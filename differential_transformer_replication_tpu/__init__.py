@@ -1,0 +1,20 @@
+"""TPU-native Differential Transformer framework.
+
+A from-scratch JAX/XLA/Pallas/pjit framework with the capabilities of
+``JoshFCooper415/differential_transformer_replication`` (see SURVEY.md):
+three interchangeable decoder-only LMs (vanilla control, 2-term
+differential, N-term alternating differential) behind a single
+model-select switch, plus a data-parallel training runtime, BPE data
+pipeline, checkpointing, and fused Pallas differential flash attention.
+
+Design stance (not a port): merged-head einsum attention instead of the
+reference's per-head Python loops, pure-functional lambda scheduling
+instead of in-place buffer writes, pytree parameters, bf16 compute with
+fp32 state, and SPMD sharding over a `jax.sharding.Mesh`.
+"""
+
+__version__ = "0.1.0"
+
+from differential_transformer_replication_tpu.config import ModelConfig, TrainConfig
+
+__all__ = ["ModelConfig", "TrainConfig", "__version__"]

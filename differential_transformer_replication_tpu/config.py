@@ -1,0 +1,173 @@
+"""Configuration dataclasses.
+
+Replaces the reference's single ``TrainingConfig`` (train.py:57-93) with an
+explicit model/train split and a real ``model`` switch instead of the
+reference's comment-toggled model selection (train.py:205-230).
+
+Reference landmines deliberately fixed here (SURVEY.md section 5.6):
+  - ``n_terms`` is a real typed field (train.py:79 lacks an annotation, so
+    it silently becomes a class attribute and is dropped from ``vars()``),
+  - ``batch_size`` is not carried as a dead field (train.py:67 declares it
+    but only ``micro_batch_size`` is ever used),
+  - no global-config access from helper functions (train.py:36).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+MODEL_KINDS = ("control", "diff", "ndiff")
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Hyperparameters shared by all three model families.
+
+    Mirrors the constructor surface of the reference models
+    (control.py:114, diff_transformer.py:129, Ndiff_transformer.py:183).
+    """
+
+    model: str = "control"  # one of MODEL_KINDS (train.py:205-230 switch)
+    vocab_size: int = 12000  # train.py:41 (BPE vocab)
+    n_embd: int = 768  # train.py:60
+    n_head: int = 4  # train.py:61; the *diff* head count
+    n_layer: int = 8  # train.py:62
+    block_size: int = 512  # train.py:63
+    dropout: float = 0.0  # train.py:64
+    n_terms: int = 4  # Ndiff_transformer.py:183 default (train.py's 0 is a bug)
+    # TPU execution policy (no reference analog; reference used CUDA AMP fp16,
+    # train.py:251-279 — on TPU we use bf16 compute without loss scaling).
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    # Attention backend: "xla" (merged-head einsum under jit) or "pallas"
+    # (fused differential flash attention kernel).
+    attention_impl: str = "xla"
+
+    def __post_init__(self):
+        if self.model not in MODEL_KINDS:
+            raise ValueError(f"model must be one of {MODEL_KINDS}, got {self.model!r}")
+        if self.model == "ndiff" and self.n_terms < 1:
+            raise ValueError(
+                "n_terms must be >= 1 (the reference's n_terms=0 config, "
+                "train.py:79, would crash at Ndiff_transformer.py:119)"
+            )
+
+    @property
+    def head_size(self) -> int:
+        """Per-head query/key width.
+
+        control.py:96 uses n_embd // n_head; the differential variants halve
+        it because each head carries a doubled value
+        (diff_transformer.py:111, Ndiff_transformer.py:164).
+        """
+        if self.model == "control":
+            return self.n_embd // self.n_head
+        return self.n_embd // (self.n_head * 2)
+
+    @property
+    def value_size(self) -> int:
+        """Per-head value width: doubled for differential variants
+        (diff_transformer.py:30, Ndiff_transformer.py:59)."""
+        if self.model == "control":
+            return self.head_size
+        return self.head_size * 2
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. The reference has no working distributed path
+    (NCCL/DDP imported but never initialized, train.py:7-10,88); this is the
+    TPU-native replacement: axes map onto ICI.
+    """
+
+    data: int = 1  # data parallel (batch sharding + gradient psum)
+    fsdp: int = 1  # parameter/optimizer sharding over the data axis group
+    tensor: int = 1  # tensor parallel (head / ffn-hidden sharding)
+    sequence: int = 1  # context parallel (ring attention over sequence)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("data", "fsdp", "tensor", "sequence")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.data, self.fsdp, self.tensor, self.sequence)
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training recipe, mirroring train.py:57-93 field for field."""
+
+    model: ModelConfig = field(default_factory=ModelConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+    # Optimization (train.py:67-78)
+    grad_acc_steps: int = 1  # train.py:68
+    micro_batch_size: int = 32  # train.py:69 (per optimizer step, pre-DP-split)
+    max_iters: int = 40_000  # train.py:70
+    eval_interval: int = 500  # train.py:71
+    eval_iters: int = 200  # train.py:72
+    learning_rate: float = 3.2e-4  # train.py:73
+    min_lr: float = 6e-5  # train.py:74
+    weight_decay: float = 0.1  # train.py:75
+    beta1: float = 0.9  # train.py:76
+    beta2: float = 0.95  # train.py:77
+    warmup_iters: int = 1000  # train.py:78
+    grad_clip: float = 1.0  # train.py:275
+
+    # Reference quirk preserved as a flag: train.py:223-230 doubles the head
+    # count when training the control model ("Double the heads since each
+    # head is smaller") so control roughly param-matches diff.
+    control_head_multiplier: int = 2
+
+    # Data (train.py:82, 155, 41-46)
+    dataset: str = "tinystories"  # "tinystories" | "synthetic" | path to a .txt
+    num_train_samples: int = 1_000_000
+    vocab_size: int = 12000
+    min_frequency: int = 2
+    val_fraction: float = 0.1  # train.py:178 (90/10 split)
+    tokenizer_dir: str = "tokenizer"
+
+    # Logging (train.py:90-93)
+    log_interval: int = 10
+    wandb_project: str = "diff-transformer"
+    wandb_run_name: Optional[str] = None
+    use_wandb: bool = False  # wandb sink is optional; stdout+jsonl always on
+    metrics_path: Optional[str] = "metrics.jsonl"
+
+    # Checkpointing (train.py:307-317 saved; resume is new capability)
+    checkpoint_path: str = "best_model.ckpt"
+    resume_from: Optional[str] = None
+
+    seed: int = 1337  # train.py:329-330
+
+    def resolved_model(self) -> ModelConfig:
+        """Apply trainer-level switches to the model config: the
+        control-head-doubling quirk (train.py:226) and the single source of
+        truth for vocab_size (the trainer's, which the tokenizer produces —
+        train.py:160)."""
+        m = self.model
+        if m.vocab_size != self.vocab_size:
+            m = m.replace(vocab_size=self.vocab_size)
+        if m.model == "control" and self.control_head_multiplier != 1:
+            m = m.replace(n_head=m.n_head * self.control_head_multiplier)
+        return m
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = dataclasses.asdict(self)
+        return d

@@ -1,0 +1,33 @@
+from differential_transformer_replication_tpu.ops.rope import rope_cos_sin, apply_rope
+from differential_transformer_replication_tpu.ops.norms import layer_norm, group_layer_norm
+from differential_transformer_replication_tpu.ops.swiglu import swiglu
+from differential_transformer_replication_tpu.ops.lambdas import (
+    lambda_init_schedule,
+    diff_lambda,
+    ndiff_lambdas,
+    ndiff_signs,
+)
+from differential_transformer_replication_tpu.ops.attention import (
+    causal_mask,
+    masked_softmax,
+    vanilla_attention,
+    diff_attention,
+    ndiff_attention,
+)
+
+__all__ = [
+    "rope_cos_sin",
+    "apply_rope",
+    "layer_norm",
+    "group_layer_norm",
+    "swiglu",
+    "lambda_init_schedule",
+    "diff_lambda",
+    "ndiff_lambdas",
+    "ndiff_signs",
+    "causal_mask",
+    "masked_softmax",
+    "vanilla_attention",
+    "diff_attention",
+    "ndiff_attention",
+]

@@ -1,0 +1,138 @@
+"""Merged-head attention ops (XLA path).
+
+The reference computes attention one head at a time in Python loops
+(control.py:76, diff_transformer.py:89, Ndiff_transformer.py:142) — the
+single biggest performance sin to fix on TPU. Here every variant is a
+batched einsum over all heads at once: shapes ``(B, T, H, d)`` so the MXU
+sees large contractions, with softmax in float32 (matching the numerics
+the reference gets from CUDA AMP's fp32 softmax) and matmuls in the
+compute dtype.
+
+Behavioral parity:
+  - scale is ``1/sqrt(head_size)`` (control.py:51, diff_transformer.py:57,
+    Ndiff_transformer.py:98),
+  - causal mask fills future positions with -inf BEFORE softmax
+    (control.py:55),
+  - attention-probability dropout is applied per map, independently
+    (diff_transformer.py:66-67), before the lambda combination,
+  - diff combine: ``att1 - lambda * att2`` (diff_transformer.py:70),
+  - ndiff combine: ``lambda_0*att_0 + sum_i sign_i*lambda_i*att_i``
+    (Ndiff_transformer.py:119-123).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask(seq_len: int) -> jnp.ndarray:
+    """Lower-triangular keep-mask, the ``tril`` buffer of control.py:31."""
+    return jnp.tril(jnp.ones((seq_len, seq_len), dtype=bool))
+
+
+def masked_softmax(scores: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """float32 softmax over the last axis with -inf masking
+    (control.py:55-58). ``mask`` broadcasts against ``scores``; True=keep."""
+    scores = scores.astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    return jax.nn.softmax(scores, axis=-1)
+
+
+def _dropout(probs: jnp.ndarray, rate: float, rng: Optional[jax.Array]) -> jnp.ndarray:
+    """Inverted dropout on attention probabilities (control.py:59). A no-op
+    at rate 0 (the reference default, train.py:64) or without an rng
+    (deterministic/eval mode)."""
+    if rate <= 0.0 or rng is None:
+        return probs
+    keep = jax.random.bernoulli(rng, 1.0 - rate, probs.shape)
+    return jnp.where(keep, probs / (1.0 - rate), 0.0)
+
+
+def _probs(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    dropout_rate: float,
+    rng: Optional[jax.Array],
+) -> jnp.ndarray:
+    """Scores -> masked fp32 softmax -> dropout. q, k: (B, T, H, d) ->
+    probs (B, H, T, T)."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    probs = masked_softmax(scores, mask)
+    return _dropout(probs, dropout_rate, rng)
+
+
+def vanilla_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    mask: Optional[jnp.ndarray] = None,
+    dropout_rate: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Standard causal attention, all heads at once (control.py:38-63).
+
+    q, k, v: (B, T, H, d) -> (B, T, H, d).
+    """
+    probs = _probs(q, k, mask, dropout_rate, rng)
+    return jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+
+
+def diff_attention(
+    q1: jnp.ndarray,
+    k1: jnp.ndarray,
+    q2: jnp.ndarray,
+    k2: jnp.ndarray,
+    v: jnp.ndarray,
+    lam: jnp.ndarray,
+    *,
+    mask: Optional[jnp.ndarray] = None,
+    dropout_rate: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Two-term differential attention (diff_transformer.py:50-73).
+
+    q1/k1/q2/k2: (B, T, H, d); v: (B, T, H, 2d); lam: per-head scalars (H,)
+    in float32. Returns (B, T, H, 2d).
+    """
+    rng1 = rng2 = None
+    if rng is not None:
+        rng1, rng2 = jax.random.split(rng)
+    att1 = _probs(q1, k1, mask, dropout_rate, rng1)
+    att2 = _probs(q2, k2, mask, dropout_rate, rng2)
+    diff = att1 - lam[None, :, None, None] * att2  # fp32 combine
+    return jnp.einsum("bhts,bshd->bthd", diff.astype(v.dtype), v)
+
+
+def ndiff_attention(
+    qs: jnp.ndarray,
+    ks: jnp.ndarray,
+    v: jnp.ndarray,
+    lams: jnp.ndarray,
+    signs: jnp.ndarray,
+    *,
+    mask: Optional[jnp.ndarray] = None,
+    dropout_rate: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """N-term alternating differential attention
+    (Ndiff_transformer.py:95-126), all terms batched into a leading axis
+    instead of the reference's Python term loop.
+
+    qs/ks: (n_terms, B, T, H, d); v: (B, T, H, 2d); lams: (n_terms, H)
+    float32; signs: (n_terms,) with signs[0]=+1 (the first map is scaled by
+    lambda_0, Ndiff_transformer.py:119). Returns (B, T, H, 2d).
+    """
+    scale = 1.0 / (qs.shape[-1] ** 0.5)
+    scores = jnp.einsum("nbthd,nbshd->nbhts", qs, ks) * scale
+    probs = masked_softmax(scores, mask)  # (n, B, H, T, T) fp32
+    probs = _dropout(probs, dropout_rate, rng)
+    coeff = signs[:, None] * lams  # (n_terms, H)
+    diff = jnp.einsum("nh,nbhts->bhts", coeff.astype(jnp.float32), probs)
+    return jnp.einsum("bhts,bshd->bthd", diff.astype(v.dtype), v)

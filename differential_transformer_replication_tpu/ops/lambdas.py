@@ -1,0 +1,82 @@
+"""Learnable-lambda machinery for differential attention.
+
+The reference computes lambda inside each head's forward with an in-place
+buffer write (diff_transformer.py:41-48 writes ``dynamic_init`` into a
+registered buffer every call, :44). Here lambda is a pure function: the
+dynamic init is computed from the static 1-based ``layer_idx`` at trace
+time and never stored.
+
+Parity quirks preserved (SURVEY.md section 2.1):
+  - the dynamic schedule ``0.8 - 0.6*exp(-0.3*(layer_idx - 1))`` uses
+    1-BASED layer indices (diff_transformer.py:43; blocks are enumerated
+    from 1 at diff_transformer.py:161 / Ndiff_transformer.py:216),
+  - the multi-head OUTPUT scale is a separate, never-updated buffer fixed
+    at 0.8, making the post-norm scale a constant ``1 - 0.8 = 0.2`` at
+    every layer (diff_transformer.py:86,91) — see OUTPUT_SCALE,
+  - N-term lambdas: term 0 is ``mean(exp(lq0*lk0) + init)`` (no
+    subtraction), term i>0 subtracts term i-1's exponential
+    (Ndiff_transformer.py:85-93).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+# diff_transformer.py:86,91 — the MultiHead*DiffAttention modules keep their
+# own lambda_init buffer at its initial 0.8 forever, so the output scale is
+# the constant (1 - 0.8), NOT a function of the dynamic per-layer schedule.
+OUTPUT_SCALE = 1.0 - 0.8
+
+
+def lambda_init_schedule(layer_idx: int) -> float:
+    """Dynamic per-layer lambda_init, 1-based layer index
+    (diff_transformer.py:43). Layer 1 -> 0.2, 2 -> 0.3555..., 8 -> 0.7265...
+
+    Computed host-side: ``layer_idx`` is static under jit.
+    """
+    return 0.8 - 0.6 * math.exp(-0.3 * (float(layer_idx) - 1.0))
+
+
+def diff_lambda(
+    lambda_q1: jnp.ndarray,
+    lambda_k1: jnp.ndarray,
+    lambda_q2: jnp.ndarray,
+    lambda_k2: jnp.ndarray,
+    lambda_init: float,
+) -> jnp.ndarray:
+    """Two-term lambda (diff_transformer.py:45-48).
+
+    Inputs are (..., head_size) — typically (H, d) with all heads merged.
+    Returns the scalar-per-head lambda of shape (...,): the MEAN over the
+    head_size axis of ``exp(lq1*lk1) - exp(lq2*lk2) + init``. At zero init
+    this is exactly ``lambda_init``.
+    """
+    vec = jnp.exp(lambda_q1 * lambda_k1) - jnp.exp(lambda_q2 * lambda_k2) + lambda_init
+    return jnp.mean(vec, axis=-1)
+
+
+def ndiff_lambdas(
+    lambda_qs: jnp.ndarray,
+    lambda_ks: jnp.ndarray,
+    lambda_init: float,
+) -> jnp.ndarray:
+    """N-term lambdas (Ndiff_transformer.py:85-93).
+
+    ``lambda_qs``/``lambda_ks``: (n_terms, ..., head_size). Returns
+    (n_terms, ...): term 0 is ``mean(exp(lq0*lk0) + init)``; term i>0 is
+    ``mean(exp(lqi*lki) - exp(lq(i-1)*lk(i-1)) + init)``.
+    """
+    e = jnp.exp(lambda_qs * lambda_ks)  # (n_terms, ..., d)
+    prev = jnp.concatenate([jnp.zeros_like(e[:1]), e[:-1]], axis=0)
+    return jnp.mean(e - prev + lambda_init, axis=-1)
+
+
+def ndiff_signs(n_terms: int) -> jnp.ndarray:
+    """Alternating combination signs (Ndiff_transformer.py:119-123): the
+    first map enters with ``+lambda_0`` (NOT coefficient 1 — this is why
+    n_terms=2 is not numerically identical to the 2-term DiffHead), then
+    ``-1 if i odd else +1`` for i >= 1."""
+    signs = [1.0] + [(-1.0 if i % 2 else 1.0) for i in range(1, n_terms)]
+    return jnp.asarray(signs, dtype=jnp.float32)

@@ -1,0 +1,66 @@
+"""Rotary position embeddings.
+
+Real-arithmetic equivalent of the reference's complex-number formulation
+(control.py:4-22, duplicated Ndiff_transformer.py:4-22): the reference packs
+consecutive feature pairs ``(x[2i], x[2i+1])`` into complex numbers and
+multiplies by ``exp(i * t * theta_j)``. Here we keep everything real (TPUs
+have no complex MXU path): split even/odd lanes, rotate, re-interleave.
+
+Parity notes:
+  - frequencies: ``1 / theta**(2j/d)`` for ``j in [0, d/2)`` (control.py:6),
+  - the rotation is computed in float32 and cast back to the input dtype,
+    matching the reference's explicit upcast (control.py:17,22),
+  - the table is truncated to the actual sequence length at apply time
+    (control.py:18).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(head_dim: int, max_seq_len: int, theta: float = 10000.0):
+    """Precompute the (cos, sin) tables, each of shape ``(max_seq_len, head_dim // 2)``.
+
+    Equivalent to the modulus/argument of ``precompute_freqs_cis``
+    (control.py:4-9): ``torch.polar(ones, outer(t, freqs))`` has
+    ``cos(t * f_j) + i sin(t * f_j)`` entries.
+    """
+    j = jnp.arange(0, head_dim, 2, dtype=jnp.float32)[: head_dim // 2]
+    freqs = 1.0 / (theta ** (j / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)  # (T, d/2)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x``.
+
+    Layout rule: ndim >= 4 means the merged-head layout ``(..., T, H, d)``
+    (tables broadcast over the head axis); ndim <= 3 means ``(..., T, d)``,
+    the reference's per-head layout (control.py:11-22).
+
+    ``cos``/``sin`` have shape ``(>=T, d//2)`` and are truncated to T
+    (control.py:18). Pairing is over consecutive features, matching
+    ``x.reshape(*, -1, 2)`` + ``view_as_complex`` (control.py:17): the even
+    lane is the real part, the odd lane the imaginary part.
+    """
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    x_even = xf[..., 0::2]
+    x_odd = xf[..., 1::2]
+
+    if x.ndim >= 4:
+        # (..., T, H, d): broadcast tables over the head axis.
+        seq_len = x.shape[-3]
+        c = cos[:seq_len][:, None, :]
+        s = sin[:seq_len][:, None, :]
+    else:
+        seq_len = x.shape[-2]
+        c = cos[:seq_len]
+        s = sin[:seq_len]
+
+    rot_even = x_even * c - x_odd * s
+    rot_odd = x_even * s + x_odd * c
+    out = jnp.stack([rot_even, rot_odd], axis=-1).reshape(x.shape)
+    return out.astype(orig_dtype)

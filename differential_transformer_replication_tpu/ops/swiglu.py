@@ -1,0 +1,25 @@
+"""SwiGLU activation: ``silu(x @ Wg + bg) * (x @ Wx + bx)``.
+
+Replicates the reference's SwiGLU module (control.py:80-90, copied at
+diff_transformer.py:95-105 and Ndiff_transformer.py:148-158). Both linears
+carry biases (the reference uses ``nn.Linear`` defaults).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def swiglu(
+    x: jnp.ndarray,
+    w_gate: jnp.ndarray,
+    b_gate: jnp.ndarray,
+    w_xform: jnp.ndarray,
+    b_xform: jnp.ndarray,
+) -> jnp.ndarray:
+    """``x``: (..., in); weights stored (in, out) so this is ``x @ W + b``
+    (the transpose of torch's (out, in) storage — same math)."""
+    gate = jax.nn.silu(x @ w_gate + b_gate)
+    xform = x @ w_xform + b_xform
+    return gate * xform

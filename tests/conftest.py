@@ -1,0 +1,27 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+This is the TPU-world stand-in for a multi-chip testbed (SURVEY.md
+section 4): ``xla_force_host_platform_device_count`` fakes 8 devices so
+sharding/collective tests run on one host. Must be set before jax is
+imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The container's sitecustomize imports jax at interpreter start (before
+# this conftest), so the env var alone is too late — force the platform
+# through the live config as well. Backends must not have initialized yet.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"
+assert jax.device_count() == 8, "expected 8 virtual CPU devices for sharding tests"

@@ -1,0 +1,277 @@
+"""Unit + parity-fixture tests for core ops.
+
+Fixtures are independent numpy re-derivations of the reference formulas
+(cited per test); nothing is imported from /root/reference.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.ops import (
+    apply_rope,
+    causal_mask,
+    diff_attention,
+    diff_lambda,
+    group_layer_norm,
+    lambda_init_schedule,
+    layer_norm,
+    masked_softmax,
+    ndiff_attention,
+    ndiff_lambdas,
+    ndiff_signs,
+    rope_cos_sin,
+    swiglu,
+    vanilla_attention,
+)
+from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
+
+
+def np_softmax(x, axis=-1):
+    x = x - np.max(x, axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def np_rope(x, theta=10000.0):
+    """Complex-arithmetic RoPE exactly as control.py:4-22: consecutive
+    feature pairs as complex numbers times exp(i*t*f_j)."""
+    T, d = x.shape[-2], x.shape[-1]
+    j = np.arange(0, d, 2)[: d // 2].astype(np.float64)
+    freqs = 1.0 / (theta ** (j / d))
+    angles = np.outer(np.arange(T), freqs)
+    f_cis = np.exp(1j * angles)  # (T, d/2)
+    xc = x.astype(np.float64).reshape(*x.shape[:-1], d // 2, 2)
+    xc = xc[..., 0] + 1j * xc[..., 1]
+    rot = xc * f_cis
+    out = np.stack([rot.real, rot.imag], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class TestRope:
+    def test_matches_complex_formulation(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 7, 16)).astype(np.float32)  # (B, T, d)
+        cos, sin = rope_cos_sin(16, 32)
+        got = apply_rope(jnp.asarray(x), cos, sin)
+        np.testing.assert_allclose(np.asarray(got), np_rope(x), rtol=1e-5, atol=1e-5)
+
+    def test_headed_layout(self):
+        """(B, T, H, d) must equal per-head application."""
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 5, 3, 8)).astype(np.float32)
+        cos, sin = rope_cos_sin(8, 5)
+        got = np.asarray(apply_rope(jnp.asarray(x), cos, sin))
+        for h in range(3):
+            np.testing.assert_allclose(got[:, :, h], np_rope(x[:, :, h]), rtol=1e-5, atol=1e-5)
+
+    def test_table_truncation(self):
+        """Tables longer than T are truncated at apply time (control.py:18)."""
+        x = np.ones((1, 3, 4), np.float32)
+        cos, sin = rope_cos_sin(4, 100)
+        got = apply_rope(jnp.asarray(x), cos, sin)
+        assert got.shape == (1, 3, 4)
+
+    def test_preserves_dtype(self):
+        cos, sin = rope_cos_sin(8, 4)
+        x = jnp.ones((1, 4, 8), jnp.bfloat16)
+        assert apply_rope(x, cos, sin).dtype == jnp.bfloat16
+
+    def test_position_zero_identity(self):
+        """t=0 -> angle 0 -> no rotation."""
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((1, 1, 6)).astype(np.float32)
+        cos, sin = rope_cos_sin(6, 1)
+        np.testing.assert_allclose(np.asarray(apply_rope(jnp.asarray(x), cos, sin)), x, rtol=1e-6)
+
+
+class TestNorms:
+    def test_layer_norm_formula(self):
+        """Biased variance, eps inside sqrt (diff_transformer.py:17-19)."""
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((2, 4, 10)).astype(np.float32)
+        w = rng.standard_normal(10).astype(np.float32)
+        b = rng.standard_normal(10).astype(np.float32)
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)  # biased
+        want = (x - mean) / np.sqrt(var + 1e-5) * w + b
+        got = layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+    def test_group_layer_norm_is_full_width(self):
+        """The quirk: GroupLayerNorm normalizes the ENTIRE concat dim, not
+        per head (diff_transformer.py:17-18). With per-head stats this
+        fixture would NOT match."""
+        rng = np.random.default_rng(4)
+        H, two_d = 3, 8
+        x = rng.standard_normal((2, 5, H * two_d)).astype(np.float32)
+        w = np.ones(H * two_d, np.float32)
+        b = np.zeros(H * two_d, np.float32)
+        got = np.asarray(group_layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)))
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        want = (x - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        # sanity: differs from per-head normalization
+        xh = x.reshape(2, 5, H, two_d)
+        per_head = (xh - xh.mean(-1, keepdims=True)) / np.sqrt(xh.var(-1, keepdims=True) + 1e-5)
+        assert not np.allclose(got, per_head.reshape(2, 5, -1), atol=1e-3)
+
+
+class TestSwiGLU:
+    def test_formula(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((4, 6)).astype(np.float32)
+        wg = rng.standard_normal((6, 9)).astype(np.float32)
+        bg = rng.standard_normal(9).astype(np.float32)
+        wx = rng.standard_normal((6, 9)).astype(np.float32)
+        bx = rng.standard_normal(9).astype(np.float32)
+        g = x @ wg + bg
+        want = (g / (1 + np.exp(-g))) * (x @ wx + bx)  # silu(g) * xform
+        got = swiglu(*map(jnp.asarray, (x, wg, bg, wx, bx)))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+class TestLambdas:
+    def test_dynamic_init_schedule_pinned_values(self):
+        """SURVEY.md section 2.1 table: 1-based layers (diff_transformer.py:43)."""
+        want = {1: 0.2, 2: 0.3555091, 3: 0.4707130, 4: 0.5560582,
+                5: 0.6192835, 6: 0.6661219, 7: 0.7008207, 8: 0.7265261}
+        for layer, val in want.items():
+            assert lambda_init_schedule(layer) == pytest.approx(val, abs=1e-6)
+        # layer 1 exactly: 0.8 - 0.6*exp(0) = 0.2
+        assert lambda_init_schedule(1) == pytest.approx(0.2, abs=1e-12)
+
+    def test_diff_lambda_zero_init_equals_lambda_init(self):
+        """At zero-initialized lambda params (diff_transformer.py:35-38),
+        exp(0)-exp(0)+init = init exactly."""
+        z = jnp.zeros((4, 16))
+        lam = diff_lambda(z, z, z, z, 0.2)
+        np.testing.assert_allclose(np.asarray(lam), 0.2 * np.ones(4), rtol=1e-6)
+
+    def test_diff_lambda_formula(self):
+        rng = np.random.default_rng(6)
+        lq1, lk1, lq2, lk2 = (rng.standard_normal((2, 8)).astype(np.float32) * 0.1 for _ in range(4))
+        init = 0.4707
+        want = (np.exp(lq1 * lk1) - np.exp(lq2 * lk2) + init).mean(-1)
+        got = diff_lambda(*map(jnp.asarray, (lq1, lk1, lq2, lk2)), init)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    def test_ndiff_lambda_chain(self):
+        """Ndiff_transformer.py:85-93: term 0 has no subtraction; term i
+        subtracts term i-1's exponential."""
+        rng = np.random.default_rng(7)
+        n, H, d = 3, 2, 8
+        lqs = (rng.standard_normal((n, H, d)) * 0.1).astype(np.float32)
+        lks = (rng.standard_normal((n, H, d)) * 0.1).astype(np.float32)
+        init = 0.2
+        e = np.exp(lqs * lks)
+        want = np.stack(
+            [(e[0] + init).mean(-1)]
+            + [(e[i] - e[i - 1] + init).mean(-1) for i in range(1, n)]
+        )
+        got = ndiff_lambdas(jnp.asarray(lqs), jnp.asarray(lks), init)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    def test_ndiff_signs(self):
+        np.testing.assert_array_equal(np.asarray(ndiff_signs(5)), [1, -1, 1, -1, 1])
+
+    def test_output_scale_is_fixed_point_two(self):
+        """diff_transformer.py:86,91 — the multi-head module's lambda_init
+        buffer is never updated, so the output scale is constant 0.2."""
+        assert OUTPUT_SCALE == pytest.approx(0.2)
+
+
+def np_attention_probs(q, k, causal=True):
+    """Per-head fixture: (T, d) x (T, d) -> masked softmax probs."""
+    T = q.shape[0]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    att = (q @ k.T) * scale
+    if causal:
+        mask = np.tril(np.ones((T, T), bool))
+        att = np.where(mask, att, -np.inf)
+    return np_softmax(att)
+
+
+class TestAttention:
+    def setup_method(self):
+        self.rng = np.random.default_rng(8)
+
+    def test_masked_softmax_rows_sum_to_one(self):
+        s = jnp.asarray(self.rng.standard_normal((2, 3, 4, 4)), dtype=jnp.float32)
+        p = masked_softmax(s, causal_mask(4))
+        np.testing.assert_allclose(np.asarray(p.sum(-1)), 1.0, rtol=1e-5)
+        assert p.dtype == jnp.float32
+
+    def test_vanilla_matches_per_head_fixture(self):
+        B, T, H, d = 2, 6, 3, 4
+        q, k, v = (self.rng.standard_normal((B, T, H, d)).astype(np.float32) for _ in range(3))
+        out = np.asarray(vanilla_attention(*map(jnp.asarray, (q, k, v)), mask=causal_mask(T)))
+        for b in range(B):
+            for h in range(H):
+                probs = np_attention_probs(q[b, :, h], k[b, :, h])
+                np.testing.assert_allclose(out[b, :, h], probs @ v[b, :, h], rtol=1e-4, atol=1e-5)
+
+    def test_causality(self):
+        """Changing future tokens must not change past outputs."""
+        B, T, H, d = 1, 5, 2, 4
+        q, k, v = (self.rng.standard_normal((B, T, H, d)).astype(np.float32) for _ in range(3))
+        out1 = np.asarray(vanilla_attention(*map(jnp.asarray, (q, k, v)), mask=causal_mask(T)))
+        k2, v2 = k.copy(), v.copy()
+        k2[:, -1], v2[:, -1] = 99.0, 99.0
+        out2 = np.asarray(vanilla_attention(*map(jnp.asarray, (q, k2, v2)), mask=causal_mask(T)))
+        np.testing.assert_allclose(out1[:, :-1], out2[:, :-1], rtol=1e-5, atol=1e-6)
+
+    def test_diff_attention_fixture(self):
+        """diff_transformer.py:57-72: out = (att1 - lam*att2) @ v per head."""
+        B, T, H, d = 2, 5, 2, 4
+        q1, k1, q2, k2 = (self.rng.standard_normal((B, T, H, d)).astype(np.float32) for _ in range(4))
+        v = self.rng.standard_normal((B, T, H, 2 * d)).astype(np.float32)
+        lam = np.asarray([0.2, 0.5], np.float32)
+        out = np.asarray(
+            diff_attention(*map(jnp.asarray, (q1, k1, q2, k2, v)), jnp.asarray(lam), mask=causal_mask(T))
+        )
+        for b in range(B):
+            for h in range(H):
+                a1 = np_attention_probs(q1[b, :, h], k1[b, :, h])
+                a2 = np_attention_probs(q2[b, :, h], k2[b, :, h])
+                want = (a1 - lam[h] * a2) @ v[b, :, h]
+                np.testing.assert_allclose(out[b, :, h], want, rtol=1e-4, atol=1e-5)
+
+    def test_ndiff_attention_fixture(self):
+        """Ndiff_transformer.py:117-125: lambda_0-scaled first map plus
+        alternating-sign terms."""
+        n, B, T, H, d = 3, 1, 4, 2, 4
+        qs = self.rng.standard_normal((n, B, T, H, d)).astype(np.float32)
+        ks = self.rng.standard_normal((n, B, T, H, d)).astype(np.float32)
+        v = self.rng.standard_normal((B, T, H, 2 * d)).astype(np.float32)
+        lams = (self.rng.uniform(0.1, 0.9, (n, H))).astype(np.float32)
+        out = np.asarray(
+            ndiff_attention(
+                jnp.asarray(qs), jnp.asarray(ks), jnp.asarray(v),
+                jnp.asarray(lams), ndiff_signs(n), mask=causal_mask(T),
+            )
+        )
+        for b in range(B):
+            for h in range(H):
+                maps = [np_attention_probs(qs[i, b, :, h], ks[i, b, :, h]) for i in range(n)]
+                acc = lams[0, h] * maps[0]
+                for i in range(1, n):
+                    sign = -1.0 if i % 2 else 1.0
+                    acc = acc + sign * lams[i, h] * maps[i]
+                np.testing.assert_allclose(out[b, :, h], acc @ v[b, :, h], rtol=1e-4, atol=1e-5)
+
+    def test_dropout_zero_is_identity_and_active_scales(self):
+        B, T, H, d = 1, 4, 1, 4
+        q, k, v = (self.rng.standard_normal((B, T, H, d)).astype(np.float32) for _ in range(3))
+        key = jax.random.PRNGKey(0)
+        out0 = vanilla_attention(*map(jnp.asarray, (q, k, v)), mask=causal_mask(T), dropout_rate=0.0, rng=key)
+        out_none = vanilla_attention(*map(jnp.asarray, (q, k, v)), mask=causal_mask(T))
+        np.testing.assert_allclose(np.asarray(out0), np.asarray(out_none), rtol=1e-6)
+        out_drop = vanilla_attention(
+            *map(jnp.asarray, (q, k, v)), mask=causal_mask(T), dropout_rate=0.5, rng=key
+        )
+        assert not np.allclose(np.asarray(out_drop), np.asarray(out_none))
