@@ -1,0 +1,8 @@
+from differential_transformer_replication_tpu.models.registry import (
+    init_model,
+    model_forward,
+    param_count,
+)
+from differential_transformer_replication_tpu.models.generate import generate
+
+__all__ = ["init_model", "model_forward", "param_count", "generate"]

@@ -1,0 +1,96 @@
+"""Shared model plumbing: parameter init, linear/FFN application, loss.
+
+Init parity with the reference's ``_init_weights`` (control.py:132-138,
+identical in the other two files): every Linear weight ~ N(0, 0.02), every
+Linear bias zero, embeddings ~ N(0, 0.02). LayerNorm weights/biases start
+at ones/zeros, and the lambda vectors start at zero (diff_transformer.py:
+35-38) — ``_init_weights`` only touches Linear/Embedding modules, so those
+defaults survive in the reference too.
+
+Weights are stored ``(in, out)`` so application is ``x @ W + b`` (the
+transpose of torch's ``(out, in)`` storage; same distribution at init
+since entries are iid).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from differential_transformer_replication_tpu.ops import layer_norm, swiglu
+
+INIT_STD = 0.02  # control.py:134
+
+
+def normal_init(key: jax.Array, shape, std: float = INIT_STD) -> jnp.ndarray:
+    return jax.random.normal(key, shape, dtype=jnp.float32) * std
+
+
+def linear_params(key: jax.Array, in_dim: int, out_dim: int, bias: bool = True) -> dict:
+    p = {"w": normal_init(key, (in_dim, out_dim))}
+    if bias:
+        p["b"] = jnp.zeros((out_dim,), jnp.float32)
+    return p
+
+
+def linear(x: jnp.ndarray, p: dict) -> jnp.ndarray:
+    y = x @ p["w"].astype(x.dtype)
+    if "b" in p:
+        y = y + p["b"].astype(x.dtype)
+    return y
+
+
+def layer_norm_params(dim: int) -> dict:
+    return {"w": jnp.ones((dim,), jnp.float32), "b": jnp.zeros((dim,), jnp.float32)}
+
+
+def apply_layer_norm(x: jnp.ndarray, p: dict) -> jnp.ndarray:
+    return layer_norm(x, p["w"], p["b"])
+
+
+def ffn_params(key: jax.Array, n_embd: int) -> dict:
+    """The reference FFN: SwiGLU(n_embd -> 4*n_embd) then Linear(4*n_embd ->
+    n_embd) then Dropout (control.py:100-104). All three linears carry
+    biases (nn.Linear defaults)."""
+    kg, kx, ko = jax.random.split(key, 3)
+    return {
+        "gate": linear_params(kg, n_embd, 4 * n_embd),
+        "xform": linear_params(kx, n_embd, 4 * n_embd),
+        "out": linear_params(ko, 4 * n_embd, n_embd),
+    }
+
+
+def apply_ffn(
+    x: jnp.ndarray,
+    p: dict,
+    dropout_rate: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    h = swiglu(
+        x,
+        p["gate"]["w"].astype(x.dtype), p["gate"]["b"].astype(x.dtype),
+        p["xform"]["w"].astype(x.dtype), p["xform"]["b"].astype(x.dtype),
+    )
+    out = linear(h, p["out"])
+    return dropout(out, dropout_rate, rng)
+
+
+from differential_transformer_replication_tpu.ops.dropout import dropout  # noqa: E402  (re-export for model modules)
+
+
+def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean cross-entropy over all (B*T) positions, matching the flattened
+    ``F.cross_entropy`` call (control.py:153-159). Computed in float32."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def split_rng(rng: Optional[jax.Array], n: int):
+    """Split an optional dropout rng into n optional keys."""
+    if rng is None:
+        return (None,) * n
+    return tuple(jax.random.split(rng, n))

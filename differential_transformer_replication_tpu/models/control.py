@@ -1,0 +1,107 @@
+"""StandardTransformer: the vanilla-attention control model.
+
+Functional JAX re-design of control.py:113-171 — decoder-only LM with
+RoPE as the only position encoding (no position table, control.py:118-119,
+143-144), pre-LN residual blocks, SwiGLU FFN, untied lm_head.
+
+All heads are computed in one merged einsum instead of the reference's
+per-head Python loop (control.py:76).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from differential_transformer_replication_tpu.config import ModelConfig
+from differential_transformer_replication_tpu.models import common
+from differential_transformer_replication_tpu.ops import (
+    apply_rope,
+    causal_mask,
+    rope_cos_sin,
+    vanilla_attention,
+)
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> dict:
+    H, d, E = cfg.n_head, cfg.head_size, cfg.n_embd
+    keys = jax.random.split(key, cfg.n_layer + 3)
+    blocks = []
+    for li in range(cfg.n_layer):
+        kq, kk, kv, ko, kf = jax.random.split(keys[li], 5)
+        blocks.append(
+            {
+                "ln1": common.layer_norm_params(E),
+                "attn": {
+                    # merged per-head K/Q/V projections, no bias
+                    # (control.py:28-30)
+                    "wq": common.normal_init(kq, (E, H, d)),
+                    "wk": common.normal_init(kk, (E, H, d)),
+                    "wv": common.normal_init(kv, (E, H, d)),
+                    # out-proj Linear(head_size*num_heads, n_embd) with bias
+                    # (control.py:72)
+                    "out": common.linear_params(ko, H * d, E),
+                },
+                "ln2": common.layer_norm_params(E),
+                "ffn": common.ffn_params(kf, E),
+            }
+        )
+    return {
+        "tok_emb": common.normal_init(keys[-3], (cfg.vocab_size, E)),
+        "blocks": blocks,
+        "ln_f": common.layer_norm_params(E),
+        "lm_head": common.linear_params(keys[-1], E, cfg.vocab_size),
+    }
+
+
+def _attn(
+    x: jnp.ndarray,
+    p: dict,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    mask: jnp.ndarray,
+    dropout_rate: float,
+    rng: Optional[jax.Array],
+) -> jnp.ndarray:
+    B, T, E = x.shape
+    r_att, r_out = common.split_rng(rng, 2)
+    q = jnp.einsum("bte,ehd->bthd", x, p["wq"].astype(x.dtype))
+    k = jnp.einsum("bte,ehd->bthd", x, p["wk"].astype(x.dtype))
+    v = jnp.einsum("bte,ehd->bthd", x, p["wv"].astype(x.dtype))
+    q = apply_rope(q, cos, sin)  # control.py:47-48
+    k = apply_rope(k, cos, sin)
+    out = vanilla_attention(q, k, v, mask=mask, dropout_rate=dropout_rate, rng=r_att)
+    out = out.reshape(B, T, -1)  # concat heads (control.py:76)
+    out = common.linear(out, p["out"])
+    return common.dropout(out, dropout_rate, r_out)  # control.py:77
+
+
+def forward(
+    params: dict,
+    idx: jnp.ndarray,
+    cfg: ModelConfig,
+    targets: Optional[jnp.ndarray] = None,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """(B, T) int tokens -> (logits (B, T, V), loss or None)."""
+    B, T = idx.shape
+    compute = jnp.dtype(cfg.compute_dtype)
+    x = params["tok_emb"][idx].astype(compute)  # control.py:144, no pos table
+    cos, sin = rope_cos_sin(cfg.head_size, T)
+    mask = causal_mask(T)
+    rngs = common.split_rng(rng, cfg.n_layer)
+    for blk, r in zip(params["blocks"], rngs):
+        r_attn, r_ffn = common.split_rng(r, 2)
+        x = x + _attn(
+            common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
+            cos, sin, mask, cfg.dropout, r_attn,
+        )
+        x = x + common.apply_ffn(
+            common.apply_layer_norm(x, blk["ln2"]), blk["ffn"], cfg.dropout, r_ffn
+        )
+    x = common.apply_layer_norm(x, params["ln_f"])
+    logits = common.linear(x, params["lm_head"])
+    loss = None if targets is None else common.cross_entropy_loss(logits, targets)
+    return logits, loss

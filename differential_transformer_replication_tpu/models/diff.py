@@ -1,0 +1,133 @@
+"""DiffTransformer: the 2-term differential attention model.
+
+Functional JAX re-design of diff_transformer.py:128-185. Distinctive
+reference behaviors preserved:
+  - learned ABSOLUTE position embeddings — the only variant with a
+    position table; no RoPE (diff_transformer.py:133-134, 157-159),
+  - head_size = n_embd // (2 * n_head) with doubled values
+    (diff_transformer.py:111, 30),
+  - per-layer dynamic lambda_init with 1-BASED layer indices
+    (diff_transformer.py:43, 161), computed purely from the static layer
+    index instead of the reference's in-place buffer write,
+  - full-width GroupLayerNorm over the head concat, then the CONSTANT 0.2
+    output scale (diff_transformer.py:90-91; SURVEY.md section 2.1 quirks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from differential_transformer_replication_tpu.config import ModelConfig
+from differential_transformer_replication_tpu.models import common
+from differential_transformer_replication_tpu.ops import (
+    causal_mask,
+    diff_attention,
+    diff_lambda,
+    group_layer_norm,
+    lambda_init_schedule,
+)
+from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> dict:
+    H, d, E = cfg.n_head, cfg.head_size, cfg.n_embd
+    keys = jax.random.split(key, cfg.n_layer + 3)
+    blocks = []
+    for li in range(cfg.n_layer):
+        kq, kk, kv, ko, kf = jax.random.split(keys[li], 5)
+        blocks.append(
+            {
+                "ln1": common.layer_norm_params(E),
+                "attn": {
+                    # the two Q/K streams stacked on a leading axis
+                    # (query1/query2, key1/key2: diff_transformer.py:26-29)
+                    "wq": common.normal_init(kq, (2, E, H, d)),
+                    "wk": common.normal_init(kk, (2, E, H, d)),
+                    # doubled value projection (diff_transformer.py:30)
+                    "wv": common.normal_init(kv, (E, H, 2 * d)),
+                    # lambda vectors, zero-init (diff_transformer.py:35-38)
+                    "lambda_q": jnp.zeros((2, H, d), jnp.float32),
+                    "lambda_k": jnp.zeros((2, H, d), jnp.float32),
+                    "gn": common.layer_norm_params(H * 2 * d),
+                    # out-proj Linear(2*head_size*num_heads, n_embd), bias
+                    # (diff_transformer.py:84)
+                    "out": common.linear_params(ko, H * 2 * d, E),
+                },
+                "ln2": common.layer_norm_params(E),
+                "ffn": common.ffn_params(kf, E),
+            }
+        )
+    return {
+        "tok_emb": common.normal_init(keys[-3], (cfg.vocab_size, E)),
+        # learned absolute positions (diff_transformer.py:134)
+        "pos_emb": common.normal_init(keys[-2], (cfg.block_size, E)),
+        "blocks": blocks,
+        "ln_f": common.layer_norm_params(E),
+        "lm_head": common.linear_params(keys[-1], E, cfg.vocab_size),
+    }
+
+
+def _attn(
+    x: jnp.ndarray,
+    p: dict,
+    layer_idx: int,
+    mask: jnp.ndarray,
+    dropout_rate: float,
+    rng: Optional[jax.Array],
+) -> jnp.ndarray:
+    B, T, E = x.shape
+    r_att, r_out = common.split_rng(rng, 2)
+    qs = jnp.einsum("bte,sehd->sbthd", x, p["wq"].astype(x.dtype))
+    ks = jnp.einsum("bte,sehd->sbthd", x, p["wk"].astype(x.dtype))
+    v = jnp.einsum("bte,ehd->bthd", x, p["wv"].astype(x.dtype))
+    lam = diff_lambda(
+        p["lambda_q"][0], p["lambda_k"][0],
+        p["lambda_q"][1], p["lambda_k"][1],
+        lambda_init_schedule(layer_idx),
+    )  # (H,) fp32
+    out = diff_attention(
+        qs[0], ks[0], qs[1], ks[1], v, lam,
+        mask=mask, dropout_rate=dropout_rate, rng=r_att,
+    )
+    out = out.reshape(B, T, -1)  # concat heads (diff_transformer.py:89)
+    out = group_layer_norm(out, p["gn"]["w"], p["gn"]["b"])  # :90
+    out = out * OUTPUT_SCALE  # constant 0.2, :91
+    out = common.linear(out, p["out"])
+    return common.dropout(out, dropout_rate, r_out)
+
+
+def forward(
+    params: dict,
+    idx: jnp.ndarray,
+    cfg: ModelConfig,
+    targets: Optional[jnp.ndarray] = None,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """(B, T) int tokens -> (logits (B, T, V), loss or None)."""
+    B, T = idx.shape
+    if T > cfg.block_size:
+        # The reference raises (nn.Embedding index error) past block_size;
+        # a JAX gather would silently clamp, so fail loudly instead.
+        raise ValueError(f"sequence length {T} exceeds block_size {cfg.block_size}")
+    compute = jnp.dtype(cfg.compute_dtype)
+    tok = params["tok_emb"][idx]
+    pos = params["pos_emb"][jnp.arange(T)]  # diff_transformer.py:158
+    x = (tok + pos).astype(compute)
+    mask = causal_mask(T)
+    rngs = common.split_rng(rng, cfg.n_layer)
+    for li, (blk, r) in enumerate(zip(params["blocks"], rngs), 1):  # 1-based, :161
+        r_attn, r_ffn = common.split_rng(r, 2)
+        x = x + _attn(
+            common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
+            li, mask, cfg.dropout, r_attn,
+        )
+        x = x + common.apply_ffn(
+            common.apply_layer_norm(x, blk["ln2"]), blk["ffn"], cfg.dropout, r_ffn
+        )
+    x = common.apply_layer_norm(x, params["ln_f"])
+    logits = common.linear(x, params["lm_head"])
+    loss = None if targets is None else common.cross_entropy_loss(logits, targets)
+    return logits, loss

@@ -1,0 +1,69 @@
+"""Autoregressive sampling.
+
+Behavioral match for the reference's ``generate`` (control.py:163-171,
+diff_transformer.py:177-185, Ndiff_transformer.py:232-241): crop the
+context to the last ``block_size`` tokens, run a full forward, take the
+last position's logits, and sample at temperature 1 with no top-k/top-p
+(``torch.multinomial`` over the softmax == Gumbel sampling via
+``jax.random.categorical``).
+
+TPU re-design: instead of the reference's Python loop over a growing
+tensor (O(T^2) recompile-inducing dynamic shapes), a single jitted
+``lax.fori_loop`` carries a fixed ``(B, block_size)`` window buffer.
+Positions stay left-aligned exactly as the reference's crop does; slots
+past the current length are garbage but cannot influence earlier
+positions under the causal mask.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from differential_transformer_replication_tpu.config import ModelConfig
+from differential_transformer_replication_tpu.models.registry import model_forward
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def generate(
+    params: dict,
+    idx: jnp.ndarray,
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    rng: jax.Array,
+) -> jnp.ndarray:
+    """idx: (B, T0) prompt with 0 < T0 <= block_size. Returns
+    (B, T0 + max_new_tokens), prompt included, like the reference."""
+    B, T0 = idx.shape
+    S = cfg.block_size
+    if T0 > S:
+        raise ValueError(f"prompt length {T0} exceeds block_size {S}")
+
+    window = jnp.zeros((B, S), idx.dtype).at[:, :T0].set(idx)
+    samples = jnp.zeros((B, max_new_tokens), idx.dtype)
+
+    def body(i, carry):
+        window, length, samples, rng = carry
+        rng, sample_key = jax.random.split(rng)
+        logits, _ = model_forward(params, window, cfg)
+        # logits at the last real position (control.py:167)
+        last = logits[:, length - 1, :].astype(jnp.float32)
+        nxt = jax.random.categorical(sample_key, last, axis=-1).astype(window.dtype)
+        samples = samples.at[:, i].set(nxt)
+
+        def append(w):
+            return w.at[:, length].set(nxt)
+
+        def shift(w):
+            return jnp.concatenate([w[:, 1:], nxt[:, None]], axis=1)
+
+        window = jax.lax.cond(length < S, append, shift, window)
+        length = jnp.minimum(length + 1, S)
+        return window, length, samples, rng
+
+    _, _, samples, _ = jax.lax.fori_loop(
+        0, max_new_tokens, body, (window, jnp.asarray(T0, jnp.int32), samples, rng)
+    )
+    return jnp.concatenate([idx, samples], axis=1)

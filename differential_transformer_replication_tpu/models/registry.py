@@ -1,0 +1,36 @@
+"""Model-select switch.
+
+The reference selects models by commenting code blocks in and out
+(train.py:205-230); here it is a first-class dispatch on
+``ModelConfig.model`` covering the same three families.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from differential_transformer_replication_tpu.config import ModelConfig
+from differential_transformer_replication_tpu.models import control, diff, ndiff
+
+_MODULES = {"control": control, "diff": diff, "ndiff": ndiff}
+
+
+def init_model(key: jax.Array, cfg: ModelConfig) -> dict:
+    return _MODULES[cfg.model].init(key, cfg)
+
+
+def model_forward(
+    params: dict,
+    idx: jnp.ndarray,
+    cfg: ModelConfig,
+    targets: Optional[jnp.ndarray] = None,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    return _MODULES[cfg.model].forward(params, idx, cfg, targets=targets, rng=rng)
+
+
+def param_count(params: dict) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))

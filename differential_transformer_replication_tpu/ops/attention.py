@@ -42,14 +42,7 @@ def masked_softmax(scores: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndar
     return jax.nn.softmax(scores, axis=-1)
 
 
-def _dropout(probs: jnp.ndarray, rate: float, rng: Optional[jax.Array]) -> jnp.ndarray:
-    """Inverted dropout on attention probabilities (control.py:59). A no-op
-    at rate 0 (the reference default, train.py:64) or without an rng
-    (deterministic/eval mode)."""
-    if rate <= 0.0 or rng is None:
-        return probs
-    keep = jax.random.bernoulli(rng, 1.0 - rate, probs.shape)
-    return jnp.where(keep, probs / (1.0 - rate), 0.0)
+from differential_transformer_replication_tpu.ops.dropout import dropout as _dropout
 
 
 def _probs(

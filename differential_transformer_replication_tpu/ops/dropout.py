@@ -1,0 +1,18 @@
+"""Inverted dropout, shared by attention-probability dropout
+(control.py:59, diff_transformer.py:66-67) and residual/FFN dropout
+(control.py:77,103). Identity at rate 0 (the reference default,
+train.py:64) or without an rng (deterministic/eval mode)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dropout(x: jnp.ndarray, rate: float, rng: Optional[jax.Array]) -> jnp.ndarray:
+    if rate <= 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0)

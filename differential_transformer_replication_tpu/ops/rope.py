@@ -33,12 +33,20 @@ def rope_cos_sin(head_dim: int, max_seq_len: int, theta: float = 10000.0):
     return jnp.cos(angles), jnp.sin(angles)
 
 
-def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    headed: bool | None = None,
+) -> jnp.ndarray:
     """Rotate ``x``.
 
-    Layout rule: ndim >= 4 means the merged-head layout ``(..., T, H, d)``
-    (tables broadcast over the head axis); ndim <= 3 means ``(..., T, d)``,
-    the reference's per-head layout (control.py:11-22).
+    Layout rule (when ``headed`` is None): ndim >= 4 means the merged-head
+    layout ``(..., T, H, d)`` (tables broadcast over the head axis); ndim <=
+    3 means ``(..., T, d)``, the reference's per-head layout
+    (control.py:11-22). Pass ``headed`` explicitly for ambiguous ranks
+    (an unbatched ``(T, H, d)`` is rank 3 and would otherwise be rotated by
+    head index).
 
     ``cos``/``sin`` have shape ``(>=T, d//2)`` and are truncated to T
     (control.py:18). Pairing is over consecutive features, matching
@@ -50,7 +58,9 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarra
     x_even = xf[..., 0::2]
     x_odd = xf[..., 1::2]
 
-    if x.ndim >= 4:
+    if headed is None:
+        headed = x.ndim >= 4
+    if headed:
         # (..., T, H, d): broadcast tables over the head axis.
         seq_len = x.shape[-3]
         c = cos[:seq_len][:, None, :]
