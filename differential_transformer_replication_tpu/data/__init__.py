@@ -1,0 +1,16 @@
+from differential_transformer_replication_tpu.data.corpus import load_corpus
+from differential_transformer_replication_tpu.data.tokenizer import (
+    encode_corpus,
+    load_tokenizer,
+    train_bpe_tokenizer,
+)
+from differential_transformer_replication_tpu.data.sampler import TokenWindows, split_tokens
+
+__all__ = [
+    "load_corpus",
+    "train_bpe_tokenizer",
+    "load_tokenizer",
+    "encode_corpus",
+    "TokenWindows",
+    "split_tokens",
+]

@@ -1,0 +1,91 @@
+"""Corpus loading.
+
+The reference hard-codes HF ``load_dataset('roneneldan/TinyStories')``
+(train.py:155). Here the source is a config switch:
+  - ``"tinystories"``: the HF dataset if a local cache exists (this
+    environment has no network egress; we never download),
+  - ``"synthetic"``: a seeded generator of TinyStories-like text so the
+    full pipeline runs hermetically,
+  - a filesystem path: plain text, one document per line.
+
+Falls back from tinystories to synthetic with a warning rather than
+failing, so training is always runnable.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+_SYNTH_NOUNS = [
+    "cat", "dog", "bird", "tree", "ball", "house", "river", "star", "frog",
+    "bear", "boat", "cake", "hat", "moon", "sun", "fish", "girl", "boy",
+    "dragon", "garden", "mouse", "cloud", "flower", "stone", "fox", "owl",
+]
+_SYNTH_NAMES = [
+    "Tom", "Lily", "Max", "Mia", "Sam", "Anna", "Ben", "Sue", "Tim", "Amy",
+    "Leo", "Zoe", "Jack", "Emma", "Finn", "Ruby",
+]
+_SYNTH_VERBS = [
+    "found", "saw", "liked", "chased", "made", "lost", "painted", "carried",
+    "hugged", "shared", "hid", "threw", "caught", "visited", "built",
+]
+_SYNTH_ADJS = [
+    "big", "small", "red", "happy", "sad", "shiny", "old", "funny", "brave",
+    "tiny", "green", "soft", "loud", "quiet", "kind",
+]
+
+
+def synthetic_corpus(num_docs: int, seed: int = 1337) -> List[str]:
+    """Seeded TinyStories-like documents: short simple sentences with a
+    tiny vocabulary, enough structure for a small LM to learn from."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    names = rng.choice(_SYNTH_NAMES, size=num_docs)
+    docs = []
+    for i in range(num_docs):
+        n_sent = int(rng.integers(2, 6))
+        name = names[i]
+        sents = []
+        for _ in range(n_sent):
+            noun = rng.choice(_SYNTH_NOUNS)
+            verb = rng.choice(_SYNTH_VERBS)
+            adj = rng.choice(_SYNTH_ADJS)
+            form = int(rng.integers(0, 4))
+            if form == 0:
+                sents.append(f"{name} {verb} a {adj} {noun}.")
+            elif form == 1:
+                sents.append(f"One day, {name} {verb} the {noun}.")
+            elif form == 2:
+                sents.append(f"The {noun} was very {adj}.")
+            else:
+                other = rng.choice(_SYNTH_NAMES)
+                sents.append(f"{name} and {other} {verb} a {noun} together.")
+        docs.append(" ".join(sents))
+    return docs
+
+
+def load_corpus(dataset: str, num_train_samples: int, seed: int = 1337) -> List[str]:
+    """Returns the first ``num_train_samples`` documents (train.py:165)."""
+    if dataset == "synthetic":
+        return synthetic_corpus(num_train_samples, seed)
+    if dataset == "tinystories":
+        try:
+            from datasets import load_dataset
+
+            ds = load_dataset("roneneldan/TinyStories")
+            return list(ds["train"]["text"][:num_train_samples])
+        except Exception as e:  # no cache / no network
+            print(
+                f"[data] TinyStories unavailable ({type(e).__name__}); "
+                "falling back to the synthetic corpus",
+                file=sys.stderr,
+            )
+            return synthetic_corpus(num_train_samples, seed)
+    if os.path.exists(dataset):
+        with open(dataset, "r", encoding="utf-8") as f:
+            texts = [line.rstrip("\n") for line in f if line.strip()]
+        return texts[:num_train_samples]
+    raise ValueError(f"unknown dataset {dataset!r} (not a known name or a path)")

@@ -1,0 +1,67 @@
+"""Byte-level BPE tokenizer training and corpus encoding.
+
+Replicates ``create_and_train_tokenizer`` (train.py:27-55) and the
+tokenize loop (train.py:165-172): a from-scratch ByteLevelBPE with
+vocab_size=12000, min_frequency=2, special tokens ``<|endoftext|>`` and
+``<|pad|>``; every document is encoded and followed by one
+``<|endoftext|>`` id. This layer stays host-side Python by design
+(SURVEY.md section 7.4) — the `tokenizers` library is Rust-backed and
+already fast.
+
+Fixed vs the reference: no module-global config access (train.py:36), no
+temp-file round trip (train.py:35-37) — we train from the in-memory
+iterator.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence
+
+import numpy as np
+
+EOT = "<|endoftext|>"
+PAD = "<|pad|>"
+
+
+def train_bpe_tokenizer(
+    texts: Sequence[str],
+    vocab_size: int = 12000,
+    min_frequency: int = 2,
+    save_dir: str | None = "tokenizer",
+):
+    """Train ByteLevelBPE on the given texts (train.py:41-46) and
+    optionally persist vocab+merges to ``save_dir`` (train.py:49-50)."""
+    from tokenizers import ByteLevelBPETokenizer
+
+    tok = ByteLevelBPETokenizer()
+    tok.train_from_iterator(
+        iter(texts),
+        vocab_size=vocab_size,
+        min_frequency=min_frequency,
+        special_tokens=[EOT, PAD],
+    )
+    if save_dir:
+        os.makedirs(save_dir, exist_ok=True)
+        tok.save_model(save_dir)
+    return tok
+
+
+def load_tokenizer(save_dir: str):
+    from tokenizers import ByteLevelBPETokenizer
+
+    return ByteLevelBPETokenizer(
+        os.path.join(save_dir, "vocab.json"), os.path.join(save_dir, "merges.txt")
+    )
+
+
+def encode_corpus(tokenizer, texts: Sequence[str]) -> np.ndarray:
+    """Encode all texts, appending one EOT id after each document
+    (train.py:167-170). Returns a flat int32 token array."""
+    eot_id = tokenizer.token_to_id(EOT)
+    parts: List[np.ndarray] = []
+    # encode_batch is the Rust-parallel path; the reference's per-text
+    # Python loop (train.py:167) was a host bottleneck.
+    for enc in tokenizer.encode_batch(list(texts)):
+        parts.append(np.asarray(enc.ids + [eot_id], dtype=np.int32))
+    return np.concatenate(parts) if parts else np.zeros((0,), np.int32)

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from differential_transformer_replication_tpu.ops import layer_norm, swiglu
+from differential_transformer_replication_tpu.ops.dropout import dropout
 
 INIT_STD = 0.02  # control.py:134
 
@@ -77,7 +78,6 @@ def apply_ffn(
     return dropout(out, dropout_rate, rng)
 
 
-from differential_transformer_replication_tpu.ops.dropout import dropout  # noqa: E402  (re-export for model modules)
 
 
 def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:

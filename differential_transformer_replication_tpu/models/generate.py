@@ -38,8 +38,8 @@ def generate(
     (B, T0 + max_new_tokens), prompt included, like the reference."""
     B, T0 = idx.shape
     S = cfg.block_size
-    if T0 > S:
-        raise ValueError(f"prompt length {T0} exceeds block_size {S}")
+    if not 0 < T0 <= S:
+        raise ValueError(f"prompt length {T0} must be in (0, block_size={S}]")
 
     window = jnp.zeros((B, S), idx.dtype).at[:, :T0].set(idx)
     samples = jnp.zeros((B, max_new_tokens), idx.dtype)

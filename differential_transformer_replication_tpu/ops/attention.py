@@ -27,6 +27,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from differential_transformer_replication_tpu.ops.dropout import dropout as _dropout
+
 
 def causal_mask(seq_len: int) -> jnp.ndarray:
     """Lower-triangular keep-mask, the ``tril`` buffer of control.py:31."""
@@ -40,9 +42,6 @@ def masked_softmax(scores: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndar
     if mask is not None:
         scores = jnp.where(mask, scores, -jnp.inf)
     return jax.nn.softmax(scores, axis=-1)
-
-
-from differential_transformer_replication_tpu.ops.dropout import dropout as _dropout
 
 
 def _probs(

@@ -1,0 +1,37 @@
+from differential_transformer_replication_tpu.train.optim import (
+    cosine_warmup_schedule,
+    make_optimizer,
+)
+from differential_transformer_replication_tpu.train.step import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+from differential_transformer_replication_tpu.train.checkpoint import (
+    from_pretrained,
+    load_checkpoint,
+    save_checkpoint,
+    save_pretrained,
+)
+from differential_transformer_replication_tpu.train.metrics import MetricLogger
+from differential_transformer_replication_tpu.train.trainer import (
+    build_data,
+    estimate_loss,
+    train,
+)
+
+__all__ = [
+    "cosine_warmup_schedule",
+    "make_optimizer",
+    "create_train_state",
+    "make_eval_step",
+    "make_train_step",
+    "save_checkpoint",
+    "load_checkpoint",
+    "save_pretrained",
+    "from_pretrained",
+    "MetricLogger",
+    "train",
+    "build_data",
+    "estimate_loss",
+]

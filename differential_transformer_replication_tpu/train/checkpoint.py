@@ -1,0 +1,80 @@
+"""Checkpointing and resume.
+
+Two formats, generalizing the reference's pair (SURVEY.md section 3.5):
+
+1. **Training checkpoint** — params + optimizer state + step +
+   best_val_loss + full train config (the reference's best-model blob,
+   train.py:310-317), PLUS actual resume support, which the reference
+   never built (no load path exists in its train.py).
+2. **``save_pretrained`` / ``from_pretrained``** — self-describing
+   {model_args, model_state} for ALL THREE model families, generalizing
+   the N-diff-only implementation (Ndiff_transformer.py:243-265).
+
+Serialization is flax msgpack (pytree-shaped, framework-native) in a
+checkpoint directory: ``state.msgpack`` + ``meta.json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional, Tuple
+
+import jax
+from flax import serialization
+
+from differential_transformer_replication_tpu.config import ModelConfig, TrainConfig
+from differential_transformer_replication_tpu.models import init_model
+
+
+def save_checkpoint(
+    path: str, state: dict, best_val_loss: float, cfg: TrainConfig
+) -> None:
+    """train.py:310-317 equivalent (model+optimizer+scheduler state; the
+    schedule is stateless here, so `step` covers it)."""
+    os.makedirs(path, exist_ok=True)
+    state = jax.device_get(state)
+    with open(os.path.join(path, "state.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(state))
+    meta = {
+        "best_val_loss": float(best_val_loss),
+        "iter_num": int(state["step"]),
+        "config": cfg.to_dict(),
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+def load_checkpoint(path: str, cfg: TrainConfig, target_state: dict) -> Tuple[dict, float]:
+    """Restore (state, best_val_loss). ``target_state`` supplies the pytree
+    structure (create_train_state output)."""
+    if not os.path.isfile(os.path.join(path, "state.msgpack")):
+        raise FileNotFoundError(
+            f"no checkpoint at {path!r} (expected {path}/state.msgpack)"
+        )
+    with open(os.path.join(path, "state.msgpack"), "rb") as f:
+        state = serialization.from_bytes(target_state, f.read())
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    return state, meta["best_val_loss"]
+
+
+def save_pretrained(path: str, params: dict, model_cfg: ModelConfig) -> None:
+    """Self-describing model checkpoint (Ndiff_transformer.py:251-265),
+    for any of the three families."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "params.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(jax.device_get(params)))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump({"model_args": dataclasses.asdict(model_cfg)}, f, indent=1)
+
+
+def from_pretrained(path: str) -> Tuple[dict, ModelConfig]:
+    """Rebuild config + params (Ndiff_transformer.py:243-249)."""
+    with open(os.path.join(path, "config.json")) as f:
+        model_cfg = ModelConfig(**json.load(f)["model_args"])
+    target = init_model(jax.random.PRNGKey(0), model_cfg)
+    with open(os.path.join(path, "params.msgpack"), "rb") as f:
+        params = serialization.from_bytes(target, f.read())
+    return params, model_cfg

@@ -1,0 +1,86 @@
+"""Metric logging with pluggable sinks.
+
+Replicates the reference's observability surface (train.py:286-304):
+stdout prints in the same format, the same metric names and cadence
+(``iter``/``loss``/``learning_rate``/``gpu_memory`` every log_interval;
+``train_loss``/``val_loss`` every eval_interval), with sinks:
+  - stdout (always),
+  - JSONL append (replaces wandb as the durable record; always unless
+    disabled),
+  - wandb (optional, only if installed and enabled — the reference hard
+    -requires it, train.py:15,151).
+
+``gpu_memory`` keeps the reference's key name for drop-in dashboard
+compatibility but reports the accelerator's (TPU) allocated bytes in MB.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import jax
+
+from differential_transformer_replication_tpu.config import TrainConfig
+
+
+def device_memory_mb() -> float:
+    """Allocated device memory in MB (the reference logs
+    torch.cuda.memory_allocated/1024**2, train.py:293)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        return stats.get("bytes_in_use", 0) / 1024**2
+    except Exception:
+        return 0.0
+
+
+class MetricLogger:
+    def __init__(self, cfg: TrainConfig, run_config: Optional[dict] = None):
+        self.cfg = cfg
+        self._jsonl = None
+        self._wandb = None
+        if cfg.metrics_path:
+            self._jsonl = open(cfg.metrics_path, "a", buffering=1)
+        if cfg.use_wandb:
+            try:
+                import wandb
+
+                wandb.init(
+                    project=cfg.wandb_project,
+                    name=cfg.wandb_run_name,
+                    config=run_config or cfg.to_dict(),  # train.py:151
+                )
+                self._wandb = wandb
+            except Exception as e:
+                print(f"[metrics] wandb unavailable ({type(e).__name__}); continuing without")
+
+    def log_step(self, iter_num: int, loss: float, lr: float) -> None:
+        """Per-log_interval metrics (train.py:286-294)."""
+        print(f"iter {iter_num}: loss {loss:.4f}, lr {lr:.2e}")  # train.py:288
+        self._emit(
+            {
+                "iter": iter_num,
+                "loss": loss,
+                "learning_rate": lr,
+                "gpu_memory": device_memory_mb(),
+            }
+        )
+
+    def log_eval(self, iter_num: int, train_loss: float, val_loss: float) -> None:
+        """Per-eval_interval metrics (train.py:297-304)."""
+        print(
+            f"step {iter_num}: train loss {train_loss:.4f}, val loss {val_loss:.4f}"
+        )  # train.py:299
+        self._emit({"iter": iter_num, "train_loss": train_loss, "val_loss": val_loss})
+
+    def _emit(self, payload: dict) -> None:
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(payload) + "\n")
+        if self._wandb is not None:
+            self._wandb.log(payload)
+
+    def finish(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+        if self._wandb is not None:
+            self._wandb.finish()  # train.py:325

@@ -1,0 +1,61 @@
+"""Optimizer and LR schedule.
+
+Replicates the reference recipe (train.py:236-251): AdamW with decoupled
+weight decay applied to ALL parameters (torch applies it uniformly; we
+deliberately do NOT exclude norms/biases, for parity), betas (0.9, 0.95),
+global-norm gradient clipping at 1.0 BEFORE the optimizer step
+(train.py:274-275), and the linear-warmup + cosine-decay schedule of
+``CosineWarmupScheduler`` (train.py:109-123).
+
+Parity notes:
+  - torch steps the scheduler AFTER the optimizer, so optimizer step k
+    uses the LR computed at count k starting from 0 — the FIRST step runs
+    at lr = base * 0 / warmup = 0. optax's schedule-by-count reproduces
+    this exactly (count starts at 0).
+  - past max_steps the reference keeps following the cosine beyond pi
+    (progress > 1); we replicate rather than clamp.
+  - no GradScaler: bf16 on TPU needs no loss scaling (the reference's
+    fp16 AMP machinery, train.py:251-279, is dropped by design).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from differential_transformer_replication_tpu.config import TrainConfig
+
+
+def cosine_warmup_schedule(
+    base_lr: float, warmup_steps: int, max_steps: int, min_lr: float
+):
+    """The exact formula of CosineWarmupScheduler.get_lr (train.py:116-123)."""
+
+    def schedule(count):
+        count = jnp.asarray(count, jnp.float32)
+        warm = base_lr * count / max(warmup_steps, 1)
+        progress = (count - warmup_steps) / max(max_steps - warmup_steps, 1)
+        factor = 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        decay = min_lr + (base_lr - min_lr) * factor
+        return jnp.where(count < warmup_steps, warm, decay)
+
+    return schedule
+
+
+def make_optimizer(cfg: TrainConfig) -> tuple[optax.GradientTransformation, callable]:
+    """Returns (optimizer, schedule). The schedule is exposed separately so
+    the trainer can log the LR (train.py:287-288)."""
+    schedule = cosine_warmup_schedule(
+        cfg.learning_rate, cfg.warmup_iters, cfg.max_iters, cfg.min_lr
+    )
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),  # train.py:275
+        optax.adamw(
+            learning_rate=schedule,
+            b1=cfg.beta1,
+            b2=cfg.beta2,
+            eps=1e-8,  # torch AdamW default
+            weight_decay=cfg.weight_decay,  # applied to all params, as torch does
+        ),
+    )
+    return tx, schedule

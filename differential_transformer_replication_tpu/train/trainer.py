@@ -1,0 +1,134 @@
+"""The training runtime: data build, train loop, eval, checkpointing.
+
+This is the TPU-native counterpart of ``train()`` (train.py:141-325):
+same recipe, same eval protocol, same logging cadence, plus resume —
+with the eager per-batch Python loop replaced by a jitted step over
+device-resident data.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from differential_transformer_replication_tpu.config import TrainConfig
+from differential_transformer_replication_tpu.data import (
+    TokenWindows,
+    encode_corpus,
+    load_corpus,
+    split_tokens,
+    train_bpe_tokenizer,
+)
+from differential_transformer_replication_tpu.train.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+from differential_transformer_replication_tpu.train.metrics import MetricLogger
+from differential_transformer_replication_tpu.train.step import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+
+
+def estimate_loss(
+    eval_step,
+    params: dict,
+    train_ds: TokenWindows,
+    val_ds: TokenWindows,
+    cfg: TrainConfig,
+    rng: np.random.Generator,
+) -> dict:
+    """Mean loss over eval_iters batches from each split (train.py:125-139):
+    train batches shuffled, val batches sequential from the start — the
+    same draws the reference's two loaders produce."""
+    out = {}
+    for split, ds in (("train", train_ds), ("val", val_ds)):
+        losses = np.empty(cfg.eval_iters, np.float64)
+        for k in range(cfg.eval_iters):
+            if split == "train":
+                batch = ds.random_batch(rng, cfg.micro_batch_size)
+            else:
+                batch = ds.sequential_batch(k, cfg.micro_batch_size)
+            losses[k] = float(eval_step(params, batch["x"], batch["y"]))
+        out[split] = float(losses.mean())
+    return out
+
+
+def build_data(cfg: TrainConfig):
+    """Corpus -> tokenizer -> token stream -> train/val window datasets
+    (train.py:153-200)."""
+    texts = load_corpus(cfg.dataset, cfg.num_train_samples, cfg.seed)
+    tokenizer = train_bpe_tokenizer(
+        texts, cfg.vocab_size, cfg.min_frequency, cfg.tokenizer_dir
+    )
+    vocab_size = tokenizer.get_vocab_size()
+    print(f"Vocabulary size: {vocab_size}")  # train.py:161
+    tokens = encode_corpus(tokenizer, texts)
+    print(f"Total tokens: {len(tokens)}")  # train.py:174
+    train_tokens, val_tokens = split_tokens(tokens, cfg.val_fraction)
+    block = cfg.model.block_size
+    return (
+        tokenizer,
+        vocab_size,
+        TokenWindows(train_tokens, block),
+        TokenWindows(val_tokens, block),
+    )
+
+
+def train(cfg: TrainConfig) -> dict:
+    """Run the full recipe; returns the final train state."""
+    print(f"Using devices: {jax.devices()}")
+
+    tokenizer, vocab_size, train_ds, val_ds = build_data(cfg)
+    cfg = cfg.replace(vocab_size=vocab_size)
+
+    logger = MetricLogger(cfg)
+    state = create_train_state(jax.random.PRNGKey(cfg.seed), cfg)
+    best_val_loss = float("inf")
+    if cfg.resume_from:
+        state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, state)
+        print(f"Resumed from {cfg.resume_from} at iter {int(state['step'])}")
+
+    train_step = make_train_step(cfg)
+    eval_step = make_eval_step(cfg)
+
+    data_rng = np.random.default_rng(cfg.seed)
+    eval_rng = np.random.default_rng(cfg.seed + 1)
+    dropout_key = jax.random.PRNGKey(cfg.seed + 2)
+    model_cfg = cfg.resolved_model()
+    use_dropout = model_cfg.dropout > 0.0
+
+    print("Starting training...")
+    t0 = time.time()
+    tokens_seen = 0
+    iter_num = int(state["step"])
+    while iter_num < cfg.max_iters:
+        batch = train_ds.random_batches(
+            data_rng, cfg.micro_batch_size, cfg.grad_acc_steps
+        )
+        rng = jax.random.fold_in(dropout_key, iter_num) if use_dropout else None
+        state, metrics = train_step(state, batch, rng)
+        iter_num = int(state["step"])
+        tokens_seen += cfg.micro_batch_size * cfg.grad_acc_steps * model_cfg.block_size
+
+        if iter_num % cfg.log_interval == 0:
+            logger.log_step(iter_num, float(metrics["loss"]), float(metrics["learning_rate"]))
+
+        if iter_num % cfg.eval_interval == 0:
+            losses = estimate_loss(eval_step, state["params"], train_ds, val_ds, cfg, eval_rng)
+            logger.log_eval(iter_num, losses["train"], losses["val"])
+            if losses["val"] < best_val_loss:  # train.py:307-317
+                best_val_loss = losses["val"]
+                print(f"Saving best model with val loss: {best_val_loss:.4f}")
+                save_checkpoint(cfg.checkpoint_path, state, best_val_loss, cfg)
+
+    dt = time.time() - t0
+    if dt > 0:
+        print(f"Training done: {tokens_seen} tokens in {dt:.1f}s "
+              f"({tokens_seen / dt:.0f} tokens/sec)")
+    logger.finish()
+    return state

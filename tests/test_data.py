@@ -1,0 +1,114 @@
+"""Data pipeline tests: corpus, tokenizer, window sampler."""
+
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.data import (
+    TokenWindows,
+    encode_corpus,
+    load_corpus,
+    load_tokenizer,
+    split_tokens,
+    train_bpe_tokenizer,
+)
+from differential_transformer_replication_tpu.data.corpus import synthetic_corpus
+from differential_transformer_replication_tpu.data.tokenizer import EOT
+
+
+class TestCorpus:
+    def test_synthetic_deterministic(self):
+        a = synthetic_corpus(10, seed=1)
+        b = synthetic_corpus(10, seed=1)
+        assert a == b and len(a) == 10
+        assert synthetic_corpus(10, seed=2) != a
+
+    def test_load_corpus_path(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("hello world\nsecond doc\n\nthird\n")
+        texts = load_corpus(str(p), 10)
+        assert texts == ["hello world", "second doc", "third"]
+
+    def test_load_corpus_truncates(self):
+        assert len(load_corpus("synthetic", 5)) == 5
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            load_corpus("no-such-dataset", 5)
+
+
+@pytest.fixture(scope="module")
+def tok_and_tokens(tmp_path_factory):
+    texts = synthetic_corpus(300, seed=3)
+    d = tmp_path_factory.mktemp("tok")
+    tok = train_bpe_tokenizer(texts, vocab_size=600, min_frequency=2, save_dir=str(d))
+    tokens = encode_corpus(tok, texts)
+    return tok, tokens, texts, str(d)
+
+
+class TestTokenizer:
+    def test_vocab_and_specials(self, tok_and_tokens):
+        tok, tokens, texts, d = tok_and_tokens
+        assert tok.token_to_id(EOT) is not None
+        assert tok.token_to_id("<|pad|>") is not None
+        assert tok.get_vocab_size() <= 600
+
+    def test_eot_after_each_doc(self, tok_and_tokens):
+        """train.py:167-170: one EOT id per document."""
+        tok, tokens, texts, d = tok_and_tokens
+        eot = tok.token_to_id(EOT)
+        assert (tokens == eot).sum() == len(texts)
+        assert tokens[-1] == eot
+
+    def test_roundtrip(self, tok_and_tokens):
+        tok, tokens, texts, d = tok_and_tokens
+        enc = tok.encode(texts[0])
+        assert tok.decode(enc.ids) == texts[0]
+
+    def test_save_load(self, tok_and_tokens):
+        tok, tokens, texts, d = tok_and_tokens
+        tok2 = load_tokenizer(d)
+        assert tok2.encode(texts[5]).ids == tok.encode(texts[5]).ids
+
+    def test_dtype(self, tok_and_tokens):
+        _, tokens, _, _ = tok_and_tokens
+        assert tokens.dtype == np.int32
+
+
+class TestWindows:
+    def test_split(self):
+        tokens = np.arange(100, dtype=np.int32)
+        tr, va = split_tokens(tokens, 0.1)
+        assert len(tr) == 90 and len(va) == 10
+        np.testing.assert_array_equal(np.concatenate([tr, va]), tokens)
+
+    def test_window_semantics(self):
+        """train.py:104-107: window i is tokens[i:i+B], target shifted 1."""
+        tokens = np.arange(50, dtype=np.int32)
+        ds = TokenWindows(tokens, block_size=8)
+        assert len(ds) == 42
+        b = ds.batch(np.asarray([0, 5]))
+        np.testing.assert_array_equal(np.asarray(b["x"][0]), np.arange(8))
+        np.testing.assert_array_equal(np.asarray(b["y"][0]), np.arange(1, 9))
+        np.testing.assert_array_equal(np.asarray(b["x"][1]), np.arange(5, 13))
+        np.testing.assert_array_equal(np.asarray(b["y"][1]), np.arange(6, 14))
+
+    def test_sequential_batches_cover_prefix(self):
+        tokens = np.arange(200, dtype=np.int32)
+        ds = TokenWindows(tokens, block_size=4)
+        b0 = ds.sequential_batch(0, 8)
+        b1 = ds.sequential_batch(1, 8)
+        assert int(b0["x"][0, 0]) == 0
+        assert int(b1["x"][0, 0]) == 8  # next 8 windows
+
+    def test_random_batches_shape_and_range(self):
+        tokens = np.arange(300, dtype=np.int32)
+        ds = TokenWindows(tokens, block_size=16)
+        rng = np.random.default_rng(0)
+        b = ds.random_batches(rng, batch_size=4, n_batches=3)
+        assert b["x"].shape == (3, 4, 16) and b["y"].shape == (3, 4, 16)
+        # y == x + 1 for this arange corpus everywhere
+        np.testing.assert_array_equal(np.asarray(b["y"]), np.asarray(b["x"]) + 1)
+
+    def test_too_short_raises(self):
+        with pytest.raises(ValueError):
+            TokenWindows(np.arange(5, dtype=np.int32), block_size=8)

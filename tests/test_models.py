@@ -162,6 +162,12 @@ class TestGenerate:
         np.testing.assert_array_equal(np.asarray(out[:, :3]), np.asarray(prompt))
         assert (np.asarray(out) >= 0).all() and (np.asarray(out) < cfg.vocab_size).all()
 
+    def test_empty_prompt_rejected(self):
+        cfg = tiny_cfg("control")
+        params = init_model(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="prompt length"):
+            generate(params, jnp.zeros((1, 0), jnp.int32), cfg, 2, jax.random.PRNGKey(0))
+
     def test_window_overflow(self):
         """Generation past block_size exercises the sliding-window path
         (the reference's idx[:, -block_size:] crop)."""

@@ -80,6 +80,19 @@ class TestRope:
         x = jnp.ones((1, 4, 8), jnp.bfloat16)
         assert apply_rope(x, cos, sin).dtype == jnp.bfloat16
 
+    def test_headed_override_for_rank3(self):
+        """An unbatched (T, H, d) tensor is rank 3 and must be rotated by
+        position, not head index, when headed=True is passed."""
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((5, 3, 8)).astype(np.float32)  # (T, H, d)
+        cos, sin = rope_cos_sin(8, 5)
+        got = np.asarray(apply_rope(jnp.asarray(x), cos, sin, headed=True))
+        batched = np.asarray(apply_rope(jnp.asarray(x[None]), cos, sin))[0]
+        np.testing.assert_allclose(got, batched, rtol=1e-6)
+        # the auto rule would have mis-rotated this shape
+        auto = np.asarray(apply_rope(jnp.asarray(x), cos, sin))
+        assert not np.allclose(got, auto, atol=1e-4)
+
     def test_position_zero_identity(self):
         """t=0 -> angle 0 -> no rotation."""
         rng = np.random.default_rng(2)

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""CLI entry point.
+
+The reference is configured by editing source and selects models by
+commenting blocks in and out (train.py:57-93, 205-230). Here every recipe
+field is a flag and the model switch is ``--model {control,diff,ndiff}``.
+
+Defaults reproduce the reference recipe exactly (8L/768d, block 512,
+micro-batch 32, 40k iters, AdamW 3.2e-4 -> 6e-5 cosine, warmup 1000,
+TinyStories 1M docs, BPE-12k).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from differential_transformer_replication_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from differential_transformer_replication_tpu.train.trainer import train
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    m = ModelConfig()
+    t = TrainConfig()
+    p.add_argument("--model", choices=("control", "diff", "ndiff"), default=m.model)
+    p.add_argument("--n-embd", type=int, default=m.n_embd)
+    p.add_argument("--n-head", type=int, default=m.n_head)
+    p.add_argument("--n-layer", type=int, default=m.n_layer)
+    p.add_argument("--block-size", type=int, default=m.block_size)
+    p.add_argument("--dropout", type=float, default=m.dropout)
+    p.add_argument("--n-terms", type=int, default=m.n_terms)
+    p.add_argument("--compute-dtype", default=m.compute_dtype)
+    p.add_argument("--attention-impl", choices=("xla", "pallas"), default=m.attention_impl)
+
+    p.add_argument("--dataset", default=t.dataset,
+                   help="tinystories | synthetic | path to a text file")
+    p.add_argument("--num-train-samples", type=int, default=t.num_train_samples)
+    p.add_argument("--vocab-size", type=int, default=t.vocab_size)
+    p.add_argument("--micro-batch-size", type=int, default=t.micro_batch_size)
+    p.add_argument("--grad-acc-steps", type=int, default=t.grad_acc_steps)
+    p.add_argument("--max-iters", type=int, default=t.max_iters)
+    p.add_argument("--eval-interval", type=int, default=t.eval_interval)
+    p.add_argument("--eval-iters", type=int, default=t.eval_iters)
+    p.add_argument("--learning-rate", type=float, default=t.learning_rate)
+    p.add_argument("--min-lr", type=float, default=t.min_lr)
+    p.add_argument("--weight-decay", type=float, default=t.weight_decay)
+    p.add_argument("--warmup-iters", type=int, default=t.warmup_iters)
+    p.add_argument("--seed", type=int, default=t.seed)
+    p.add_argument("--checkpoint-path", default=t.checkpoint_path)
+    p.add_argument("--resume-from", default=None)
+    p.add_argument("--metrics-path", default=t.metrics_path)
+    p.add_argument("--wandb", action="store_true", help="enable the wandb sink")
+    p.add_argument("--data-parallel", type=int, default=1,
+                   help="devices on the data mesh axis")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="devices on the tensor mesh axis")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    model = ModelConfig(
+        model=args.model,
+        vocab_size=args.vocab_size,
+        n_embd=args.n_embd,
+        n_head=args.n_head,
+        n_layer=args.n_layer,
+        block_size=args.block_size,
+        dropout=args.dropout,
+        n_terms=args.n_terms,
+        compute_dtype=args.compute_dtype,
+        attention_impl=args.attention_impl,
+    )
+    return TrainConfig(
+        model=model,
+        mesh=MeshConfig(data=args.data_parallel, tensor=args.tensor_parallel),
+        dataset=args.dataset,
+        num_train_samples=args.num_train_samples,
+        vocab_size=args.vocab_size,
+        micro_batch_size=args.micro_batch_size,
+        grad_acc_steps=args.grad_acc_steps,
+        max_iters=args.max_iters,
+        eval_interval=args.eval_interval,
+        eval_iters=args.eval_iters,
+        learning_rate=args.learning_rate,
+        min_lr=args.min_lr,
+        weight_decay=args.weight_decay,
+        warmup_iters=args.warmup_iters,
+        seed=args.seed,
+        checkpoint_path=args.checkpoint_path,
+        resume_from=args.resume_from,
+        metrics_path=args.metrics_path,
+        use_wandb=args.wandb,
+    )
+
+
+if __name__ == "__main__":
+    train(config_from_args(build_parser().parse_args()))
