@@ -72,7 +72,15 @@ class TokenWindows:
         """Unshuffled-loader equivalent (train.py:193-200): batch k covers
         windows [k*B, (k+1)*B), wrapping at the end (drop_last keeps every
         batch full)."""
-        start = (batch_index * batch_size) % max(len(self) - batch_size + 1, 1)
+        if batch_size > len(self):
+            # A JAX gather would clamp out-of-range offsets into silently
+            # duplicated windows; fail loudly like DataLoader's drop_last
+            # yielding nothing.
+            raise ValueError(
+                f"batch_size {batch_size} exceeds the {len(self)} available "
+                f"windows (need more tokens in this split)"
+            )
+        start = (batch_index * batch_size) % (len(self) - batch_size + 1)
         return self.batch(np.arange(start, start + batch_size))
 
     def random_batches(

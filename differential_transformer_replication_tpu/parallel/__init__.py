@@ -1,0 +1,19 @@
+from differential_transformer_replication_tpu.parallel.mesh import create_mesh
+from differential_transformer_replication_tpu.parallel.sharding import (
+    batch_sharding,
+    make_param_specs,
+    shard_state,
+    state_sharding,
+)
+from differential_transformer_replication_tpu.parallel.dp_step import (
+    make_sharded_train_step,
+)
+
+__all__ = [
+    "create_mesh",
+    "make_param_specs",
+    "batch_sharding",
+    "state_sharding",
+    "shard_state",
+    "make_sharded_train_step",
+]

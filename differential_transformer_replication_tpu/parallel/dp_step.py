@@ -1,0 +1,59 @@
+"""The sharded (multi-chip) training step.
+
+``jax.jit`` with explicit in/out shardings over a Mesh: the partitioner
+inserts the gradient psum over the ``data``/``fsdp`` axes and the
+tensor-parallel all-gathers/reduce-scatters implied by the param specs —
+this is the working replacement for the reference's imported-but-never-
+used DDP/NCCL stack (train.py:7-10, 88).
+
+The step body is IDENTICAL to the single-device one (train/step.py); only
+the placement differs. That is the point of the SPMD design: one program,
+any mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from differential_transformer_replication_tpu.config import TrainConfig
+from differential_transformer_replication_tpu.parallel.sharding import (
+    batch_sharding,
+    state_sharding,
+)
+from differential_transformer_replication_tpu.train.step import (
+    create_train_state,
+    make_step_fn,
+)
+
+
+def make_sharded_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict):
+    """Returns ``step(state, batch, rng) -> (state, metrics)`` compiled with
+    the mesh's shardings. ``state_template`` (abstract or concrete) supplies
+    the pytree structure for sharding inference."""
+    st_sh = state_sharding(state_template, mesh)
+    b_sh = batch_sharding(mesh)
+
+    jitted = jax.jit(
+        make_step_fn(cfg),
+        in_shardings=(st_sh, {"x": b_sh, "y": b_sh}, None),
+        out_shardings=(st_sh, None),
+        donate_argnums=(0,),
+    )
+
+    def step(state: dict, batch: dict, rng=None):
+        return jitted(state, batch, rng)
+
+    return step
+
+
+def create_sharded_train_state(key: jax.Array, cfg: TrainConfig, mesh: Mesh) -> dict:
+    """Initialize the train state directly onto the mesh: the init is
+    jitted with the state sharding as out_shardings, so each device
+    materializes only its own shards (no host-side full copy)."""
+    abstract = jax.eval_shape(lambda k: create_train_state(k, cfg), key)
+    sh = state_sharding(abstract, mesh)
+    init = jax.jit(lambda k: create_train_state(k, cfg), out_shardings=sh)
+    return init(key)

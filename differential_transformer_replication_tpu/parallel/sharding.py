@@ -1,0 +1,105 @@
+"""Sharding rules: parameter, optimizer-state, and batch PartitionSpecs.
+
+Path-based rules over the model's param pytree (the structures built in
+``models/{control,diff,ndiff}.py``). The layout follows the standard
+Megatron-style recipe mapped to this architecture:
+
+  - Q/K/V projections shard the HEAD axis on ``tensor`` (column parallel);
+    the merged-head einsum then runs on local heads only,
+  - attention out-proj and FFN down-proj shard their INPUT dim on
+    ``tensor`` (row parallel) — XLA inserts the psum,
+  - FFN up-projections (SwiGLU gate/xform) shard the hidden dim,
+  - token/position embeddings shard the vocab/position dim; lm_head
+    shards vocab (logits stay vocab-sharded through the loss — XLA
+    handles the sharded log-softmax),
+  - GroupLayerNorm scale/bias shard with the head concat; block LayerNorm
+    params replicate,
+  - lambda vectors shard the head axis,
+  - everything additionally shards its largest remaining dim over
+    ``fsdp`` (ZeRO-style parameter sharding),
+  - the batch shards over ``data`` (gradient psum over ``data`` is
+    inserted by the partitioner — the DDP+NCCL equivalent the reference
+    never wired up, train.py:7-10).
+
+Optimizer state (AdamW mu/nu) inherits the param specs leafwise.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from differential_transformer_replication_tpu.config import ModelConfig
+
+
+def _spec_for(path: tuple, leaf: Any) -> P:
+    """PartitionSpec for one param leaf, keyed on its path in the model
+    pytree. ``path`` elements are jax DictKey/SequenceKey entries."""
+    names = [
+        k.key if hasattr(k, "key") else str(getattr(k, "idx", k)) for k in path
+    ]
+    name = names[-1]
+    parent = names[-2] if len(names) >= 2 else ""
+    rank = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
+
+    # embeddings: (V, E) / (S, E) -> shard rows on tensor, cols on fsdp
+    if name in ("tok_emb", "pos_emb"):
+        return P("tensor", "fsdp")
+    if name in ("wq", "wk"):
+        # (E, H, d) or (streams/terms, E, H, d): head axis on tensor
+        if rank == 3:
+            return P("fsdp", "tensor", None)
+        return P(None, "fsdp", "tensor", None)
+    if name == "wv":
+        return P("fsdp", "tensor", None)  # (E, H, v)
+    if name in ("lambda_q", "lambda_k"):
+        return P(None, "tensor", None)  # (streams, H, d)
+    if parent == "gn":
+        return P("tensor")  # (H * 2d,) aligned with the head concat
+    if parent == "out" and "attn" in names:
+        # attention out-proj: (H*v, E) row parallel
+        return P("tensor", "fsdp") if rank == 2 else P(None)
+    if parent in ("gate", "xform"):
+        # SwiGLU up-proj: (E, 4E) column parallel
+        return P("fsdp", "tensor") if rank == 2 else P("tensor")
+    if parent == "out" and "ffn" in names:
+        # FFN down-proj: (4E, E) row parallel
+        return P("tensor", "fsdp") if rank == 2 else P(None)
+    if parent == "lm_head":
+        # (E, V) vocab column parallel
+        return P("fsdp", "tensor") if rank == 2 else P("tensor")
+    # layer norms, scalars, anything else: replicated
+    return P()
+
+
+def make_param_specs(params: dict) -> dict:
+    """A PartitionSpec pytree mirroring ``params``."""
+    return jax.tree_util.tree_map_with_path(_spec_for, params)
+
+
+def state_sharding(state: dict, mesh: Mesh) -> dict:
+    """NamedSharding pytree for the full train state.
+
+    Works on the WHOLE state with the same path rules: optax's AdamW
+    moments (mu/nu) mirror the param tree, so their leaf paths END with
+    the same names (…/mu/blocks/0/attn/wq) and pick up the param's spec;
+    scalars (count, step) fall through to replicated.
+    """
+    specs = jax.tree_util.tree_map_with_path(_spec_for, state)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """(A, B, T) microbatched batch: shard the batch dim over data (+fsdp,
+    which acts as a second data axis for the forward/backward)."""
+    return NamedSharding(mesh, P(None, ("data", "fsdp"), None))
+
+
+def shard_state(state: dict, mesh: Mesh) -> dict:
+    """Place an (unsharded) train state onto the mesh."""
+    sh = state_sharding(state, mesh)
+    return jax.tree_util.tree_map(jax.device_put, state, sh)

@@ -35,15 +35,26 @@ def save_checkpoint(
     schedule is stateless here, so `step` covers it)."""
     os.makedirs(path, exist_ok=True)
     state = jax.device_get(state)
-    with open(os.path.join(path, "state.msgpack"), "wb") as f:
-        f.write(serialization.to_bytes(state))
     meta = {
         "best_val_loss": float(best_val_loss),
         "iter_num": int(state["step"]),
         "config": cfg.to_dict(),
     }
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=1)
+    # Write-then-rename so a crash mid-save (preemption) never destroys the
+    # previous good checkpoint.
+    _atomic_write(os.path.join(path, "state.msgpack"), serialization.to_bytes(state))
+    _atomic_write(
+        os.path.join(path, "meta.json"), json.dumps(meta, indent=1).encode()
+    )
+
+
+def _atomic_write(dest: str, data: bytes) -> None:
+    tmp = dest + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dest)
 
 
 def load_checkpoint(path: str, cfg: TrainConfig, target_state: dict) -> Tuple[dict, float]:

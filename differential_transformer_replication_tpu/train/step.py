@@ -46,8 +46,9 @@ def loss_fn(
     return loss
 
 
-def make_train_step(cfg: TrainConfig):
-    """Returns ``step(state, batch, rng) -> (state, metrics)``, jitted.
+def make_step_fn(cfg: TrainConfig):
+    """The raw (un-jitted) optimizer-step function — reused by the
+    single-device jit below and by the sharded jit in parallel/dp_step.py.
 
     ``batch`` is ``{"x": (A, B, T), "y": (A, B, T)}`` with A =
     grad_acc_steps microbatches (A=1 for the reference default,
@@ -58,7 +59,6 @@ def make_train_step(cfg: TrainConfig):
     tx, schedule = make_optimizer(cfg)
     grad_fn = jax.value_and_grad(loss_fn)
 
-    @jax.jit
     def step(state: dict, batch: dict, rng: Optional[jax.Array] = None):
         def micro(carry, xs):
             grads_acc, loss_acc, i = carry
@@ -92,6 +92,12 @@ def make_train_step(cfg: TrainConfig):
         return new_state, metrics
 
     return step
+
+
+def make_train_step(cfg: TrainConfig):
+    """``step(state, batch, rng) -> (state, metrics)``, jitted for the
+    default (single-device) placement."""
+    return jax.jit(make_step_fn(cfg))
 
 
 def make_eval_step(cfg: TrainConfig):

@@ -87,13 +87,34 @@ def train(cfg: TrainConfig) -> dict:
     cfg = cfg.replace(vocab_size=vocab_size)
 
     logger = MetricLogger(cfg)
-    state = create_train_state(jax.random.PRNGKey(cfg.seed), cfg)
-    best_val_loss = float("inf")
-    if cfg.resume_from:
-        state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, state)
-        print(f"Resumed from {cfg.resume_from} at iter {int(state['step'])}")
+    if cfg.mesh.n_devices > 1:
+        # Sharded path: mesh + partitioned step (the DDP/NCCL replacement).
+        from differential_transformer_replication_tpu.parallel import (
+            create_mesh,
+            make_sharded_train_step,
+            shard_state,
+        )
+        from differential_transformer_replication_tpu.parallel.dp_step import (
+            create_sharded_train_state,
+        )
 
-    train_step = make_train_step(cfg)
+        mesh = create_mesh(cfg.mesh)
+        print(f"Mesh: {dict(mesh.shape)}")
+        state = create_sharded_train_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
+        best_val_loss = float("inf")
+        if cfg.resume_from:
+            host_state = jax.tree_util.tree_map(jax.device_get, state)
+            host_state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, host_state)
+            state = shard_state(host_state, mesh)
+            print(f"Resumed from {cfg.resume_from} at iter {int(jax.device_get(state['step']))}")
+        train_step = make_sharded_train_step(cfg, mesh, state)
+    else:
+        state = create_train_state(jax.random.PRNGKey(cfg.seed), cfg)
+        best_val_loss = float("inf")
+        if cfg.resume_from:
+            state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, state)
+            print(f"Resumed from {cfg.resume_from} at iter {int(state['step'])}")
+        train_step = make_train_step(cfg)
     eval_step = make_eval_step(cfg)
 
     data_rng = np.random.default_rng(cfg.seed)
@@ -105,30 +126,39 @@ def train(cfg: TrainConfig) -> dict:
     print("Starting training...")
     t0 = time.time()
     tokens_seen = 0
-    iter_num = int(state["step"])
-    while iter_num < cfg.max_iters:
-        batch = train_ds.random_batches(
-            data_rng, cfg.micro_batch_size, cfg.grad_acc_steps
-        )
-        rng = jax.random.fold_in(dropout_key, iter_num) if use_dropout else None
-        state, metrics = train_step(state, batch, rng)
-        iter_num = int(state["step"])
-        tokens_seen += cfg.micro_batch_size * cfg.grad_acc_steps * model_cfg.block_size
+    # Host-side iteration counter: the device `state["step"]` advances by
+    # exactly 1 per call, and reading it back would force a host-device
+    # sync every iteration, breaking async dispatch pipelining.
+    iter_num = int(jax.device_get(state["step"]))
+    try:
+        while iter_num < cfg.max_iters:
+            batch = train_ds.random_batches(
+                data_rng, cfg.micro_batch_size, cfg.grad_acc_steps
+            )
+            rng = jax.random.fold_in(dropout_key, iter_num) if use_dropout else None
+            state, metrics = train_step(state, batch, rng)
+            iter_num += 1
+            tokens_seen += cfg.micro_batch_size * cfg.grad_acc_steps * model_cfg.block_size
 
-        if iter_num % cfg.log_interval == 0:
-            logger.log_step(iter_num, float(metrics["loss"]), float(metrics["learning_rate"]))
+            if iter_num % cfg.log_interval == 0:
+                logger.log_step(
+                    iter_num, float(metrics["loss"]), float(metrics["learning_rate"])
+                )
 
-        if iter_num % cfg.eval_interval == 0:
-            losses = estimate_loss(eval_step, state["params"], train_ds, val_ds, cfg, eval_rng)
-            logger.log_eval(iter_num, losses["train"], losses["val"])
-            if losses["val"] < best_val_loss:  # train.py:307-317
-                best_val_loss = losses["val"]
-                print(f"Saving best model with val loss: {best_val_loss:.4f}")
-                save_checkpoint(cfg.checkpoint_path, state, best_val_loss, cfg)
+            if iter_num % cfg.eval_interval == 0:
+                losses = estimate_loss(
+                    eval_step, state["params"], train_ds, val_ds, cfg, eval_rng
+                )
+                logger.log_eval(iter_num, losses["train"], losses["val"])
+                if losses["val"] < best_val_loss:  # train.py:307-317
+                    best_val_loss = losses["val"]
+                    print(f"Saving best model with val loss: {best_val_loss:.4f}")
+                    save_checkpoint(cfg.checkpoint_path, state, best_val_loss, cfg)
 
-    dt = time.time() - t0
-    if dt > 0:
-        print(f"Training done: {tokens_seen} tokens in {dt:.1f}s "
-              f"({tokens_seen / dt:.0f} tokens/sec)")
-    logger.finish()
+        dt = time.time() - t0
+        if dt > 0:
+            print(f"Training done: {tokens_seen} tokens in {dt:.1f}s "
+                  f"({tokens_seen / dt:.0f} tokens/sec)")
+    finally:
+        logger.finish()
     return state

@@ -112,3 +112,10 @@ class TestWindows:
     def test_too_short_raises(self):
         with pytest.raises(ValueError):
             TokenWindows(np.arange(5, dtype=np.int32), block_size=8)
+
+    def test_sequential_batch_too_large_raises(self):
+        """A tiny val split must fail loudly rather than let the gather
+        clamp offsets into silently duplicated eval windows."""
+        ds = TokenWindows(np.arange(12, dtype=np.int32), block_size=8)  # 4 windows
+        with pytest.raises(ValueError, match="exceeds"):
+            ds.sequential_batch(0, 32)

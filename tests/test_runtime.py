@@ -73,6 +73,21 @@ class TestEndToEnd:
         # loss must decrease over the run
         assert step_lines[-1]["loss"] < step_lines[0]["loss"]
 
+    def test_train_on_mesh(self, tmp_path):
+        """train() with mesh.n_devices > 1 must take the sharded path end
+        to end (the CLI's --data-parallel/--tensor-parallel wiring)."""
+        from differential_transformer_replication_tpu.config import MeshConfig
+
+        cfg = tiny_cfg(
+            tmp_path,
+            max_iters=10,
+            eval_interval=5,
+            micro_batch_size=4,
+            model_kw=dict(vocab_size=256, n_head=2),
+        ).replace(mesh=MeshConfig(data=2, tensor=2))
+        state = train(cfg)
+        assert int(jax.device_get(state["step"])) == 10
+
     def test_resume_continues(self, tmp_path):
         cfg = tiny_cfg(tmp_path, max_iters=15, eval_interval=10)
         train(cfg)
