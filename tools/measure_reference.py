@@ -1,0 +1,94 @@
+"""Measure the reference implementation's training throughput.
+
+Imports the reference's own model classes from /root/reference (no code is
+copied) and drives forward+backward+AdamW steps with synthetic token data at
+the reference recipe shapes (train.py:60-69). Records tokens/sec for the
+model-select switch's flagship (DiffTransformer, train.py:205-212).
+
+torch in this image is CPU-only, so this measures the reference on host CPU;
+the number is recorded in BASELINE.md with that caveat. Usage:
+
+    python tools/measure_reference.py [--micro-batch 8] [--steps 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+REFERENCE_PATH = "/root/reference"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--micro-batch", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--model", default="diff", choices=("control", "diff", "ndiff"))
+    args = ap.parse_args()
+
+    sys.path.insert(0, REFERENCE_PATH)
+    import torch
+
+    torch.manual_seed(1337)
+
+    # Reference recipe: train.py:60-64 (8L/768d/4-head/block-512), vocab
+    # 12000 (train.py:41), AdamW recipe train.py:236-241.
+    vocab, n_embd, n_head, n_layer, block = 12000, 768, 4, 8, 512
+    if args.model == "diff":
+        from diff_transformer import DiffTransformer
+
+        model = DiffTransformer(vocab, n_embd, n_head, n_layer, block, 0.0)
+    elif args.model == "control":
+        from control import StandardTransformer
+
+        # control gets doubled heads (train.py:226)
+        model = StandardTransformer(vocab, n_embd, n_head * 2, n_layer, block, 0.0)
+    else:
+        from Ndiff_transformer import AlternatingDiffTransformer
+
+        model = AlternatingDiffTransformer(vocab, n_embd, n_head, n_layer, block, 0.0, n_terms=4)
+
+    opt = torch.optim.AdamW(
+        model.parameters(), lr=3.2e-4, betas=(0.9, 0.95), weight_decay=0.1
+    )
+    B, T = args.micro_batch, block
+    x = torch.randint(0, vocab, (B, T))
+    y = torch.randint(0, vocab, (B, T))
+
+    def step() -> None:
+        opt.zero_grad(set_to_none=True)
+        _, loss = model(x, y)
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+        opt.step()
+
+    for _ in range(args.warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        step()
+    dt = time.perf_counter() - t0
+
+    tps = args.steps * B * T / dt
+    n_params = sum(p.numel() for p in model.parameters())
+    print(
+        json.dumps(
+            {
+                "impl": f"reference-torch-{args.model}",
+                "device": "cpu",
+                "micro_batch": B,
+                "block_size": T,
+                "steps": args.steps,
+                "sec_per_step": dt / args.steps,
+                "tokens_per_sec": tps,
+                "n_params": n_params,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
