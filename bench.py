@@ -83,14 +83,19 @@ def main() -> None:
     x = jax.random.randint(jax.random.PRNGKey(1), (1, micro_batch, T), 0, model.vocab_size)
     batch = {"x": x, "y": jnp.roll(x, -1, axis=-1)}
 
-    for _ in range(warmup):
+    # NOTE: sync via scalar readback, NOT block_until_ready — on the axon
+    # TPU platform block_until_ready returns before the computation actually
+    # finishes (measured: it reports physically impossible >1 PFLOP/s).
+    # Successive steps are serialized by the state->state data dependence,
+    # and float() forces a device->host transfer that cannot complete early.
+    for _ in range(max(warmup, 1)):  # >=1 so `metrics` exists for the sync
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    _ = float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    _ = float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     tps = steps * micro_batch * T / dt

@@ -48,6 +48,11 @@ class ModelConfig:
     def __post_init__(self):
         if self.model not in MODEL_KINDS:
             raise ValueError(f"model must be one of {MODEL_KINDS}, got {self.model!r}")
+        if self.attention_impl not in ("xla", "pallas"):
+            raise ValueError(
+                "attention_impl must be 'xla' or 'pallas', got "
+                f"{self.attention_impl!r}"
+            )
         if self.model == "ndiff" and self.n_terms < 1:
             raise ValueError(
                 "n_terms must be >= 1 (the reference's n_terms=0 config, "

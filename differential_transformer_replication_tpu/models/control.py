@@ -20,9 +20,11 @@ from differential_transformer_replication_tpu.models import common
 from differential_transformer_replication_tpu.ops import (
     apply_rope,
     causal_mask,
+    flash_vanilla_attention,
     rope_cos_sin,
     vanilla_attention,
 )
+from differential_transformer_replication_tpu.ops.flash import use_flash
 
 
 def init(key: jax.Array, cfg: ModelConfig) -> dict:
@@ -64,6 +66,7 @@ def _attn(
     mask: jnp.ndarray,
     dropout_rate: float,
     rng: Optional[jax.Array],
+    impl: str = "xla",
 ) -> jnp.ndarray:
     B, T, E = x.shape
     r_att, r_out = common.split_rng(rng, 2)
@@ -72,7 +75,12 @@ def _attn(
     v = jnp.einsum("bte,ehd->bthd", x, p["wv"].astype(x.dtype))
     q = apply_rope(q, cos, sin)  # control.py:47-48
     k = apply_rope(k, cos, sin)
-    out = vanilla_attention(q, k, v, mask=mask, dropout_rate=dropout_rate, rng=r_att)
+    if use_flash(impl, dropout_rate, r_att):
+        out = flash_vanilla_attention(q, k, v)
+    else:
+        out = vanilla_attention(
+            q, k, v, mask=mask, dropout_rate=dropout_rate, rng=r_att
+        )
     out = out.reshape(B, T, -1)  # concat heads (control.py:76)
     out = common.linear(out, p["out"])
     return common.dropout(out, dropout_rate, r_out)  # control.py:77
@@ -96,7 +104,7 @@ def forward(
         r_attn, r_ffn = common.split_rng(r, 2)
         x = x + _attn(
             common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
-            cos, sin, mask, cfg.dropout, r_attn,
+            cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl,
         )
         x = x + common.apply_ffn(
             common.apply_layer_norm(x, blk["ln2"]), blk["ffn"], cfg.dropout, r_ffn

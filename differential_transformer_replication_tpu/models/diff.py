@@ -26,9 +26,11 @@ from differential_transformer_replication_tpu.ops import (
     causal_mask,
     diff_attention,
     diff_lambda,
+    flash_diff_attention,
     group_layer_norm,
     lambda_init_schedule,
 )
+from differential_transformer_replication_tpu.ops.flash import use_flash
 from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
 
 
@@ -77,6 +79,7 @@ def _attn(
     mask: jnp.ndarray,
     dropout_rate: float,
     rng: Optional[jax.Array],
+    impl: str = "xla",
 ) -> jnp.ndarray:
     B, T, E = x.shape
     r_att, r_out = common.split_rng(rng, 2)
@@ -88,10 +91,13 @@ def _attn(
         p["lambda_q"][1], p["lambda_k"][1],
         lambda_init_schedule(layer_idx),
     )  # (H,) fp32
-    out = diff_attention(
-        qs[0], ks[0], qs[1], ks[1], v, lam,
-        mask=mask, dropout_rate=dropout_rate, rng=r_att,
-    )
+    if use_flash(impl, dropout_rate, r_att):
+        out = flash_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam)
+    else:
+        out = diff_attention(
+            qs[0], ks[0], qs[1], ks[1], v, lam,
+            mask=mask, dropout_rate=dropout_rate, rng=r_att,
+        )
     out = out.reshape(B, T, -1)  # concat heads (diff_transformer.py:89)
     out = group_layer_norm(out, p["gn"]["w"], p["gn"]["b"])  # :90
     out = out * OUTPUT_SCALE  # constant 0.2, :91
@@ -122,7 +128,7 @@ def forward(
         r_attn, r_ffn = common.split_rng(r, 2)
         x = x + _attn(
             common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
-            li, mask, cfg.dropout, r_attn,
+            li, mask, cfg.dropout, r_attn, cfg.attention_impl,
         )
         x = x + common.apply_ffn(
             common.apply_layer_norm(x, blk["ln2"]), blk["ffn"], cfg.dropout, r_ffn

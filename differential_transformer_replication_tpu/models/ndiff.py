@@ -28,6 +28,7 @@ from differential_transformer_replication_tpu.models import common
 from differential_transformer_replication_tpu.ops import (
     apply_rope,
     causal_mask,
+    flash_ndiff_attention,
     group_layer_norm,
     lambda_init_schedule,
     ndiff_attention,
@@ -35,6 +36,7 @@ from differential_transformer_replication_tpu.ops import (
     ndiff_signs,
     rope_cos_sin,
 )
+from differential_transformer_replication_tpu.ops.flash import use_flash
 from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
 
 
@@ -79,6 +81,7 @@ def _attn(
     mask: jnp.ndarray,
     dropout_rate: float,
     rng: Optional[jax.Array],
+    impl: str = "xla",
 ) -> jnp.ndarray:
     B, T, E = x.shape
     n = p["wq"].shape[0]
@@ -91,10 +94,13 @@ def _attn(
     qs = apply_rope(qs, cos, sin)
     ks = apply_rope(ks, cos, sin)
     lams = ndiff_lambdas(p["lambda_q"], p["lambda_k"], lambda_init_schedule(layer_idx))
-    out = ndiff_attention(
-        qs, ks, v, lams, ndiff_signs(n),
-        mask=mask, dropout_rate=dropout_rate, rng=r_att,
-    )
+    if use_flash(impl, dropout_rate, r_att):
+        out = flash_ndiff_attention(qs, ks, v, lams, ndiff_signs(n))
+    else:
+        out = ndiff_attention(
+            qs, ks, v, lams, ndiff_signs(n),
+            mask=mask, dropout_rate=dropout_rate, rng=r_att,
+        )
     out = out.reshape(B, T, -1)  # concat heads (Ndiff_transformer.py:142)
     out = group_layer_norm(out, p["gn"]["w"], p["gn"]["b"])  # :143
     out = out * OUTPUT_SCALE  # constant 0.2, :144
@@ -120,7 +126,7 @@ def forward(
         r_attn, r_ffn = common.split_rng(r, 2)
         x = x + _attn(
             common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
-            li, cos, sin, mask, cfg.dropout, r_attn,
+            li, cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl,
         )
         x = x + common.apply_ffn(
             common.apply_layer_norm(x, blk["ln2"]), blk["ffn"], cfg.dropout, r_ffn

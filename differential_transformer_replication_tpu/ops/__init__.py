@@ -14,6 +14,12 @@ from differential_transformer_replication_tpu.ops.attention import (
     diff_attention,
     ndiff_attention,
 )
+from differential_transformer_replication_tpu.ops.flash import (
+    multi_stream_flash_attention,
+    flash_vanilla_attention,
+    flash_diff_attention,
+    flash_ndiff_attention,
+)
 
 __all__ = [
     "rope_cos_sin",
@@ -30,4 +36,8 @@ __all__ = [
     "vanilla_attention",
     "diff_attention",
     "ndiff_attention",
+    "multi_stream_flash_attention",
+    "flash_vanilla_attention",
+    "flash_diff_attention",
+    "flash_ndiff_attention",
 ]

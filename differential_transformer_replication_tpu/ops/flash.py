@@ -1,0 +1,544 @@
+"""Fused multi-stream flash attention — the Pallas TPU kernel.
+
+The reference materializes full ``(T, T)`` attention maps per head and per
+softmax stream (diff_transformer.py:57-70, control.py:52-62,
+Ndiff_transformer.py:102-123). On TPU the O(T^2) memory traffic, not the
+FLOPs, is the bottleneck, so this module computes the same math as an
+online-softmax (flash) kernel that never materializes a T x T map.
+
+One kernel serves all three model families, because each one's attention is
+a *linear combination of softmax streams over a shared V*:
+
+    out = sum_s coeff[s, h] * causal_softmax(Q_s K_s^T / sqrt(d)) @ V
+
+  - control (control.py:52-62):            S=1, coeff = [1]
+  - diff    (diff_transformer.py:70):      S=2, coeff = [1, -lambda_h]
+  - ndiff   (Ndiff_transformer.py:119-123): S=n, coeff = sign_s * lambda_{s,h}
+
+The kernel runs S online-softmax accumulators in one pass sharing the V
+tiles (SURVEY.md section 7.7: "exploit linearity"), with the per-stream
+coefficients applied at combine time. Scores, softmax and accumulation are
+float32; tile matmuls feed the MXU in the input dtype.
+
+Backward is a custom VJP with two Pallas kernels (dq; dk/dv) that recompute
+probabilities from the saved per-stream log-sum-exp — the standard flash
+backward, generalized to S streams. The per-stream outputs O_s are saved
+from the forward so that d(coeff) and the flash "delta" rowsum need no
+extra recompute pass.
+
+Restrictions (documented per SURVEY.md section 7.7): attention-probability
+dropout is NOT fused — the reference trains with dropout=0.0 (train.py:64);
+models fall back to the XLA path when dropout is active (rate > 0 AND an
+rng is supplied).
+
+VMEM envelope: each grid step holds the full per-(b,h) K/V (forward, dq)
+or Q/dO (dkv) in VMEM, so per-chip sequence length is bounded by roughly
+S*T*(d+dv)*2 bytes <= ~12 MB — T up to ~8k for the flagship diff shapes
+(verified compiling/running at T=4096 on v5e). Longer contexts are the
+sequence-parallel path's job (parallel/ring attention shards T across the
+mesh before the kernel sees it); a K-grid-tiled kernel variant can lift
+the single-chip bound later if needed.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # finite stand-in for -inf: keeps exp(m - m_new) NaN-free
+
+
+def _auto_interpret() -> bool:
+    """Compiled Mosaic on TPU; interpreter everywhere else (CPU CI)."""
+    return jax.default_backend() != "tpu"
+
+
+def use_flash(impl: str, dropout_rate: float, rng) -> bool:
+    """Single dispatch predicate shared by all three model families.
+
+    The fused path applies when requested AND attention-prob dropout is
+    inert: rate 0 (the reference's training default, train.py:64) or no rng
+    (eval mode — ops/dropout.py is an identity without a key). Prob-dropout
+    itself is not fused; SURVEY.md section 7.7.
+    """
+    return impl == "pallas" and (dropout_rate == 0.0 or rng is None)
+
+
+def _pick_block(desired: int, total: int) -> int:
+    """Largest divisor of ``total`` that is <= desired (block shapes must
+    tile the sequence exactly)."""
+    b = min(desired, total)
+    while total % b:
+        b -= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref,  # (1, S, block_q, d)
+    k_ref,  # (1, S, T, d)
+    v_ref,  # (1, T, dv)
+    c_ref,  # (BH, S) float32 coefficient table, whole array in SMEM
+    out_ref,  # (1, block_q, dv)
+    oall_ref=None,  # (1, S, block_q, dv) per-stream outputs (VJP residual)
+    lse_ref=None,  # (1, S, block_q)      per-stream logsumexp (VJP residual)
+    *,
+    block_k: int,
+    save_residuals: bool,
+):
+    S, block_q, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    T = k_ref.shape[2]
+    dv = v_ref.shape[2]
+    nk = T // block_k
+    i = pl.program_id(1)
+    q_start = i * block_q
+
+    q = q_ref[0].astype(jnp.float32)  # (S, block_q, d)
+    scale = 1.0 / math.sqrt(d)
+    row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+
+        def compute(carry):
+            m, l, acc = carry
+            k_j = k_ref[0, :, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            v_j = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            # (S, block_q, block_k) scores on the MXU, fp32 accumulate
+            s = jax.lax.dot_general(
+                q, k_j,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            col_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where((col_ids <= row_ids)[None, :, :], s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (S, block_q)
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[:, :, None])
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            pv = jax.lax.dot_general(
+                p, v_j,
+                dimension_numbers=(((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (S, block_q, dv)
+            acc_new = acc * alpha[:, :, None] + pv
+            return m_new, l_new, acc_new
+
+        # causal skip: K block j is entirely in the future of this Q block
+        return jax.lax.cond(
+            j * block_k <= q_start + block_q - 1, compute, lambda c: c, carry
+        )
+
+    m0 = jnp.full((S, block_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((S, block_q), jnp.float32)
+    a0 = jnp.zeros((S, block_q, dv), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+
+    o_s = acc / l[:, :, None]  # (S, block_q, dv); diagonal keeps l > 0
+    # combine streams with the per-(b,h) scalar coefficients (SMEM)
+    bh = pl.program_id(0)
+    combined = c_ref[bh, 0] * o_s[0]
+    for s in range(1, S):
+        combined += c_ref[bh, s] * o_s[s]
+    out_ref[0] = combined.astype(out_ref.dtype)
+    if save_residuals:
+        oall_ref[0] = o_s.astype(oall_ref.dtype)
+        lse_ref[0] = (m + jnp.log(l)).astype(lse_ref.dtype)
+
+
+def _fwd_call(
+    q: jnp.ndarray,  # (BH, S, T, d)
+    k: jnp.ndarray,  # (BH, S, T, d)
+    v: jnp.ndarray,  # (BH, T, dv)
+    coeffs: jnp.ndarray,  # (BH, S) float32
+    *,
+    block_q: int,
+    block_k: int,
+    save_residuals: bool,
+    interpret: bool,
+):
+    BH, S, T, d = q.shape
+    dv = v.shape[-1]
+    nq = T // block_q
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, save_residuals=save_residuals
+    )
+    out_shapes = [jax.ShapeDtypeStruct((BH, T, dv), q.dtype)]
+    out_specs = [
+        pl.BlockSpec(
+            (1, block_q, dv), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
+        ),
+    ]
+    if save_residuals:
+        # residual buffers exist only on the VJP path; the inference primal
+        # must not allocate (BH, S, T, dv) of dead HBM
+        out_shapes += [
+            jax.ShapeDtypeStruct((BH, S, T, dv), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, T), jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec(
+                (1, S, block_q, dv), lambda b, i: (b, 0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, S, block_q), lambda b, i: (b, 0, i), memory_space=pltpu.VMEM
+            ),
+        ]
+    results = pl.pallas_call(
+        kernel,
+        grid=(BH, nq),
+        in_specs=[
+            pl.BlockSpec(
+                (1, S, block_q, d), lambda b, i: (b, 0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, S, T, d), lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            # the whole (BH, S) scalar coefficient table rides in SMEM; a
+            # per-bh block would violate Mosaic's (8, 128) tiling check
+            pl.BlockSpec((BH, S), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(q, k, v, coeffs)
+    if save_residuals:
+        return results
+    return results[0], None, None
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref,  # (1, S, block_q, d)
+    k_ref,  # (1, S, T, d)
+    v_ref,  # (1, T, dv)
+    do_ref,  # (1, S, block_q, dv)  per-stream upstream grad (coeff folded in)
+    lse_ref,  # (1, S, block_q)
+    delta_ref,  # (1, S, block_q)     rowsum(dO_s * O_s)
+    dq_ref,  # (1, S, block_q, d)
+    *,
+    block_k: int,
+):
+    S, block_q, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    T = k_ref.shape[2]
+    nk = T // block_k
+    i = pl.program_id(1)
+    q_start = i * block_q
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)  # (S, block_q, dv)
+    lse = lse_ref[0]  # (S, block_q) f32
+    delta = delta_ref[0]  # (S, block_q) f32
+    scale = 1.0 / math.sqrt(d)
+    row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, dq):
+        def compute(dq):
+            k_j = k_ref[0, :, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            v_j = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k_j,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            col_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            masked = (col_ids <= row_ids)[None, :, :]
+            p = jnp.where(masked, jnp.exp(s - lse[:, :, None]), 0.0)
+            dp = jax.lax.dot_general(
+                do, v_j,
+                dimension_numbers=(((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (S, block_q, block_k)
+            ds = p * (dp - delta[:, :, None])
+            return dq + jax.lax.dot_general(
+                ds, k_j,
+                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale
+        return jax.lax.cond(
+            j * block_k <= q_start + block_q - 1, compute, lambda x: x, dq
+        )
+
+    dq0 = jnp.zeros((S, block_q, d), jnp.float32)
+    dq = jax.lax.fori_loop(0, nk, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref,  # (1, S, T, d)
+    k_ref,  # (1, S, block_k, d)
+    v_ref,  # (1, block_k, dv)
+    do_ref,  # (1, S, T, dv)
+    lse_ref,  # (1, S, T)
+    delta_ref,  # (1, S, T)
+    dk_ref,  # (1, S, block_k, d)
+    dv_ref,  # (1, block_k, dv)
+    *,
+    block_q: int,
+):
+    S, block_k, d = k_ref.shape[1], k_ref.shape[2], k_ref.shape[3]
+    T = q_ref.shape[2]
+    dv_width = v_ref.shape[2]
+    nq = T // block_q
+    j = pl.program_id(1)
+    k_start = j * block_k
+
+    k = k_ref[0].astype(jnp.float32)  # (S, block_k, d)
+    scale = 1.0 / math.sqrt(d)
+    col_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+
+        def compute(carry):
+            dk, dv = carry
+            q_i = q_ref[0, :, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+            do_i = do_ref[0, :, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+            lse_i = lse_ref[0, :, pl.ds(i * block_q, block_q)]
+            delta_i = delta_ref[0, :, pl.ds(i * block_q, block_q)]
+            s = jax.lax.dot_general(
+                q_i, k,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale  # (S, block_q, block_k)
+            row_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            masked = (col_ids <= row_ids)[None, :, :]
+            p = jnp.where(masked, jnp.exp(s - lse_i[:, :, None]), 0.0)
+            # dV = sum_s P_s^T dO_s (coeff already folded into dO_s).
+            # Mosaic can't contract two dims at once, so loop streams
+            # statically — S is tiny (1, 2, or n_terms).
+            dv_new = dv
+            for s_idx in range(S):
+                dv_new = dv_new + jax.lax.dot_general(
+                    p[s_idx], do_i[s_idx],
+                    dimension_numbers=(((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            dp = jax.lax.dot_general(
+                do_i, v_ref[0].astype(jnp.float32),
+                dimension_numbers=(((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_i[:, :, None])
+            dk_new = dk + jax.lax.dot_general(
+                ds, q_i,
+                dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            return dk_new, dv_new
+
+        # skip Q blocks entirely before this K block (causal: no grad flows)
+        return jax.lax.cond(i * block_q + block_q - 1 >= k_start, compute,
+                            lambda c: c, carry)
+
+    dk0 = jnp.zeros((S, block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, dv_width), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_call(
+    q, k, v, do_s, lse, delta, *, block_q: int, block_k: int, interpret: bool
+):
+    BH, S, T, d = q.shape
+    dv_width = v.shape[-1]
+    nq, nk = T // block_q, T // block_k
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k),
+        grid=(BH, nq),
+        in_specs=[
+            pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, T, d), lambda b, i: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, dv_width), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q, dv_width), lambda b, i: (b, 0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do_s, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q),
+        grid=(BH, nk),
+        in_specs=[
+            pl.BlockSpec((1, S, T, d), lambda b, j: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_k, d), lambda b, j: (b, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dv_width), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, T, dv_width), lambda b, j: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, S, block_k, d), lambda b, j: (b, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dv_width), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, dv_width), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do_s, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper over (BH, S, T, d) layout
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, coeffs, block_q, block_k, interpret):
+    out, _, _ = _fwd_call(
+        q, k, v, coeffs,
+        block_q=block_q, block_k=block_k,
+        save_residuals=False, interpret=interpret,
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, coeffs, block_q, block_k, interpret):
+    out, o_all, lse = _fwd_call(
+        q, k, v, coeffs,
+        block_q=block_q, block_k=block_k,
+        save_residuals=True, interpret=interpret,
+    )
+    return out, (q, k, v, coeffs, o_all, lse)
+
+
+def _flash_bwd(block_q, block_k, interpret, res, g):
+    q, k, v, coeffs, o_all, lse = res
+    g32 = g.astype(jnp.float32)
+    o32 = o_all.astype(jnp.float32)
+    # d(coeff)[bh, s] = <g, O_s>
+    dcoeffs = jnp.einsum("btd,bstd->bs", g32, o32)
+    # per-stream upstream grad with the combine coefficient folded in
+    do_s = (coeffs[:, :, None, None] * g32[:, None, :, :]).astype(q.dtype)
+    # flash backward rowsum: delta_s = rowsum(dO_s * O_s)
+    delta = jnp.einsum("bstd,bstd->bst", do_s.astype(jnp.float32), o32)
+    dq, dk, dv = _bwd_call(
+        q, k, v, do_s, lse, delta,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return dq, dk, dv, dcoeffs.astype(coeffs.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public API — model-facing layouts (matching ops/attention.py conventions)
+# ---------------------------------------------------------------------------
+
+
+def multi_stream_flash_attention(
+    qs: jnp.ndarray,  # (S, B, T, H, d)
+    ks: jnp.ndarray,  # (S, B, T, H, d)
+    v: jnp.ndarray,  # (B, T, H, dv)
+    coeffs: jnp.ndarray,  # (S, H) float32
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused causal attention: ``sum_s coeffs[s,h] * softmax(Q_s K_s^T /
+    sqrt(d)) @ V`` without materializing any T x T map. Returns
+    (B, T, H, dv)."""
+    if interpret is None:
+        interpret = _auto_interpret()
+    S, B, T, H, d = qs.shape
+    dv = v.shape[-1]
+    bq = _pick_block(block_q, T)
+    bk = _pick_block(block_k, T)
+    # (S, B, T, H, d) -> (B*H, S, T, d)
+    q_r = qs.transpose(1, 3, 0, 2, 4).reshape(B * H, S, T, d)
+    k_r = ks.transpose(1, 3, 0, 2, 4).reshape(B * H, S, T, d)
+    v_r = v.transpose(0, 2, 1, 3).reshape(B * H, T, dv)
+    c_r = jnp.broadcast_to(
+        coeffs.astype(jnp.float32).T[None], (B, H, S)
+    ).reshape(B * H, S)
+    out = _flash(q_r, k_r, v_r, c_r, bq, bk, interpret)  # (BH, T, dv)
+    return out.reshape(B, H, T, dv).transpose(0, 2, 1, 3)
+
+
+def flash_vanilla_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, **kw
+) -> jnp.ndarray:
+    """Fused drop-in for ops.attention.vanilla_attention (causal, no
+    dropout). q/k/v: (B, T, H, d)."""
+    H = q.shape[2]
+    coeffs = jnp.ones((1, H), jnp.float32)
+    return multi_stream_flash_attention(q[None], k[None], v, coeffs, **kw)
+
+
+def flash_diff_attention(
+    q1: jnp.ndarray,
+    k1: jnp.ndarray,
+    q2: jnp.ndarray,
+    k2: jnp.ndarray,
+    v: jnp.ndarray,
+    lam: jnp.ndarray,
+    **kw,
+) -> jnp.ndarray:
+    """Fused drop-in for ops.attention.diff_attention:
+    ``att1 - lam*att2`` (diff_transformer.py:70) as coeffs [1, -lam]."""
+    qs = jnp.stack([q1, q2])
+    ks = jnp.stack([k1, k2])
+    coeffs = jnp.stack([jnp.ones_like(lam), -lam])  # (2, H)
+    return multi_stream_flash_attention(qs, ks, v, coeffs, **kw)
+
+
+def flash_ndiff_attention(
+    qs: jnp.ndarray,
+    ks: jnp.ndarray,
+    v: jnp.ndarray,
+    lams: jnp.ndarray,
+    signs: jnp.ndarray,
+    **kw,
+) -> jnp.ndarray:
+    """Fused drop-in for ops.attention.ndiff_attention: coeffs are
+    ``sign_s * lambda_{s,h}`` (Ndiff_transformer.py:119-123 — the first
+    map is scaled by lambda_0, not 1)."""
+    coeffs = signs[:, None].astype(jnp.float32) * lams.astype(jnp.float32)
+    return multi_stream_flash_attention(qs, ks, v, coeffs, **kw)

@@ -33,6 +33,15 @@ def make_sharded_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict):
     """Returns ``step(state, batch, rng) -> (state, metrics)`` compiled with
     the mesh's shardings. ``state_template`` (abstract or concrete) supplies
     the pytree structure for sharding inference."""
+    if cfg.model.attention_impl == "pallas" and mesh.devices.size > 1:
+        # GSPMD cannot partition a bare pallas_call: on a multi-device mesh
+        # it would all-gather every attention operand (or fail to compile).
+        # The fused kernel joins the sharded path via shard_map in the
+        # sequence-parallel work; until then fail loudly, not slowly.
+        raise NotImplementedError(
+            "attention_impl='pallas' is single-device for now; use 'xla' on "
+            f"multi-device meshes (got {mesh.devices.size} devices)"
+        )
     st_sh = state_sharding(state_template, mesh)
     b_sh = batch_sharding(mesh)
 
