@@ -67,6 +67,7 @@ def _attn(
     dropout_rate: float,
     rng: Optional[jax.Array],
     impl: str = "xla",
+    mesh=None,
 ) -> jnp.ndarray:
     B, T, E = x.shape
     r_att, r_out = common.split_rng(rng, 2)
@@ -75,7 +76,18 @@ def _attn(
     v = jnp.einsum("bte,ehd->bthd", x, p["wv"].astype(x.dtype))
     q = apply_rope(q, cos, sin)  # control.py:47-48
     k = apply_rope(k, cos, sin)
-    if use_flash(impl, dropout_rate, r_att):
+    # lazy import: parallel/__init__ pulls in the training stack, which
+    # imports models — importing at call (trace) time breaks the cycle
+    from differential_transformer_replication_tpu.parallel.ring import (
+        check_ring_dropout,
+        ring_vanilla_attention,
+        use_ring,
+    )
+
+    if use_ring(mesh):
+        check_ring_dropout(dropout_rate, r_att)
+        out = ring_vanilla_attention(q, k, v, mesh)
+    elif use_flash(impl, dropout_rate, r_att):
         out = flash_vanilla_attention(q, k, v)
     else:
         out = vanilla_attention(
@@ -92,6 +104,7 @@ def forward(
     cfg: ModelConfig,
     targets: Optional[jnp.ndarray] = None,
     rng: Optional[jax.Array] = None,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """(B, T) int tokens -> (logits (B, T, V), loss or None)."""
     B, T = idx.shape
@@ -104,7 +117,7 @@ def forward(
         r_attn, r_ffn = common.split_rng(r, 2)
         x = x + _attn(
             common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
-            cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl,
+            cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl, mesh,
         )
         x = x + common.apply_ffn(
             common.apply_layer_norm(x, blk["ln2"]), blk["ffn"], cfg.dropout, r_ffn

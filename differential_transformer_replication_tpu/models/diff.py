@@ -80,6 +80,7 @@ def _attn(
     dropout_rate: float,
     rng: Optional[jax.Array],
     impl: str = "xla",
+    mesh=None,
 ) -> jnp.ndarray:
     B, T, E = x.shape
     r_att, r_out = common.split_rng(rng, 2)
@@ -91,7 +92,18 @@ def _attn(
         p["lambda_q"][1], p["lambda_k"][1],
         lambda_init_schedule(layer_idx),
     )  # (H,) fp32
-    if use_flash(impl, dropout_rate, r_att):
+    # lazy import: parallel/__init__ pulls in the training stack, which
+    # imports models — importing at call (trace) time breaks the cycle
+    from differential_transformer_replication_tpu.parallel.ring import (
+        check_ring_dropout,
+        ring_diff_attention,
+        use_ring,
+    )
+
+    if use_ring(mesh):
+        check_ring_dropout(dropout_rate, r_att)
+        out = ring_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam, mesh)
+    elif use_flash(impl, dropout_rate, r_att):
         out = flash_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam)
     else:
         out = diff_attention(
@@ -111,6 +123,7 @@ def forward(
     cfg: ModelConfig,
     targets: Optional[jnp.ndarray] = None,
     rng: Optional[jax.Array] = None,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """(B, T) int tokens -> (logits (B, T, V), loss or None)."""
     B, T = idx.shape
@@ -128,7 +141,7 @@ def forward(
         r_attn, r_ffn = common.split_rng(r, 2)
         x = x + _attn(
             common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
-            li, mask, cfg.dropout, r_attn, cfg.attention_impl,
+            li, mask, cfg.dropout, r_attn, cfg.attention_impl, mesh,
         )
         x = x + common.apply_ffn(
             common.apply_layer_norm(x, blk["ln2"]), blk["ffn"], cfg.dropout, r_ffn

@@ -82,6 +82,7 @@ def _attn(
     dropout_rate: float,
     rng: Optional[jax.Array],
     impl: str = "xla",
+    mesh=None,
 ) -> jnp.ndarray:
     B, T, E = x.shape
     n = p["wq"].shape[0]
@@ -94,7 +95,18 @@ def _attn(
     qs = apply_rope(qs, cos, sin)
     ks = apply_rope(ks, cos, sin)
     lams = ndiff_lambdas(p["lambda_q"], p["lambda_k"], lambda_init_schedule(layer_idx))
-    if use_flash(impl, dropout_rate, r_att):
+    # lazy import: parallel/__init__ pulls in the training stack, which
+    # imports models — importing at call (trace) time breaks the cycle
+    from differential_transformer_replication_tpu.parallel.ring import (
+        check_ring_dropout,
+        ring_ndiff_attention,
+        use_ring,
+    )
+
+    if use_ring(mesh):
+        check_ring_dropout(dropout_rate, r_att)
+        out = ring_ndiff_attention(qs, ks, v, lams, ndiff_signs(n), mesh)
+    elif use_flash(impl, dropout_rate, r_att):
         out = flash_ndiff_attention(qs, ks, v, lams, ndiff_signs(n))
     else:
         out = ndiff_attention(
@@ -114,6 +126,7 @@ def forward(
     cfg: ModelConfig,
     targets: Optional[jnp.ndarray] = None,
     rng: Optional[jax.Array] = None,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """(B, T) int tokens -> (logits (B, T, V), loss or None)."""
     B, T = idx.shape
@@ -126,7 +139,7 @@ def forward(
         r_attn, r_ffn = common.split_rng(r, 2)
         x = x + _attn(
             common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
-            li, cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl,
+            li, cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl, mesh,
         )
         x = x + common.apply_ffn(
             common.apply_layer_norm(x, blk["ln2"]), blk["ffn"], cfg.dropout, r_ffn

@@ -28,8 +28,14 @@ def model_forward(
     cfg: ModelConfig,
     targets: Optional[jnp.ndarray] = None,
     rng: Optional[jax.Array] = None,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
-    return _MODULES[cfg.model].forward(params, idx, cfg, targets=targets, rng=rng)
+    """``mesh`` (jax.sharding.Mesh, optional): when it carries a >1
+    ``sequence`` axis, attention runs ring-sharded over it
+    (parallel/ring.py); otherwise it is ignored."""
+    return _MODULES[cfg.model].forward(
+        params, idx, cfg, targets=targets, rng=rng, mesh=mesh
+    )
 
 
 def param_count(params: dict) -> int:

@@ -51,7 +51,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30  # finite stand-in for -inf: keeps exp(m - m_new) NaN-free
+from differential_transformer_replication_tpu.ops.streams import (
+    NEG_INF,
+    diff_coeffs,
+    ndiff_coeffs,
+    vanilla_coeffs,
+)
 
 
 def _auto_interpret() -> bool:
@@ -507,9 +512,9 @@ def flash_vanilla_attention(
 ) -> jnp.ndarray:
     """Fused drop-in for ops.attention.vanilla_attention (causal, no
     dropout). q/k/v: (B, T, H, d)."""
-    H = q.shape[2]
-    coeffs = jnp.ones((1, H), jnp.float32)
-    return multi_stream_flash_attention(q[None], k[None], v, coeffs, **kw)
+    return multi_stream_flash_attention(
+        q[None], k[None], v, vanilla_coeffs(q.shape[2]), **kw
+    )
 
 
 def flash_diff_attention(
@@ -525,8 +530,7 @@ def flash_diff_attention(
     ``att1 - lam*att2`` (diff_transformer.py:70) as coeffs [1, -lam]."""
     qs = jnp.stack([q1, q2])
     ks = jnp.stack([k1, k2])
-    coeffs = jnp.stack([jnp.ones_like(lam), -lam])  # (2, H)
-    return multi_stream_flash_attention(qs, ks, v, coeffs, **kw)
+    return multi_stream_flash_attention(qs, ks, v, diff_coeffs(lam), **kw)
 
 
 def flash_ndiff_attention(
@@ -540,5 +544,4 @@ def flash_ndiff_attention(
     """Fused drop-in for ops.attention.ndiff_attention: coeffs are
     ``sign_s * lambda_{s,h}`` (Ndiff_transformer.py:119-123 — the first
     map is scaled by lambda_0, not 1)."""
-    coeffs = signs[:, None].astype(jnp.float32) * lams.astype(jnp.float32)
-    return multi_stream_flash_attention(qs, ks, v, coeffs, **kw)
+    return multi_stream_flash_attention(qs, ks, v, ndiff_coeffs(lams, signs), **kw)

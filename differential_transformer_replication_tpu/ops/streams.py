@@ -1,0 +1,40 @@
+"""The multi-stream attention decomposition shared by every backend.
+
+All three model families compute a linear combination of causal softmax
+streams over one V:
+
+    out = sum_s coeff[s, h] * softmax(Q_s K_s^T / sqrt(d)) @ V
+
+  - control (control.py:52-62):             S=1, coeff [1]
+  - diff    (diff_transformer.py:70):       S=2, coeff [1, -lambda]
+  - ndiff   (Ndiff_transformer.py:119-123): S=n, coeff sign_s * lambda_{s,h}
+    (the first map is scaled by lambda_0, NOT 1 — the documented semantic
+    difference from the 2-term model)
+
+Both fused backends — the Pallas flash kernel (ops/flash.py) and the
+ring sequence-parallel path (parallel/ring.py) — consume these builders so
+the per-family combine semantics live in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# finite stand-in for -inf in masked-softmax accumulators: keeps
+# exp(m - m_new) NaN-free when a row has seen only masked blocks
+NEG_INF = -1e30
+
+
+def vanilla_coeffs(n_head: int) -> jnp.ndarray:
+    """(1, H) of ones: a single plain softmax stream."""
+    return jnp.ones((1, n_head), jnp.float32)
+
+
+def diff_coeffs(lam: jnp.ndarray) -> jnp.ndarray:
+    """(2, H): att1 - lambda * att2 (diff_transformer.py:70)."""
+    return jnp.stack([jnp.ones_like(lam), -lam]).astype(jnp.float32)
+
+
+def ndiff_coeffs(lams: jnp.ndarray, signs: jnp.ndarray) -> jnp.ndarray:
+    """(n, H): sign_s * lambda_{s,h} (Ndiff_transformer.py:119-123)."""
+    return signs[:, None].astype(jnp.float32) * lams.astype(jnp.float32)

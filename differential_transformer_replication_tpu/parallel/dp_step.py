@@ -33,11 +33,17 @@ def make_sharded_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict):
     """Returns ``step(state, batch, rng) -> (state, metrics)`` compiled with
     the mesh's shardings. ``state_template`` (abstract or concrete) supplies
     the pytree structure for sharding inference."""
-    if cfg.model.attention_impl == "pallas" and mesh.devices.size > 1:
+    seq_parallel = mesh.shape.get("sequence", 1) > 1
+    if (
+        cfg.model.attention_impl == "pallas"
+        and mesh.devices.size > 1
+        and not seq_parallel
+    ):
         # GSPMD cannot partition a bare pallas_call: on a multi-device mesh
         # it would all-gather every attention operand (or fail to compile).
-        # The fused kernel joins the sharded path via shard_map in the
-        # sequence-parallel work; until then fail loudly, not slowly.
+        # With a >1 sequence axis attention runs the shard_map ring path
+        # instead, so the flash kernel is never reached; otherwise fail
+        # loudly, not slowly.
         raise NotImplementedError(
             "attention_impl='pallas' is single-device for now; use 'xla' on "
             f"multi-device meshes (got {mesh.devices.size} devices)"
@@ -46,7 +52,7 @@ def make_sharded_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict):
     b_sh = batch_sharding(mesh)
 
     jitted = jax.jit(
-        make_step_fn(cfg),
+        make_step_fn(cfg, mesh=mesh),
         in_shardings=(st_sh, {"x": b_sh, "y": b_sh}, None),
         out_shardings=(st_sh, None),
         donate_argnums=(0,),

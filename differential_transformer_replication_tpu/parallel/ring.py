@@ -1,0 +1,162 @@
+"""Ring attention: sequence/context parallelism over the mesh.
+
+The reference caps sequence length at a dense-masked block_size=512
+(train.py:63) and has no distributed machinery at all (SURVEY.md section
+5.7-5.8). This module is the TPU-native long-context path: the sequence
+dim is sharded over the mesh's ``sequence`` axis, each device keeps its
+local Q shard, and K/V shards rotate around the ring via
+``jax.lax.ppermute`` — P steps of blockwise attention with an
+online-softmax accumulator, so no device ever holds the full sequence or
+any (T, T) map. Collectives ride ICI; compute overlaps the rotation.
+
+Like ops/flash.py, one implementation serves all three model families via
+the multi-stream form: ``out = sum_s coeff[s,h] * softmax_s @ V``.
+
+The op is wrapped in ``shard_map`` whose in_specs compose with the other
+mesh axes: batch stays on ``data``/``fsdp``, heads stay on ``tensor``,
+sequence is the ring axis. Everything outside attention (RoPE tables,
+position embeddings, LayerNorm, FFN, loss) remains under automatic GSPMD
+partitioning — attention is the only op whose sharding XLA cannot infer
+profitably, because causal blockwise structure is a manual schedule.
+
+Autodiff: ``ppermute`` transposes to ``ppermute``, so ``jax.grad``
+through the ring gives the standard ring-attention backward (KV grads
+rotate back around the ring).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from differential_transformer_replication_tpu.ops.streams import (
+    NEG_INF,
+    diff_coeffs,
+    ndiff_coeffs,
+    vanilla_coeffs,
+)
+
+_BATCH_AXES = ("data", "fsdp")
+_SEQ_AXIS = "sequence"
+_HEAD_AXIS = "tensor"
+
+
+def _ring_shard_body(
+    qs: jnp.ndarray,  # (S, Bl, Tl, Hl, d) local shard
+    ks: jnp.ndarray,  # (S, Bl, Tl, Hl, d)
+    v: jnp.ndarray,  # (Bl, Tl, Hl, dv)
+    coeffs: jnp.ndarray,  # (S, Hl) float32
+) -> jnp.ndarray:
+    """Runs on each device inside shard_map. Rotates (ks, v) around the
+    ``sequence`` ring; accumulates S online-softmax streams against the
+    local Q shard."""
+    S, B, Tl, H, d = qs.shape
+    dv = v.shape[-1]
+    p = jax.lax.axis_size(_SEQ_AXIS)
+    my = jax.lax.axis_index(_SEQ_AXIS)
+    scale = 1.0 / math.sqrt(d)
+
+    q32 = qs.astype(jnp.float32)
+    rows = my * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def step(t, carry):
+        m, l, acc, ks_t, v_t = carry
+        # after t rotations this device holds the KV shard of ring position
+        # (my - t) mod p
+        src = jax.lax.rem(my - t + p, p)
+        k32 = ks_t.astype(jnp.float32)
+        s = jnp.einsum("sbthd,sbuhd->sbhtu", q32, k32) * scale
+        cols = src * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 1)
+        s = jnp.where((cols <= rows)[None, None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        pr = jnp.exp(s - m_new[..., None])  # (S, B, H, Tl, Tl)
+        l_new = l * alpha + jnp.sum(pr, axis=-1)
+        pv = jnp.einsum("sbhtu,buhe->sbhte", pr, v_t.astype(jnp.float32))
+        acc_new = acc * alpha[..., None] + pv
+        # rotate KV to the next device; the last step's rotation restores
+        # the original placement (and keeps every step's collective uniform)
+        ks_n = jax.lax.ppermute(ks_t, _SEQ_AXIS, perm)
+        v_n = jax.lax.ppermute(v_t, _SEQ_AXIS, perm)
+        return m_new, l_new, acc_new, ks_n, v_n
+
+    m0 = jnp.full((S, B, H, Tl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((S, B, H, Tl), jnp.float32)
+    a0 = jnp.zeros((S, B, H, Tl, dv), jnp.float32)
+    m, l, acc, _, _ = jax.lax.fori_loop(0, p, step, (m0, l0, a0, ks, v))
+
+    # step 0 visits the local diagonal chunk, so l > 0 everywhere
+    o_s = acc / l[..., None]  # (S, B, H, Tl, dv)
+    out = jnp.einsum("sh,sbhte->bhte", coeffs.astype(jnp.float32), o_s)
+    return out.transpose(0, 2, 1, 3).astype(v.dtype)  # (Bl, Tl, Hl, dv)
+
+
+def ring_multi_stream_attention(
+    qs: jnp.ndarray,  # (S, B, T, H, d) global
+    ks: jnp.ndarray,
+    v: jnp.ndarray,  # (B, T, H, dv) global
+    coeffs: jnp.ndarray,  # (S, H) float32
+    mesh: Mesh,
+) -> jnp.ndarray:
+    """Causal multi-stream attention with the sequence dim ring-sharded
+    over ``mesh``'s ``sequence`` axis. Global shapes in, global out —
+    callable from inside an outer jit; composes with data/fsdp batch
+    sharding and tensor head sharding."""
+    qk_spec = P(None, _BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
+    v_spec = P(_BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
+    c_spec = P(None, _HEAD_AXIS)
+    inner = jax.shard_map(
+        _ring_shard_body,
+        mesh=mesh,
+        in_specs=(qk_spec, qk_spec, v_spec, c_spec),
+        out_specs=v_spec,
+        check_vma=False,
+    )
+    return inner(qs, ks, v, coeffs)
+
+
+def ring_vanilla_attention(q, k, v, mesh: Mesh):
+    """Sequence-parallel form of ops.attention.vanilla_attention."""
+    return ring_multi_stream_attention(
+        q[None], k[None], v, vanilla_coeffs(q.shape[2]), mesh
+    )
+
+
+def ring_diff_attention(q1, k1, q2, k2, v, lam, mesh: Mesh):
+    """Sequence-parallel form of ops.attention.diff_attention:
+    coeffs [1, -lambda] (diff_transformer.py:70)."""
+    qs = jnp.stack([q1, q2])
+    ks = jnp.stack([k1, k2])
+    return ring_multi_stream_attention(qs, ks, v, diff_coeffs(lam), mesh)
+
+
+def ring_ndiff_attention(qs, ks, v, lams, signs, mesh: Mesh):
+    """Sequence-parallel form of ops.attention.ndiff_attention: coeffs
+    sign_s * lambda_{s,h} (Ndiff_transformer.py:119-123)."""
+    return ring_multi_stream_attention(qs, ks, v, ndiff_coeffs(lams, signs), mesh)
+
+
+def use_ring(mesh: Optional[Mesh]) -> bool:
+    """Ring attention applies when a mesh with a >1 sequence axis is
+    threaded into the forward."""
+    return mesh is not None and mesh.shape.get(_SEQ_AXIS, 1) > 1
+
+
+def check_ring_dropout(dropout_rate: float, rng) -> None:
+    """The ring path does not implement attention-prob dropout (like the
+    flash kernel, SURVEY.md section 7.7) — but unlike flash there is no
+    dense fallback that preserves the sequence sharding, so training with
+    active dropout on a sequence-parallel mesh must fail loudly instead
+    of silently dropping the regularizer. Both args are trace-static."""
+    if dropout_rate > 0.0 and rng is not None:
+        raise NotImplementedError(
+            "attention-prob dropout is not supported on the sequence-"
+            "parallel ring path; train with dropout=0.0 (the reference "
+            "default, train.py:64) or a sequence=1 mesh"
+        )

@@ -95,8 +95,10 @@ def state_sharding(state: dict, mesh: Mesh) -> dict:
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """(A, B, T) microbatched batch: shard the batch dim over data (+fsdp,
-    which acts as a second data axis for the forward/backward)."""
-    return NamedSharding(mesh, P(None, ("data", "fsdp"), None))
+    which acts as a second data axis for the forward/backward) and the
+    sequence dim over ``sequence`` (context parallelism — each device
+    holds a T/P slice; attention rings over it, parallel/ring.py)."""
+    return NamedSharding(mesh, P(None, ("data", "fsdp"), "sequence"))
 
 
 def shard_state(state: dict, mesh: Mesh) -> dict:

@@ -41,14 +41,16 @@ def loss_fn(
     y: jnp.ndarray,
     model_cfg: ModelConfig,
     rng: Optional[jax.Array] = None,
+    mesh=None,
 ) -> jnp.ndarray:
-    _, loss = model_forward(params, x, model_cfg, targets=y, rng=rng)
+    _, loss = model_forward(params, x, model_cfg, targets=y, rng=rng, mesh=mesh)
     return loss
 
 
-def make_step_fn(cfg: TrainConfig):
+def make_step_fn(cfg: TrainConfig, mesh=None):
     """The raw (un-jitted) optimizer-step function — reused by the
-    single-device jit below and by the sharded jit in parallel/dp_step.py.
+    single-device jit below and by the sharded jit in parallel/dp_step.py
+    (which passes its Mesh so attention can go sequence-parallel).
 
     ``batch`` is ``{"x": (A, B, T), "y": (A, B, T)}`` with A =
     grad_acc_steps microbatches (A=1 for the reference default,
@@ -64,7 +66,7 @@ def make_step_fn(cfg: TrainConfig):
             grads_acc, loss_acc, i = carry
             x, y = xs
             r = None if rng is None else jax.random.fold_in(rng, i)
-            loss, grads = grad_fn(state["params"], x, y, model_cfg, r)
+            loss, grads = grad_fn(state["params"], x, y, model_cfg, r, mesh)
             grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
             return (grads_acc, loss_acc + loss, i + 1), None
 

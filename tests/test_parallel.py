@@ -164,4 +164,5 @@ class TestShardedTraining:
     def test_batch_sharding_spec(self):
         mesh = create_mesh(MeshConfig(data=4, fsdp=2))
         sh = batch_sharding(mesh)
-        assert sh.spec == P(None, ("data", "fsdp"), None)
+        # batch on data+fsdp, sequence dim on the context-parallel axis
+        assert sh.spec == P(None, ("data", "fsdp"), "sequence")

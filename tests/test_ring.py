@@ -1,0 +1,169 @@
+"""Ring-attention (sequence parallelism) tests on the virtual CPU mesh.
+
+SURVEY.md section 4: distributed tests without a cluster via
+``xla_force_host_platform_device_count`` (set in conftest.py). The ring
+path must match the dense single-device ops bit-for-bit up to fp32
+accumulation order, including gradients through the ppermute rotation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from differential_transformer_replication_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from differential_transformer_replication_tpu.models import init_model, model_forward
+from differential_transformer_replication_tpu.ops import (
+    causal_mask,
+    diff_attention,
+    ndiff_attention,
+    ndiff_signs,
+    vanilla_attention,
+)
+from differential_transformer_replication_tpu.parallel import create_mesh
+from differential_transformer_replication_tpu.parallel.ring import (
+    ring_diff_attention,
+    ring_ndiff_attention,
+    ring_vanilla_attention,
+    use_ring,
+)
+
+B, T, H, D = 2, 64, 2, 16
+
+
+def _seq_mesh(n_seq: int, tensor: int = 1) -> Mesh:
+    return create_mesh(MeshConfig(data=1, fsdp=1, tensor=tensor, sequence=n_seq))
+
+
+def _rand(key, *shape):
+    return jax.random.normal(key, shape, jnp.float32)
+
+
+@pytest.mark.parametrize("n_seq", [2, 4, 8])
+def test_vanilla_ring_parity(n_seq):
+    mesh = _seq_mesh(n_seq)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (_rand(kk, B, T, H, D) for kk in ks)
+    ref = vanilla_attention(q, k, v, mask=causal_mask(T))
+    got = jax.jit(lambda q, k, v: ring_vanilla_attention(q, k, v, mesh))(q, k, v)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_diff_ring_parity():
+    mesh = _seq_mesh(4)
+    ks = jax.random.split(jax.random.PRNGKey(1), 5)
+    q1, k1, q2, k2 = (_rand(kk, B, T, H, D) for kk in ks[:4])
+    v = _rand(ks[4], B, T, H, 2 * D)
+    lam = jnp.array([0.2, 0.47], jnp.float32)
+    ref = diff_attention(q1, k1, q2, k2, v, lam, mask=causal_mask(T))
+    got = jax.jit(
+        lambda *a: ring_diff_attention(*a, lam, mesh)
+    )(q1, k1, q2, k2, v)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_ndiff_ring_parity():
+    mesh = _seq_mesh(4)
+    n = 3
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    qs = _rand(ks[0], n, B, T, H, D)
+    kss = _rand(ks[1], n, B, T, H, D)
+    v = _rand(ks[2], B, T, H, 2 * D)
+    lams = jnp.abs(_rand(jax.random.PRNGKey(3), n, H)) * 0.3 + 0.1
+    signs = ndiff_signs(n)
+    ref = ndiff_attention(qs, kss, v, lams, signs, mask=causal_mask(T))
+    got = jax.jit(lambda qs, kss, v: ring_ndiff_attention(qs, kss, v, lams, signs, mesh))(
+        qs, kss, v
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_ring_grad_parity():
+    """Gradients flow back around the ring (ppermute transpose)."""
+    mesh = _seq_mesh(4)
+    ks = jax.random.split(jax.random.PRNGKey(4), 5)
+    q1, k1, q2, k2 = (_rand(kk, B, T, H, D) for kk in ks[:4])
+    v = _rand(ks[4], B, T, H, 2 * D)
+    lam = jnp.array([0.2, 0.47], jnp.float32)
+
+    def loss_ref(q1, k1, q2, k2, v):
+        out = diff_attention(q1, k1, q2, k2, v, lam, mask=causal_mask(T))
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_ring(q1, k1, q2, k2, v):
+        out = ring_diff_attention(q1, k1, q2, k2, v, lam, mesh)
+        return jnp.sum(out * jnp.cos(out))
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(q1, k1, q2, k2, v)
+    g_got = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2, 3, 4)))(q1, k1, q2, k2, v)
+    for r, g in zip(g_ref, g_got):
+        np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-4)
+
+
+def test_ring_composes_with_tensor_axis():
+    """sequence ring + tensor head sharding in one shard_map."""
+    mesh = _seq_mesh(4, tensor=2)
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (_rand(kk, B, T, 2, D) for kk in ks)  # H=2 divisible by tensor
+    ref = vanilla_attention(q, k, v, mask=causal_mask(T))
+    got = jax.jit(lambda q, k, v: ring_vanilla_attention(q, k, v, mesh))(q, k, v)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("kind", ["control", "diff", "ndiff"])
+def test_model_forward_sequence_parallel(kind):
+    """Full model forward with mesh threading: ring attention inside an
+    otherwise GSPMD-partitioned forward matches the dense forward."""
+    mesh = _seq_mesh(4)
+    cfg = ModelConfig(
+        model=kind, vocab_size=97, n_embd=32, n_head=2, n_layer=2,
+        block_size=32, dropout=0.0, n_terms=2, compute_dtype="float32",
+    )
+    params = init_model(jax.random.PRNGKey(0), cfg)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+    ref, _ = model_forward(params, idx, cfg)
+    got, _ = jax.jit(lambda p, i: model_forward(p, i, cfg, mesh=mesh))(params, idx)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_train_step_with_sequence_axis():
+    """End-to-end sharded train step on a data=2 x sequence=2 x tensor=2
+    mesh: compiles, runs, loss finite, step increments."""
+    from differential_transformer_replication_tpu.parallel import (
+        make_sharded_train_step,
+    )
+    from differential_transformer_replication_tpu.parallel.dp_step import (
+        create_sharded_train_state,
+    )
+
+    mesh_cfg = MeshConfig(data=2, fsdp=1, tensor=2, sequence=2)
+    model = ModelConfig(
+        model="diff", vocab_size=64, n_embd=32, n_head=2, n_layer=2,
+        block_size=32, dropout=0.0, compute_dtype="float32",
+    )
+    cfg = TrainConfig(
+        model=model, mesh=mesh_cfg, vocab_size=64, micro_batch_size=4,
+        grad_acc_steps=2, control_head_multiplier=1,
+    )
+    mesh = create_mesh(mesh_cfg)
+    state = create_sharded_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_sharded_train_step(cfg, mesh, state)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 4, 32), 0, 64)
+    batch = {"x": x, "y": jnp.roll(x, -1, axis=-1)}
+    state2, metrics = step(state, batch)
+    assert jnp.isfinite(float(metrics["loss"]))
+    assert int(state2["step"]) == 1
+    # a second step keeps working (state round-trips through the shardings)
+    state3, metrics2 = step(state2, batch)
+    assert jnp.isfinite(float(metrics2["loss"]))
+
+
+def test_use_ring_predicate():
+    assert not use_ring(None)
+    assert not use_ring(_seq_mesh(1))
+    assert use_ring(_seq_mesh(2))
