@@ -139,6 +139,12 @@ class TrainConfig:
 
     # Data (train.py:82, 155, 41-46)
     dataset: str = "tinystories"  # "tinystories" | "synthetic" | path to a .txt
+    # "epoch": exact epoch-permutation shuffle matching the reference's
+    # DataLoader semantics (train.py:184-191), served by the native O(1)-
+    # memory Feistel bijection (data/native.py). "replacement": uniform
+    # with-replacement draws (statistically equivalent for stride-1
+    # windows, no permutation machinery).
+    sampler: str = "epoch"
     num_train_samples: int = 1_000_000
     vocab_size: int = 12000
     min_frequency: int = 2

@@ -83,14 +83,21 @@ class TokenWindows:
         start = (batch_index * batch_size) % (len(self) - batch_size + 1)
         return self.batch(np.arange(start, start + batch_size))
 
-    def random_batches(
-        self, rng: np.random.Generator, batch_size: int, n_batches: int
-    ) -> dict:
-        """A stacked (n_batches, B, T) batch — the microbatch axis consumed
-        by the train step's lax.scan."""
-        offsets = rng.integers(0, len(self), size=(n_batches, batch_size), dtype=np.int64)
+    def batches(self, offsets: np.ndarray) -> dict:
+        """Gather a stacked (n_batches, B, T) batch from (n_batches, B)
+        offsets — the microbatch axis consumed by the train step's
+        lax.scan."""
+        n_batches, batch_size = offsets.shape
         flat = self.batch(offsets.reshape(-1))
         return {
             k: v.reshape(n_batches, batch_size, self.block_size)
             for k, v in flat.items()
         }
+
+    def random_batches(
+        self, rng: np.random.Generator, batch_size: int, n_batches: int
+    ) -> dict:
+        """With-replacement sampling (the fast default deviation; see
+        module docstring)."""
+        offsets = rng.integers(0, len(self), size=(n_batches, batch_size), dtype=np.int64)
+        return self.batches(offsets)

@@ -119,6 +119,35 @@ def train(cfg: TrainConfig) -> dict:
 
     data_rng = np.random.default_rng(cfg.seed)
     eval_rng = np.random.default_rng(cfg.seed + 1)
+    if cfg.sampler == "epoch":
+        # exact DataLoader-style epoch shuffle (train.py:184-191) via the
+        # native O(1)-memory permutation
+        from differential_transformer_replication_tpu.data.native import (
+            EpochPermutation,
+        )
+
+        perm = EpochPermutation(len(train_ds), cfg.seed)
+        # fast-forward past windows already consumed before a resume, so
+        # the once-per-epoch guarantee survives checkpoint restarts
+        consumed = (
+            int(jax.device_get(state["step"]))
+            * cfg.grad_acc_steps
+            * cfg.micro_batch_size
+        )
+        perm.epoch, perm.cursor = divmod(consumed, len(train_ds))
+
+        def draw_batch():
+            offs = perm.take(cfg.grad_acc_steps * cfg.micro_batch_size)
+            return train_ds.batches(
+                offs.reshape(cfg.grad_acc_steps, cfg.micro_batch_size)
+            )
+    elif cfg.sampler == "replacement":
+        def draw_batch():
+            return train_ds.random_batches(
+                data_rng, cfg.micro_batch_size, cfg.grad_acc_steps
+            )
+    else:
+        raise ValueError(f"unknown sampler {cfg.sampler!r}")
     dropout_key = jax.random.PRNGKey(cfg.seed + 2)
     model_cfg = cfg.resolved_model()
     use_dropout = model_cfg.dropout > 0.0
@@ -132,9 +161,7 @@ def train(cfg: TrainConfig) -> dict:
     iter_num = int(jax.device_get(state["step"]))
     try:
         while iter_num < cfg.max_iters:
-            batch = train_ds.random_batches(
-                data_rng, cfg.micro_batch_size, cfg.grad_acc_steps
-            )
+            batch = draw_batch()
             rng = jax.random.fold_in(dropout_key, iter_num) if use_dropout else None
             state, metrics = train_step(state, batch, rng)
             iter_num += 1

@@ -1,0 +1,112 @@
+// Native data-pipeline primitives for the TPU framework.
+//
+// The reference's input pipeline (train.py:95-107, 184-200) shuffles a
+// torch DataLoader over ~1e8 stride-1 window indices — an O(n)-memory
+// host-side permutation per epoch. The Python sampler approximates that
+// with with-replacement draws (data/sampler.py); this library restores
+// EXACT epoch-permutation semantics at O(1) memory via a format-preserving
+// bijection (4-round Feistel network over the index domain, cycle-walked
+// onto [0, n)), plus a threaded host-side window gather for corpora too
+// large to keep device-resident.
+//
+// Built with g++ into a shared library, loaded through ctypes
+// (data/native.py). No torch, no Python.h — plain C ABI.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// splitmix64 finalizer: the round function's mixer.
+inline uint64_t mix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct Feistel {
+  uint64_t n;
+  uint64_t seed;
+  int half_bits;      // each Feistel half covers half_bits bits
+  uint64_t half_mask; // (1 << half_bits) - 1
+
+  explicit Feistel(uint64_t n_, uint64_t seed_) : n(n_), seed(seed_) {
+    int bits = 1;
+    while ((1ULL << bits) < n_ && bits < 62) ++bits;
+    half_bits = (bits + 1) / 2;
+    half_mask = (1ULL << half_bits) - 1;
+  }
+
+  // Bijection over [0, 2^(2*half_bits)).
+  uint64_t cipher(uint64_t x) const {
+    uint64_t l = x >> half_bits;
+    uint64_t r = x & half_mask;
+    for (int round = 0; round < 4; ++round) {
+      uint64_t f = mix64(r ^ seed ^ (uint64_t)round << 56) & half_mask;
+      uint64_t nl = r;
+      r = l ^ f;
+      l = nl;
+    }
+    return (l << half_bits) | r;
+  }
+
+  // Cycle-walk the power-of-two cipher down to the true domain [0, n):
+  // repeatedly encrypt until the value lands in range. The expected number
+  // of walks is < 4 (domain is at most 4x n), and the walk preserves
+  // bijectivity.
+  uint64_t operator()(uint64_t i) const {
+    uint64_t x = cipher(i);
+    while (x >= n) x = cipher(x);
+    return x;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// out[j] = sigma(start + j) for j in [0, count), where sigma is the seeded
+// permutation of [0, n). Epoch e uses seed ^ mix64(e) at the call site.
+void permute_indices(uint64_t n, uint64_t seed, uint64_t start,
+                     uint64_t count, int64_t* out) {
+  Feistel f(n, mix64(seed));
+  for (uint64_t j = 0; j < count; ++j) {
+    out[j] = (int64_t)f(start + j);
+  }
+}
+
+// Threaded stride-1 window gather (train.py:104-107 semantics): for each
+// offset o, x-row = tokens[o : o+block], y-row = tokens[o+1 : o+block+1].
+// Host-side path for corpora kept in RAM instead of HBM.
+void gather_windows(const int32_t* tokens, uint64_t n_tokens,
+                    const int64_t* offsets, uint64_t batch, uint64_t block,
+                    int32_t* x, int32_t* y) {
+  (void)n_tokens;  // bounds are the caller's contract (checked in Python)
+  auto work = [&](uint64_t lo, uint64_t hi) {
+    for (uint64_t b = lo; b < hi; ++b) {
+      const int32_t* src = tokens + offsets[b];
+      std::memcpy(x + b * block, src, block * sizeof(int32_t));
+      std::memcpy(y + b * block, src + 1, block * sizeof(int32_t));
+    }
+  };
+  unsigned hw = std::thread::hardware_concurrency();
+  uint64_t n_threads = hw ? (hw < batch ? hw : batch) : 1;
+  if (n_threads <= 1 || batch < 64) {
+    work(0, batch);
+    return;
+  }
+  std::vector<std::thread> pool;
+  uint64_t chunk = (batch + n_threads - 1) / n_threads;
+  for (uint64_t t = 0; t < n_threads; ++t) {
+    uint64_t lo = t * chunk;
+    uint64_t hi = lo + chunk < batch ? lo + chunk : batch;
+    if (lo >= hi) break;
+    pool.emplace_back(work, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"
