@@ -87,7 +87,7 @@ def test_diff_grad_parity():
         return jnp.sum(out * jnp.cos(out))  # non-trivial cotangent
 
     def loss_flash(q1, k1, q2, k2, v, lam):
-        out = flash_diff_attention(q1, k1, q2, k2, v, lam, block_q=32, block_k=32)
+        out = flash_diff_attention(q1, k1, q2, k2, v, lam, block_q=32, block_k=32, block_q_train=32, block_k_train=16)
         return jnp.sum(out * jnp.cos(out))
 
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4, 5))(q1, k1, q2, k2, v, lam)
@@ -104,7 +104,7 @@ def test_vanilla_grad_parity():
         return jnp.sum(vanilla_attention(q, k, v, mask=causal_mask(32)) ** 2)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_vanilla_attention(q, k, v, block_q=16, block_k=16) ** 2)
+        return jnp.sum(flash_vanilla_attention(q, k, v, block_q=16, block_k=16, block_q_train=16, block_k_train=16) ** 2)
 
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     g_got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
@@ -126,7 +126,7 @@ def test_ndiff_grad_parity():
 
     def loss_flash(qs, kss, v, lams):
         return jnp.sum(
-            flash_ndiff_attention(qs, kss, v, lams, signs, block_q=16, block_k=16) ** 2
+            flash_ndiff_attention(qs, kss, v, lams, signs, block_q=16, block_k=16, block_q_train=16, block_k_train=16) ** 2
         )
 
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(qs, kss, v, lams)
