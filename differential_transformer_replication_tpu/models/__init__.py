@@ -4,5 +4,18 @@ from differential_transformer_replication_tpu.models.registry import (
     param_count,
 )
 from differential_transformer_replication_tpu.models.generate import generate
+from differential_transformer_replication_tpu.models.decode import (
+    forward_chunk,
+    generate_cached,
+    init_cache,
+)
 
-__all__ = ["init_model", "model_forward", "param_count", "generate"]
+__all__ = [
+    "init_model",
+    "model_forward",
+    "param_count",
+    "generate",
+    "generate_cached",
+    "forward_chunk",
+    "init_cache",
+]

@@ -1,0 +1,245 @@
+"""KV-cache incremental decoding.
+
+The reference's ``generate`` recomputes the full O(T^2) forward for every
+new token (control.py:163-171, diff_transformer.py:177-185,
+Ndiff_transformer.py:232-241 — "no KV cache", SURVEY.md section 3.4).
+``models/generate.py`` reproduces that behavior; this module is the
+idiomatic-TPU upgrade: per-layer K/V caches make each new token O(T).
+
+One chunked code path serves both phases — ``forward_chunk`` processes L
+tokens starting at position ``pos`` against the cache, so prefill is a
+single chunk at pos=0 and decoding is a chunk of length 1. All three
+model families run through the shared multi-stream form (ops/streams.py):
+per-stream K caches, per-stream softmax over the cached keys, coefficient
+combine, then the family's post-attention stack (plain concat for
+control; GroupLayerNorm + the constant 0.2 scale for diff/ndiff,
+diff_transformer.py:90-91).
+
+Family differences preserved (same citations as models/{control,diff,
+ndiff}.py): control/ndiff rotate q/k with RoPE at absolute positions and
+have no position table; diff adds its learned absolute position embedding
+at the input instead. Generation is eval-mode: no dropout anywhere.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from differential_transformer_replication_tpu.config import ModelConfig
+from differential_transformer_replication_tpu.models import common
+from differential_transformer_replication_tpu.ops import (
+    apply_rope,
+    diff_lambda,
+    group_layer_norm,
+    lambda_init_schedule,
+    ndiff_lambdas,
+    ndiff_signs,
+    rope_cos_sin,
+)
+from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
+from differential_transformer_replication_tpu.ops.streams import (
+    NEG_INF,
+    diff_coeffs,
+    ndiff_coeffs,
+    vanilla_coeffs,
+)
+
+
+def _n_streams(cfg: ModelConfig) -> int:
+    return {"control": 1, "diff": 2, "ndiff": cfg.n_terms}[cfg.model]
+
+
+def _uses_rope(cfg: ModelConfig) -> bool:
+    return cfg.model in ("control", "ndiff")
+
+
+def init_cache(cfg: ModelConfig, batch_size: int) -> list:
+    """Per-layer K/V buffers sized to ``block_size``: K is per-stream
+    (S, B, M, H, d); V is shared across streams (B, M, H, dv)."""
+    S = _n_streams(cfg)
+    H, d, dv, M = cfg.n_head, cfg.head_size, cfg.value_size, cfg.block_size
+    dt = jnp.dtype(cfg.compute_dtype)
+    return [
+        {
+            "k": jnp.zeros((S, batch_size, M, H, d), dt),
+            "v": jnp.zeros((batch_size, M, H, dv), dt),
+        }
+        for _ in range(cfg.n_layer)
+    ]
+
+
+def _stacked_wq(p_attn: dict) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Normalize the per-family weight layouts to stacked (S, E, H, d)."""
+    wq, wk = p_attn["wq"], p_attn["wk"]
+    if wq.ndim == 3:  # control: (E, H, d)
+        wq, wk = wq[None], wk[None]
+    return wq, wk
+
+
+def _layer_coeffs(cfg: ModelConfig, p_attn: dict, layer_idx: int) -> jnp.ndarray:
+    """(S, H) combine coefficients for this layer (1-based layer_idx for
+    the dynamic lambda_init schedule, diff_transformer.py:43,161)."""
+    if cfg.model == "control":
+        return vanilla_coeffs(cfg.n_head)
+    if cfg.model == "diff":
+        lam = diff_lambda(
+            p_attn["lambda_q"][0], p_attn["lambda_k"][0],
+            p_attn["lambda_q"][1], p_attn["lambda_k"][1],
+            lambda_init_schedule(layer_idx),
+        )
+        return diff_coeffs(lam)
+    lams = ndiff_lambdas(
+        p_attn["lambda_q"], p_attn["lambda_k"], lambda_init_schedule(layer_idx)
+    )
+    return ndiff_coeffs(lams, ndiff_signs(cfg.n_terms))
+
+
+def _attn_chunk(
+    x: jnp.ndarray,  # (B, L, E) normed input chunk
+    p_attn: dict,
+    layer_cache: dict,
+    pos,  # scalar int: absolute position of the chunk start
+    layer_idx: int,
+    cfg: ModelConfig,
+    cos: jnp.ndarray,  # (L, d/2) tables pre-sliced at [pos, pos+L)
+    sin: jnp.ndarray,
+) -> Tuple[jnp.ndarray, dict]:
+    B, L, E = x.shape
+    M = cfg.block_size
+    wq, wk = _stacked_wq(p_attn)
+    qs = jnp.einsum("ble,sehd->sblhd", x, wq.astype(x.dtype))
+    ks = jnp.einsum("ble,sehd->sblhd", x, wk.astype(x.dtype))
+    v = jnp.einsum("ble,ehd->blhd", x, p_attn["wv"].astype(x.dtype))
+    if _uses_rope(cfg):
+        qs = apply_rope(qs, cos, sin)
+        ks = apply_rope(ks, cos, sin)
+
+    k_cache = jax.lax.dynamic_update_slice(
+        layer_cache["k"], ks, (0, 0, pos, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(layer_cache["v"], v, (0, pos, 0, 0))
+
+    scale = 1.0 / (cfg.head_size ** 0.5)
+    scores = (
+        jnp.einsum("sblhd,sbmhd->sbhlm", qs, k_cache).astype(jnp.float32) * scale
+    )
+    # causal over absolute positions: chunk row l sits at pos+l and may see
+    # cached columns m <= pos+l (later cache slots are zeros — masked off)
+    rows = pos + jnp.arange(L)[:, None]
+    cols = jnp.arange(M)[None, :]
+    scores = jnp.where((cols <= rows)[None, None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)  # per-stream, fp32
+
+    coeffs = _layer_coeffs(cfg, p_attn, layer_idx)  # (S, H)
+    combined = jnp.einsum("sh,sbhlm->bhlm", coeffs, probs)
+    out = jnp.einsum("bhlm,bmhe->blhe", combined.astype(v.dtype), v_cache)
+    out = out.reshape(B, L, -1)  # concat heads
+    if cfg.model in ("diff", "ndiff"):
+        out = group_layer_norm(out, p_attn["gn"]["w"], p_attn["gn"]["b"])
+        out = out * OUTPUT_SCALE  # constant 0.2 (diff_transformer.py:91)
+    out = common.linear(out, p_attn["out"])
+    return out, {"k": k_cache, "v": v_cache}
+
+
+def forward_chunk(
+    params: dict,
+    tokens: jnp.ndarray,  # (B, L) at absolute positions [pos, pos+L)
+    pos,
+    cache: list,
+    cfg: ModelConfig,
+) -> Tuple[jnp.ndarray, list]:
+    """Process a chunk against the cache. Returns ((B, L, V) logits,
+    updated cache). Prefill = one big chunk at pos=0; decode = L=1.
+
+    ``pos + L`` must not exceed ``block_size`` — past it,
+    dynamic_update_slice would silently clamp the cache write and corrupt
+    the last slot, so concrete positions fail loudly here (the repo's
+    fail-loudly convention, models/diff.py forward). Traced positions
+    cannot be checked at trace time; jitted callers must guard like
+    generate_cached does."""
+    B, L = tokens.shape
+    if isinstance(pos, (int,)) and pos + L > cfg.block_size:
+        raise ValueError(
+            f"chunk [{pos}, {pos + L}) exceeds block_size {cfg.block_size}: "
+            "the cache write would clamp and corrupt the last slot"
+        )
+    compute = jnp.dtype(cfg.compute_dtype)
+    x = params["tok_emb"][tokens].astype(compute)
+    if cfg.model == "diff":  # learned absolute positions (diff_transformer.py:158)
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["pos_emb"], pos, L, axis=0
+        ).astype(compute)
+        cos = sin = None
+    else:
+        cos_full, sin_full = rope_cos_sin(cfg.head_size, cfg.block_size)
+        cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, L, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, L, axis=0)
+
+    new_cache = []
+    for li, blk in enumerate(params["blocks"], 1):  # 1-based (diff_transformer.py:161)
+        a, layer_cache = _attn_chunk(
+            common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
+            cache[li - 1], pos, li, cfg, cos, sin,
+        )
+        x = x + a
+        x = x + common.apply_ffn(common.apply_layer_norm(x, blk["ln2"]), blk["ffn"])
+        new_cache.append(layer_cache)
+    x = common.apply_layer_norm(x, params["ln_f"])
+    logits = common.linear(x, params["lm_head"])
+    return logits, new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def generate_cached(
+    params: dict,
+    idx: jnp.ndarray,
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    rng: jax.Array,
+) -> jnp.ndarray:
+    """KV-cached counterpart of models/generate.py: same sampling contract
+    (temperature-1 categorical over the last position, prompt included in
+    the return), O(T) per new token instead of O(T^2).
+
+    Requires ``T0 + max_new_tokens <= block_size`` (no sliding-window
+    support — use models/generate.py past the context limit, which
+    reproduces the reference's crop behavior)."""
+    B, T0 = idx.shape
+    if T0 + max_new_tokens > cfg.block_size:
+        raise ValueError(
+            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"block_size ({cfg.block_size}); use models.generate for the "
+            "sliding-window behavior"
+        )
+    cache = init_cache(cfg, B)
+    logits, cache = forward_chunk(params, idx, 0, cache, cfg)
+    samples = jnp.zeros((B, max_new_tokens), idx.dtype)
+
+    rng, key0 = jax.random.split(rng)
+    first = jax.random.categorical(
+        key0, logits[:, -1, :].astype(jnp.float32), axis=-1
+    ).astype(idx.dtype)
+    samples = samples.at[:, 0].set(first)
+
+    def body(i, carry):
+        cache, samples, rng = carry
+        rng, key = jax.random.split(rng)
+        prev = samples[:, i - 1]
+        logits, cache = forward_chunk(
+            params, prev[:, None], T0 + i - 1, cache, cfg
+        )
+        nxt = jax.random.categorical(
+            key, logits[:, -1, :].astype(jnp.float32), axis=-1
+        ).astype(samples.dtype)
+        samples = samples.at[:, i].set(nxt)
+        return cache, samples, rng
+
+    if max_new_tokens > 1:
+        _, samples, _ = jax.lax.fori_loop(
+            1, max_new_tokens, body, (cache, samples, rng)
+        )
+    return jnp.concatenate([idx, samples], axis=1)

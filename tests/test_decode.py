@@ -1,0 +1,112 @@
+"""KV-cache decode parity: the chunked cache path must reproduce the full
+forward's logits exactly (same math, different schedule) for all three
+model families, in prefill and in token-by-token decode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.config import ModelConfig
+from differential_transformer_replication_tpu.models import (
+    generate,
+    init_model,
+    model_forward,
+)
+from differential_transformer_replication_tpu.models.decode import (
+    forward_chunk,
+    generate_cached,
+    init_cache,
+)
+
+
+def _cfg(kind):
+    return ModelConfig(
+        model=kind, vocab_size=97, n_embd=32, n_head=2, n_layer=2,
+        block_size=32, dropout=0.0, n_terms=3, compute_dtype="float32",
+    )
+
+
+@pytest.mark.parametrize("kind", ["control", "diff", "ndiff"])
+def test_prefill_matches_full_forward(kind):
+    cfg = _cfg(kind)
+    params = init_model(jax.random.PRNGKey(0), cfg)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    ref, _ = model_forward(params, idx, cfg)
+    cache = init_cache(cfg, 2)
+    got, _ = forward_chunk(params, idx, 0, cache, cfg)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("kind", ["control", "diff", "ndiff"])
+def test_incremental_decode_matches_full_forward(kind):
+    """Teacher-forced: prefill 8 tokens, then feed 6 more one at a time;
+    at every step the cached logits must equal a from-scratch forward
+    over the growing prefix."""
+    cfg = _cfg(kind)
+    params = init_model(jax.random.PRNGKey(0), cfg)
+    seq = jax.random.randint(jax.random.PRNGKey(2), (2, 14), 0, cfg.vocab_size)
+    cache = init_cache(cfg, 2)
+    logits, cache = forward_chunk(params, seq[:, :8], 0, cache, cfg)
+    ref_full, _ = model_forward(params, seq[:, :8], cfg)
+    np.testing.assert_allclose(logits[:, -1], ref_full[:, -1], rtol=1e-4, atol=1e-4)
+    for t in range(8, 14):
+        logits, cache = forward_chunk(params, seq[:, t : t + 1], t, cache, cfg)
+        ref_full, _ = model_forward(params, seq[:, : t + 1], cfg)
+        np.testing.assert_allclose(
+            logits[:, -1], ref_full[:, -1], rtol=1e-4, atol=1e-4,
+            err_msg=f"divergence at position {t}",
+        )
+
+
+@pytest.mark.parametrize("kind", ["control", "diff", "ndiff"])
+def test_generate_cached_contract(kind):
+    cfg = _cfg(kind)
+    params = init_model(jax.random.PRNGKey(0), cfg)
+    idx = jax.random.randint(jax.random.PRNGKey(3), (2, 5), 0, cfg.vocab_size)
+    out = generate_cached(params, idx, cfg, 10, jax.random.PRNGKey(4))
+    assert out.shape == (2, 15)
+    np.testing.assert_array_equal(out[:, :5], idx)  # prompt preserved
+    assert int(out.max()) < cfg.vocab_size and int(out.min()) >= 0
+
+
+def test_generate_cached_rejects_overflow():
+    cfg = _cfg("diff")
+    params = init_model(jax.random.PRNGKey(0), cfg)
+    idx = jnp.zeros((1, 30), jnp.int32)
+    with pytest.raises(ValueError):
+        generate_cached(params, idx, cfg, 10, jax.random.PRNGKey(0))
+
+
+def test_generate_and_cached_agree_on_argmax_path():
+    """With near-deterministic logits the two generators walk the same
+    sequence: compare greedy continuations computed from each path's
+    logits rather than sampled tokens (sampling consumes rng differently).
+    Here: decode 5 steps teacher-forced on generate()'s output and check
+    the cached path assigns the same argmax at every position."""
+    cfg = _cfg("control")
+    params = init_model(jax.random.PRNGKey(0), cfg)
+    idx = jax.random.randint(jax.random.PRNGKey(5), (1, 4), 0, cfg.vocab_size)
+    full = generate(params, idx, cfg, 5, jax.random.PRNGKey(6))  # (1, 9)
+    cache = init_cache(cfg, 1)
+    logits_c, cache = forward_chunk(params, full[:, :4], 0, cache, cfg)
+    for t in range(4, 9):
+        ref_logits, _ = model_forward(params, full[:, : t], cfg)
+        np.testing.assert_array_equal(
+            jnp.argmax(logits_c[:, -1], -1), jnp.argmax(ref_logits[:, -1], -1)
+        )
+        if t < 8:
+            logits_c, cache = forward_chunk(params, full[:, t : t + 1], t, cache, cfg)
+
+
+def test_forward_chunk_rejects_cache_overflow():
+    """Concrete positions past block_size fail loudly instead of letting
+    dynamic_update_slice clamp and corrupt the last cache slot."""
+    cfg = _cfg("control")
+    params = init_model(jax.random.PRNGKey(0), cfg)
+    cache = init_cache(cfg, 1)
+    tok = jnp.zeros((1, 1), jnp.int32)
+    with pytest.raises(ValueError):
+        forward_chunk(params, tok, cfg.block_size, cache, cfg)
+    with pytest.raises(ValueError):
+        forward_chunk(params, jnp.zeros((1, 8), jnp.int32), 28, cache, cfg)
