@@ -151,6 +151,10 @@ class TrainConfig:
     val_fraction: float = 0.1  # train.py:178 (90/10 split)
     tokenizer_dir: str = "tokenizer"
 
+    # Profiling: capture a jax.profiler trace of a few steady-state steps
+    # into this directory (TensorBoard/Perfetto viewable); None = off.
+    profile_dir: Optional[str] = None
+
     # Logging (train.py:90-93)
     log_interval: int = 10
     wandb_project: str = "diff-transformer"

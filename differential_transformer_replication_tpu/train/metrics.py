@@ -54,17 +54,26 @@ class MetricLogger:
             except Exception as e:
                 print(f"[metrics] wandb unavailable ({type(e).__name__}); continuing without")
 
-    def log_step(self, iter_num: int, loss: float, lr: float) -> None:
-        """Per-log_interval metrics (train.py:286-294)."""
+    def log_step(
+        self,
+        iter_num: int,
+        loss: float,
+        lr: float,
+        tokens_per_sec: Optional[float] = None,
+    ) -> None:
+        """Per-log_interval metrics (train.py:286-294), plus the natively
+        measured tokens/sec the reference never recorded (SURVEY.md
+        section 5.1; BASELINE.json north-star metric)."""
         print(f"iter {iter_num}: loss {loss:.4f}, lr {lr:.2e}")  # train.py:288
-        self._emit(
-            {
-                "iter": iter_num,
-                "loss": loss,
-                "learning_rate": lr,
-                "gpu_memory": device_memory_mb(),
-            }
-        )
+        payload = {
+            "iter": iter_num,
+            "loss": loss,
+            "learning_rate": lr,
+            "gpu_memory": device_memory_mb(),
+        }
+        if tokens_per_sec is not None:
+            payload["tokens_per_sec"] = round(tokens_per_sec, 1)
+        self._emit(payload)
 
     def log_eval(self, iter_num: int, train_loss: float, val_loss: float) -> None:
         """Per-eval_interval metrics (train.py:297-304)."""

@@ -27,6 +27,7 @@ from differential_transformer_replication_tpu.train.checkpoint import (
     save_checkpoint,
 )
 from differential_transformer_replication_tpu.train.metrics import MetricLogger
+from differential_transformer_replication_tpu.utils import ProfilerWindow, Throughput
 from differential_transformer_replication_tpu.train.step import (
     create_train_state,
     make_eval_step,
@@ -58,16 +59,79 @@ def estimate_loss(
     return out
 
 
+def _cache_key(cfg: TrainConfig, source: str) -> str:
+    """Key for the (token stream, tokenizer) cache pair: everything that
+    determines them, over the corpus source ACTUALLY used (the
+    tinystories->synthetic fallback must not poison the tinystories key).
+    File-path datasets additionally key on mtime+size so edits invalidate.
+    """
+    import hashlib
+    import os
+
+    key_parts = [
+        source, str(cfg.num_train_samples), str(cfg.vocab_size),
+        str(cfg.min_frequency), str(cfg.seed), "v1",
+    ]
+    if os.path.exists(source):
+        st = os.stat(source)
+        key_parts += [str(st.st_mtime_ns), str(st.st_size)]
+    return hashlib.sha1("|".join(key_parts).encode()).hexdigest()[:16]
+
+
 def build_data(cfg: TrainConfig):
     """Corpus -> tokenizer -> token stream -> train/val window datasets
-    (train.py:153-200)."""
-    texts = load_corpus(cfg.dataset, cfg.num_train_samples, cfg.seed)
-    tokenizer = train_bpe_tokenizer(
-        texts, cfg.vocab_size, cfg.min_frequency, cfg.tokenizer_dir
+    (train.py:153-200).
+
+    The encoded stream and its tokenizer are cached TOGETHER under a
+    per-key directory (``tokenizer_dir/cache-<key>/``): corpus generation
+    + BPE training + encoding cost minutes at the reference's 1M-document
+    scale and are fully determined by the key. Pairing them in one
+    directory means a cache hit can never load a mismatched tokenizer
+    left in the shared dir by a different config. The freshly trained
+    tokenizer is also saved to ``tokenizer_dir`` itself, matching the
+    reference's artifact layout (train.py:49-50)."""
+    import os
+
+    from differential_transformer_replication_tpu.data.corpus import (
+        load_corpus_resolved,
     )
-    vocab_size = tokenizer.get_vocab_size()
-    print(f"Vocabulary size: {vocab_size}")  # train.py:161
-    tokens = encode_corpus(tokenizer, texts)
+    from differential_transformer_replication_tpu.data.tokenizer import (
+        load_tokenizer,
+    )
+
+    # probe which source the dataset name resolves to (the tinystories->
+    # synthetic fallback depends on network/cache state) with a 1-document
+    # load — cheap either way — so warm runs never build the full corpus
+    _, source = load_corpus_resolved(cfg.dataset, 1, cfg.seed)
+    texts = None
+
+    cache_dir = os.path.join(cfg.tokenizer_dir, f"cache-{_cache_key(cfg, source)}")
+    tokens_path = os.path.join(cache_dir, "tokens.npy")
+    if os.path.exists(tokens_path):
+        tokenizer = load_tokenizer(cache_dir)
+        tokens = np.load(tokens_path)
+        print(f"Loaded {len(tokens)} cached tokens from {tokens_path}")
+        vocab_size = tokenizer.get_vocab_size()
+        print(f"Vocabulary size: {vocab_size}")  # train.py:161
+    else:
+        if texts is None:
+            texts, source = load_corpus_resolved(
+                cfg.dataset, cfg.num_train_samples, cfg.seed
+            )
+        tokenizer = train_bpe_tokenizer(
+            texts, cfg.vocab_size, cfg.min_frequency, cfg.tokenizer_dir
+        )
+        vocab_size = tokenizer.get_vocab_size()
+        print(f"Vocabulary size: {vocab_size}")  # train.py:161
+        tokens = encode_corpus(tokenizer, texts)
+        os.makedirs(cache_dir, exist_ok=True)
+        tokenizer.save_model(cache_dir)
+        # write-then-rename: an interrupted save must not leave a
+        # truncated tokens.npy that matches the key forever after
+        tmp = os.path.join(cache_dir, f".tokens.{os.getpid()}.npy.tmp")
+        with open(tmp, "wb") as f:
+            np.save(f, tokens)
+        os.replace(tmp, tokens_path)
     print(f"Total tokens: {len(tokens)}")  # train.py:174
     train_tokens, val_tokens = split_tokens(tokens, cfg.val_fraction)
     block = cfg.model.block_size
@@ -155,6 +219,12 @@ def train(cfg: TrainConfig) -> dict:
     print("Starting training...")
     t0 = time.time()
     tokens_seen = 0
+    throughput = Throughput()
+    # profile a short steady-state window past compile + warmup, relative
+    # to wherever this run starts (fresh or resumed)
+    profiler = ProfilerWindow(
+        cfg.profile_dir, start=int(jax.device_get(state["step"])) + 10
+    )
     # Host-side iteration counter: the device `state["step"]` advances by
     # exactly 1 per call, and reading it back would force a host-device
     # sync every iteration, breaking async dispatch pipelining.
@@ -165,11 +235,15 @@ def train(cfg: TrainConfig) -> dict:
             rng = jax.random.fold_in(dropout_key, iter_num) if use_dropout else None
             state, metrics = train_step(state, batch, rng)
             iter_num += 1
+            profiler.step(iter_num, sync=metrics["loss"])
             tokens_seen += cfg.micro_batch_size * cfg.grad_acc_steps * model_cfg.block_size
 
             if iter_num % cfg.log_interval == 0:
                 logger.log_step(
-                    iter_num, float(metrics["loss"]), float(metrics["learning_rate"])
+                    iter_num,
+                    float(metrics["loss"]),
+                    float(metrics["learning_rate"]),
+                    tokens_per_sec=throughput.update(tokens_seen),
                 )
 
             if iter_num % cfg.eval_interval == 0:
@@ -187,5 +261,6 @@ def train(cfg: TrainConfig) -> dict:
             print(f"Training done: {tokens_seen} tokens in {dt:.1f}s "
                   f"({tokens_seen / dt:.0f} tokens/sec)")
     finally:
+        profiler.close()
         logger.finish()
     return state

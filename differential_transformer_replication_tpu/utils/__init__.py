@@ -1,0 +1,7 @@
+from differential_transformer_replication_tpu.utils.profiling import (
+    ProfilerWindow,
+    Throughput,
+    trace,
+)
+
+__all__ = ["ProfilerWindow", "Throughput", "trace"]

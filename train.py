@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume-from", default=None)
     p.add_argument("--metrics-path", default=t.metrics_path)
     p.add_argument("--wandb", action="store_true", help="enable the wandb sink")
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler trace of steps 10-15 into this dir",
+    )
     p.add_argument("--data-parallel", type=int, default=1,
                    help="devices on the data mesh axis")
     p.add_argument("--tensor-parallel", type=int, default=1,
@@ -95,6 +99,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         resume_from=args.resume_from,
         metrics_path=args.metrics_path,
         use_wandb=args.wandb,
+        profile_dir=args.profile_dir,
     )
 
 
