@@ -39,6 +39,11 @@ class MetricLogger:
         self.cfg = cfg
         self._jsonl = None
         self._wandb = None
+        # multi-host: only process 0 writes logs/files (every process
+        # would otherwise duplicate records and race on the jsonl)
+        self._primary = jax.process_index() == 0
+        if not self._primary:
+            return
         if cfg.metrics_path:
             self._jsonl = open(cfg.metrics_path, "a", buffering=1)
         if cfg.use_wandb:
@@ -64,6 +69,8 @@ class MetricLogger:
         """Per-log_interval metrics (train.py:286-294), plus the natively
         measured tokens/sec the reference never recorded (SURVEY.md
         section 5.1; BASELINE.json north-star metric)."""
+        if not self._primary:
+            return
         print(f"iter {iter_num}: loss {loss:.4f}, lr {lr:.2e}")  # train.py:288
         payload = {
             "iter": iter_num,
@@ -77,6 +84,8 @@ class MetricLogger:
 
     def log_eval(self, iter_num: int, train_loss: float, val_loss: float) -> None:
         """Per-eval_interval metrics (train.py:297-304)."""
+        if not self._primary:
+            return
         print(
             f"step {iter_num}: train loss {train_loss:.4f}, val loss {val_loss:.4f}"
         )  # train.py:299

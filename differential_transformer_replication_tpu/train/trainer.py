@@ -18,7 +18,6 @@ from differential_transformer_replication_tpu.config import TrainConfig
 from differential_transformer_replication_tpu.data import (
     TokenWindows,
     encode_corpus,
-    load_corpus,
     split_tokens,
     train_bpe_tokenizer,
 )
@@ -99,14 +98,21 @@ def build_data(cfg: TrainConfig):
         load_tokenizer,
     )
 
-    # probe which source the dataset name resolves to (the tinystories->
-    # synthetic fallback depends on network/cache state) with a 1-document
-    # load — cheap either way — so warm runs never build the full corpus
-    _, source = load_corpus_resolved(cfg.dataset, 1, cfg.seed)
-    texts = None
+    # Resolve which corpus source the dataset name maps to. Only
+    # "tinystories" is ambiguous (its network fallback depends on
+    # HF-cache/egress state, corpus.py) — probe it with a 1-document load
+    # (HF caches the dataset, so a later full load reuses the download).
+    # "synthetic" and file paths resolve to themselves with no I/O.
+    if cfg.dataset == "tinystories":
+        _, source = load_corpus_resolved(cfg.dataset, 1, cfg.seed)
+    else:
+        source = cfg.dataset
 
-    cache_dir = os.path.join(cfg.tokenizer_dir, f"cache-{_cache_key(cfg, source)}")
-    tokens_path = os.path.join(cache_dir, "tokens.npy")
+    def cache_paths(src):
+        d = os.path.join(cfg.tokenizer_dir, f"cache-{_cache_key(cfg, src)}")
+        return d, os.path.join(d, "tokens.npy")
+
+    cache_dir, tokens_path = cache_paths(source)
     if os.path.exists(tokens_path):
         tokenizer = load_tokenizer(cache_dir)
         tokens = np.load(tokens_path)
@@ -114,24 +120,33 @@ def build_data(cfg: TrainConfig):
         vocab_size = tokenizer.get_vocab_size()
         print(f"Vocabulary size: {vocab_size}")  # train.py:161
     else:
-        if texts is None:
-            texts, source = load_corpus_resolved(
-                cfg.dataset, cfg.num_train_samples, cfg.seed
-            )
+        texts, source = load_corpus_resolved(
+            cfg.dataset, cfg.num_train_samples, cfg.seed
+        )
+        # the full load may resolve differently than the probe (network
+        # state can change between the two) — key on what was USED
+        cache_dir, tokens_path = cache_paths(source)
         tokenizer = train_bpe_tokenizer(
             texts, cfg.vocab_size, cfg.min_frequency, cfg.tokenizer_dir
         )
         vocab_size = tokenizer.get_vocab_size()
         print(f"Vocabulary size: {vocab_size}")  # train.py:161
         tokens = encode_corpus(tokenizer, texts)
-        os.makedirs(cache_dir, exist_ok=True)
-        tokenizer.save_model(cache_dir)
-        # write-then-rename: an interrupted save must not leave a
-        # truncated tokens.npy that matches the key forever after
-        tmp = os.path.join(cache_dir, f".tokens.{os.getpid()}.npy.tmp")
-        with open(tmp, "wb") as f:
+        # Build the WHOLE cache entry (tokenizer files + tokens) in a
+        # scratch dir, then rename it into place: a crash or a concurrent
+        # builder can never leave a half-written entry that matches the
+        # key. If another process won the rename race, adopt its entry.
+        tmp_dir = f"{cache_dir}.tmp.{os.getpid()}"
+        os.makedirs(tmp_dir, exist_ok=True)
+        tokenizer.save_model(tmp_dir)
+        with open(os.path.join(tmp_dir, "tokens.npy"), "wb") as f:
             np.save(f, tokens)
-        os.replace(tmp, tokens_path)
+        try:
+            os.rename(tmp_dir, cache_dir)
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp_dir, ignore_errors=True)
     print(f"Total tokens: {len(tokens)}")  # train.py:174
     train_tokens, val_tokens = split_tokens(tokens, cfg.val_fraction)
     block = cfg.model.block_size
@@ -145,6 +160,12 @@ def build_data(cfg: TrainConfig):
 
 def train(cfg: TrainConfig) -> dict:
     """Run the full recipe; returns the final train state."""
+    from differential_transformer_replication_tpu.parallel.multihost import (
+        initialize as distributed_initialize,
+        is_primary,
+    )
+
+    distributed_initialize()  # no-op single-process (multihost.py)
     print(f"Using devices: {jax.devices()}")
 
     tokenizer, vocab_size, train_ds, val_ds = build_data(cfg)
@@ -253,8 +274,9 @@ def train(cfg: TrainConfig) -> dict:
                 logger.log_eval(iter_num, losses["train"], losses["val"])
                 if losses["val"] < best_val_loss:  # train.py:307-317
                     best_val_loss = losses["val"]
-                    print(f"Saving best model with val loss: {best_val_loss:.4f}")
-                    save_checkpoint(cfg.checkpoint_path, state, best_val_loss, cfg)
+                    if is_primary():  # one writer on multi-host
+                        print(f"Saving best model with val loss: {best_val_loss:.4f}")
+                        save_checkpoint(cfg.checkpoint_path, state, best_val_loss, cfg)
 
         dt = time.time() - t0
         if dt > 0:

@@ -57,12 +57,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--wandb", action="store_true", help="enable the wandb sink")
     p.add_argument(
         "--profile-dir", default=None,
-        help="capture a jax.profiler trace of steps 10-15 into this dir",
+        help="capture a 5-step steady-state jax.profiler trace (starting "
+             "~10 iters after this run begins/resumes) into this dir",
     )
     p.add_argument("--data-parallel", type=int, default=1,
                    help="devices on the data mesh axis")
     p.add_argument("--tensor-parallel", type=int, default=1,
                    help="devices on the tensor mesh axis")
+    p.add_argument("--fsdp", type=int, default=1,
+                   help="devices on the fsdp (param-sharding) mesh axis")
+    p.add_argument("--sequence-parallel", type=int, default=1,
+                   help="devices on the sequence mesh axis (ring attention)")
     return p
 
 
@@ -81,7 +86,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     )
     return TrainConfig(
         model=model,
-        mesh=MeshConfig(data=args.data_parallel, tensor=args.tensor_parallel),
+        mesh=MeshConfig(data=args.data_parallel, fsdp=args.fsdp,
+                        tensor=args.tensor_parallel,
+                        sequence=args.sequence_parallel),
         dataset=args.dataset,
         num_train_samples=args.num_train_samples,
         vocab_size=args.vocab_size,
