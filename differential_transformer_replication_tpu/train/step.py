@@ -98,17 +98,22 @@ def make_step_fn(cfg: TrainConfig, mesh=None):
 
 def make_train_step(cfg: TrainConfig):
     """``step(state, batch, rng) -> (state, metrics)``, jitted for the
-    default (single-device) placement."""
-    return jax.jit(make_step_fn(cfg))
+    default (single-device) placement. The state is donated — same
+    throughput on v5e (XLA already aliases most buffers) but roughly
+    halves peak HBM across the update, like the sharded path
+    (parallel/dp_step.py)."""
+    return jax.jit(make_step_fn(cfg), donate_argnums=(0,))
 
 
-def make_eval_step(cfg: TrainConfig):
+def make_eval_step(cfg: TrainConfig, mesh=None):
     """Returns ``eval_step(params, x, y) -> loss``, jitted; dropout off
-    (model.eval() semantics, train.py:128)."""
+    (model.eval() semantics, train.py:128). Pass the training mesh so a
+    sequence-parallel run also evaluates through the ring path instead of
+    all-gathering the sequence."""
     model_cfg = cfg.resolved_model()
 
     @jax.jit
     def eval_step(params: dict, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
-        return loss_fn(params, x, y, model_cfg, rng=None)
+        return loss_fn(params, x, y, model_cfg, rng=None, mesh=mesh)
 
     return eval_step

@@ -184,6 +184,10 @@ def train(cfg: TrainConfig) -> dict:
         )
 
         mesh = create_mesh(cfg.mesh)
+        # threaded into eval too; model_forward ignores it unless the mesh
+        # has a >1 sequence axis (ring.use_ring), keeping eval and train
+        # on the same attention path by construction
+        eval_mesh = mesh
         print(f"Mesh: {dict(mesh.shape)}")
         state = create_sharded_train_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
         best_val_loss = float("inf")
@@ -194,13 +198,14 @@ def train(cfg: TrainConfig) -> dict:
             print(f"Resumed from {cfg.resume_from} at iter {int(jax.device_get(state['step']))}")
         train_step = make_sharded_train_step(cfg, mesh, state)
     else:
+        eval_mesh = None
         state = create_train_state(jax.random.PRNGKey(cfg.seed), cfg)
         best_val_loss = float("inf")
         if cfg.resume_from:
             state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, state)
             print(f"Resumed from {cfg.resume_from} at iter {int(state['step'])}")
         train_step = make_train_step(cfg)
-    eval_step = make_eval_step(cfg)
+    eval_step = make_eval_step(cfg, mesh=eval_mesh)
 
     data_rng = np.random.default_rng(cfg.seed)
     eval_rng = np.random.default_rng(cfg.seed + 1)

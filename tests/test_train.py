@@ -84,7 +84,9 @@ class TestTrainStep:
         microbatch of 8 (gradient averaging, train.py:265)."""
         cfg = tiny_train_cfg("control")
         state1 = create_train_state(jax.random.PRNGKey(0), cfg)
-        state2 = jax.tree_util.tree_map(lambda x: x, state1)
+        # deep copy: the train step donates its input state, so the two
+        # runs must not share buffers
+        state2 = jax.tree_util.tree_map(jnp.copy, state1)
         step = make_train_step(cfg)
         x = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 31)
         y = jnp.roll(x, -1, axis=-1)
