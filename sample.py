@@ -1,0 +1,93 @@
+"""Sample text from a trained checkpoint.
+
+The reference defines ``generate`` on every model but never calls it
+anywhere (SURVEY.md section 3.4); this CLI makes the capability usable:
+load a training checkpoint (best_model.ckpt) or a ``save_pretrained``
+directory, encode a prompt with the run's tokenizer, and sample with the
+reference's contract (temperature-1 multinomial) — through the KV-cache
+decoder when the output fits the context window, else the windowed
+jitted loop.
+
+    python sample.py --checkpoint best_model.ckpt --tokenizer tokenizer \
+        --prompt "One day, " --max-new-tokens 200 --n 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint", required=True,
+                   help="training checkpoint dir (best_model.ckpt) or a "
+                        "save_pretrained dir")
+    p.add_argument("--tokenizer", default="tokenizer",
+                   help="tokenizer dir (vocab.json + merges.txt)")
+    p.add_argument("--prompt", default="Once upon a time")
+    p.add_argument("--max-new-tokens", type=int, default=200)
+    p.add_argument("--n", type=int, default=1, help="samples to draw")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    from differential_transformer_replication_tpu.config import (
+        ModelConfig,
+        TrainConfig,
+    )
+    from differential_transformer_replication_tpu.data.tokenizer import (
+        load_tokenizer,
+    )
+    from differential_transformer_replication_tpu.models import (
+        generate,
+        generate_cached,
+    )
+    from differential_transformer_replication_tpu.train.checkpoint import (
+        from_pretrained,
+        load_checkpoint,
+    )
+    from differential_transformer_replication_tpu.train.step import (
+        create_train_state,
+    )
+
+    if os.path.exists(os.path.join(args.checkpoint, "params.msgpack")):
+        params, model_cfg = from_pretrained(args.checkpoint)
+    else:
+        with open(os.path.join(args.checkpoint, "meta.json")) as f:
+            meta = json.load(f)
+        saved = meta["config"]
+        model_cfg = ModelConfig(**saved["model"])
+        cfg = TrainConfig(
+            model=model_cfg,
+            vocab_size=saved["vocab_size"],
+            control_head_multiplier=saved["control_head_multiplier"],
+        )
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        state, _ = load_checkpoint(args.checkpoint, cfg, state)
+        params, model_cfg = state["params"], cfg.resolved_model()
+
+    tokenizer = load_tokenizer(args.tokenizer)
+    ids = tokenizer.encode(args.prompt).ids
+    if not ids:
+        raise SystemExit("prompt encoded to zero tokens")
+    if len(ids) > model_cfg.block_size:
+        ids = ids[-model_cfg.block_size :]
+    idx = jnp.asarray([ids] * args.n, jnp.int32)
+
+    rng = jax.random.PRNGKey(args.seed)
+    if len(ids) + args.max_new_tokens <= model_cfg.block_size:
+        out = generate_cached(params, idx, model_cfg, args.max_new_tokens, rng)
+    else:  # sliding-window behavior past the context limit
+        out = generate(params, idx, model_cfg, args.max_new_tokens, rng)
+
+    for i, row in enumerate(jax.device_get(out)):
+        print(f"--- sample {i} ---")
+        print(tokenizer.decode(row.tolist()))
+
+
+if __name__ == "__main__":
+    main()

@@ -5,9 +5,10 @@ lower val loss than a parameter-matched vanilla control (the paper's
 claim, arXiv:2410.05258); its only instrument for that is eyeballing
 wandb curves from manually re-commented train.py runs (train.py:205-230).
 This harness runs the comparison as one command: train each requested
-model family on the SAME data, seed, and recipe, evaluate on the same
-held-out windows, and emit a JSON summary with val loss/PPL per family
-and the diff-vs-control gap — the BASELINE.json north-star quantity.
+model family on the SAME data, seed, and recipe, evaluate the FINAL
+parameters on the same held-out windows, and emit a JSON summary with
+val loss/PPL per family and the diff-vs-control gap — the BASELINE.json
+north-star quantity.
 
 Usage (defaults are a scaled-down recipe that finishes in minutes on one
 chip; pass --full for the reference 8L/768d/40k recipe):
@@ -26,49 +27,59 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+# small-scale defaults; --full swaps in the reference recipe for any flag
+# the user did not pass explicitly (argparse defaults are None sentinels
+# so "explicitly passed the small default" still wins over the preset)
+_SMALL = dict(
+    iters=2000, n_layer=4, n_embd=256, n_head=4, block_size=256,
+    vocab_size=4096, dataset="synthetic", num_train_samples=100_000,
+    eval_iters=50,
+)
+_FULL = dict(
+    iters=40_000, n_layer=8, n_embd=768, n_head=4, block_size=512,
+    vocab_size=12_000, dataset="tinystories", num_train_samples=1_000_000,
+    eval_iters=200,
+)
+
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--models", nargs="+", default=["control", "diff"],
                    choices=["control", "diff", "ndiff"])
-    p.add_argument("--iters", type=int, default=2000)
-    p.add_argument("--n-layer", type=int, default=4)
-    p.add_argument("--n-embd", type=int, default=256)
-    p.add_argument("--n-head", type=int, default=4)
-    p.add_argument("--block-size", type=int, default=256)
+    for name, small in _SMALL.items():
+        flag = "--" + name.replace("_", "-")
+        p.add_argument(flag, type=type(small), default=None,
+                       help=f"default {small} (with --full: {_FULL[name]})")
     p.add_argument("--micro-batch-size", type=int, default=32)
-    p.add_argument("--dataset", default="synthetic")
-    p.add_argument("--vocab-size", type=int, default=4096)
-    p.add_argument("--num-train-samples", type=int, default=100_000)
-    p.add_argument("--eval-iters", type=int, default=50)
     p.add_argument("--seed", type=int, default=1337)
     p.add_argument("--attention-impl", default="xla", choices=["xla", "pallas"])
     p.add_argument("--full", action="store_true",
                    help="preset: the FULL reference recipe (8L/768d/block-512/"
                         "40k iters, TinyStories 1M docs, BPE-12k, 200 eval "
-                        "batches). Explicitly passed flags still win.")
+                        "batches, eval every 500). Explicit flags still win.")
     p.add_argument("--out", default="ppl_gap.json")
     args = p.parse_args()
+
+    preset = _FULL if args.full else _SMALL
+    for name, value in preset.items():
+        if getattr(args, name) is None:
+            setattr(args, name, value)
+
+    import jax
+    import numpy as np
 
     from differential_transformer_replication_tpu.config import (
         ModelConfig,
         TrainConfig,
     )
-    from differential_transformer_replication_tpu.train.trainer import train
+    from differential_transformer_replication_tpu.train.step import make_eval_step
+    from differential_transformer_replication_tpu.train.trainer import (
+        build_data,
+        estimate_loss,
+        train,
+    )
 
-    if args.full:
-        # the reference recipe, train.py:57-93 — applied only where the
-        # user left the default, so e.g. `--full --iters 5000` shortens
-        # the run instead of being silently clobbered
-        preset = dict(
-            n_layer=8, n_embd=768, n_head=4, block_size=512, iters=40_000,
-            vocab_size=12_000, dataset="tinystories",
-            num_train_samples=1_000_000, eval_iters=200,
-        )
-        for name, value in preset.items():
-            if getattr(args, name) == p.get_default(name):
-                setattr(args, name, value)
-
+    primary = jax.process_index() == 0
     results = {}
     for kind in args.models:
         model = ModelConfig(
@@ -86,7 +97,9 @@ def main() -> None:
             model=model,
             micro_batch_size=args.micro_batch_size,
             max_iters=args.iters,
-            eval_interval=max(args.iters // 4, 1),
+            # the reference evaluates every 500 iters (train.py:71); for
+            # short runs keep at least a mid-run checkpoint opportunity
+            eval_interval=min(500, max(args.iters // 4, 1)),
             eval_iters=args.eval_iters,
             warmup_iters=min(1000, args.iters // 10),
             dataset=args.dataset,
@@ -98,37 +111,30 @@ def main() -> None:
         )
         print(f"=== training {kind} ({args.iters} iters) ===")
         t0 = time.time()
-        train(cfg)
-        # read the last eval record back for the final val loss — only the
-        # primary process writes (and should report) on multi-host runs
-        import jax
-
-        if jax.process_index() != 0:
-            continue
-        val_loss = None
-        with open(cfg.metrics_path) as f:
-            for line in f:
-                rec = json.loads(line)
-                if "val_loss" in rec:
-                    val_loss = rec["val_loss"]
+        state = train(cfg)
+        wall = round(time.time() - t0, 1)
+        # evaluate the FINAL parameters directly — no metrics-file round
+        # trip, and the number always reflects end-of-training exactly
+        tokenizer, vocab_size, train_ds, val_ds = build_data(cfg)
+        eval_cfg = cfg.replace(vocab_size=vocab_size)
+        losses = estimate_loss(
+            make_eval_step(eval_cfg), state["params"], train_ds, val_ds,
+            eval_cfg, np.random.default_rng(cfg.seed + 1),
+        )
         results[kind] = {
-            "val_loss": val_loss,
-            "val_ppl": math.exp(val_loss) if val_loss is not None else None,
-            "wall_s": round(time.time() - t0, 1),
+            "train_loss": losses["train"],
+            "val_loss": losses["val"],
+            "val_ppl": math.exp(losses["val"]),
+            "wall_s": wall,
         }
 
-    import jax
-
-    if jax.process_index() != 0:
+    if not primary:
         return  # only the primary writes the summary
     summary = {"config": vars(args), "results": results}
     if "control" in results and "diff" in results:
         c, d = results["control"]["val_loss"], results["diff"]["val_loss"]
-        if c is not None and d is not None:
-            summary["diff_minus_control_val_loss"] = round(d - c, 5)
-            summary["diff_vs_control_ppl_ratio"] = round(
-                math.exp(d) / math.exp(c), 5
-            )
+        summary["diff_minus_control_val_loss"] = round(d - c, 5)
+        summary["diff_vs_control_ppl_ratio"] = round(math.exp(d) / math.exp(c), 5)
     with open(args.out, "w") as f:
         json.dump(summary, f, indent=1)
     print(json.dumps(summary, indent=1))
