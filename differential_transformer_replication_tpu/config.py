@@ -44,6 +44,11 @@ class ModelConfig:
     # Attention backend: "xla" (merged-head einsum under jit) or "pallas"
     # (fused differential flash attention kernel).
     attention_impl: str = "xla"
+    # Rematerialize each transformer block on the backward pass
+    # (jax.checkpoint): trades ~1/3 more FLOPs for O(n_layer) less
+    # activation memory — the standard TPU lever for bigger micro-batches
+    # or longer contexts (no reference analog; it keeps all activations).
+    remat: bool = False
 
     def __post_init__(self):
         if self.model not in MODEL_KINDS:

@@ -136,14 +136,21 @@ def forward(
     mask = causal_mask(T)
     rngs = common.split_rng(rng, cfg.n_layer)
     for li, (blk, r) in enumerate(zip(params["blocks"], rngs), 1):  # 1-based, :216
-        r_attn, r_ffn = common.split_rng(r, 2)
-        x = x + _attn(
-            common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
-            li, cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl, mesh,
-        )
-        x = x + common.apply_ffn(
-            common.apply_layer_norm(x, blk["ln2"]), blk["ffn"], cfg.dropout, r_ffn
-        )
+        def block_fn(x, blk, r, li=li):
+            r_attn, r_ffn = common.split_rng(r, 2)
+            x = x + _attn(
+                common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
+                li, cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl,
+                mesh,
+            )
+            return x + common.apply_ffn(
+                common.apply_layer_norm(x, blk["ln2"]), blk["ffn"],
+                cfg.dropout, r_ffn,
+            )
+
+        if cfg.remat:  # recompute this block's activations in the backward
+            block_fn = jax.checkpoint(block_fn)
+        x = block_fn(x, blk, r)
     x = common.apply_layer_norm(x, params["ln_f"])
     logits = common.linear(x, params["lm_head"])
     loss = None if targets is None else common.cross_entropy_loss(logits, targets)

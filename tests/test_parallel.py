@@ -166,3 +166,49 @@ class TestShardedTraining:
         sh = batch_sharding(mesh)
         # batch on data+fsdp, sequence dim on the context-parallel axis
         assert sh.spec == P(None, ("data", "fsdp"), "sequence")
+
+
+class TestPallasShardingGuard:
+    def test_pallas_rejected_on_multidevice_mesh_without_sequence(self):
+        """GSPMD can't partition a bare pallas_call; the sharded step must
+        fail loudly (dp_step.py) unless ring attention takes over."""
+        import pytest
+
+        from differential_transformer_replication_tpu.parallel.dp_step import (
+            make_sharded_train_step,
+        )
+
+        model = ModelConfig(
+            model="diff", vocab_size=64, n_embd=32, n_head=2, n_layer=1,
+            block_size=16, compute_dtype="float32", attention_impl="pallas",
+        )
+        cfg = TrainConfig(model=model, mesh=MeshConfig(data=2), vocab_size=64)
+        mesh = create_mesh(MeshConfig(data=2))
+        with pytest.raises(NotImplementedError):
+            make_sharded_train_step(cfg, mesh, {})
+
+    def test_pallas_allowed_with_sequence_axis(self):
+        """With a >1 sequence axis the ring path handles attention, so the
+        pallas setting is inert and the step builds."""
+        from differential_transformer_replication_tpu.parallel import (
+            make_sharded_train_step,
+        )
+        from differential_transformer_replication_tpu.parallel.dp_step import (
+            create_sharded_train_state,
+        )
+
+        mesh_cfg = MeshConfig(data=2, sequence=2)
+        model = ModelConfig(
+            model="diff", vocab_size=64, n_embd=32, n_head=2, n_layer=1,
+            block_size=16, compute_dtype="float32", attention_impl="pallas",
+        )
+        cfg = TrainConfig(
+            model=model, mesh=mesh_cfg, vocab_size=64, micro_batch_size=4,
+            control_head_multiplier=1,
+        )
+        mesh = create_mesh(mesh_cfg)
+        state = create_sharded_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        step = make_sharded_train_step(cfg, mesh, state)
+        x = jax.random.randint(jax.random.PRNGKey(1), (1, 4, 16), 0, 64)
+        _, metrics = step(state, {"x": x, "y": jnp.roll(x, -1, -1)})
+        assert jnp.isfinite(float(metrics["loss"]))

@@ -36,6 +36,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-terms", type=int, default=m.n_terms)
     p.add_argument("--compute-dtype", default=m.compute_dtype)
     p.add_argument("--attention-impl", choices=("xla", "pallas"), default=m.attention_impl)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize blocks on backward (less activation memory)")
 
     p.add_argument("--dataset", default=t.dataset,
                    help="tinystories | synthetic | path to a text file")
@@ -83,6 +85,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         n_terms=args.n_terms,
         compute_dtype=args.compute_dtype,
         attention_impl=args.attention_impl,
+        remat=args.remat,
     )
     return TrainConfig(
         model=model,
