@@ -86,7 +86,7 @@ def _attn(
 
     if use_ring(mesh):
         check_ring_dropout(dropout_rate, r_att)
-        out = ring_vanilla_attention(q, k, v, mesh)
+        out = ring_vanilla_attention(q, k, v, mesh, impl)
     elif use_flash(impl, dropout_rate, r_att):
         out = flash_vanilla_attention(q, k, v)
     else:

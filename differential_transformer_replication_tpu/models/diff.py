@@ -102,7 +102,7 @@ def _attn(
 
     if use_ring(mesh):
         check_ring_dropout(dropout_rate, r_att)
-        out = ring_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam, mesh)
+        out = ring_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam, mesh, impl)
     elif use_flash(impl, dropout_rate, r_att):
         out = flash_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam)
     else:

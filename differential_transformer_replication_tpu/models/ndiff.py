@@ -105,7 +105,7 @@ def _attn(
 
     if use_ring(mesh):
         check_ring_dropout(dropout_rate, r_att)
-        out = ring_ndiff_attention(qs, ks, v, lams, ndiff_signs(n), mesh)
+        out = ring_ndiff_attention(qs, ks, v, lams, ndiff_signs(n), mesh, impl)
     elif use_flash(impl, dropout_rate, r_att):
         out = flash_ndiff_attention(qs, ks, v, lams, ndiff_signs(n))
     else:

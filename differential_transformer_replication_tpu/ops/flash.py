@@ -59,9 +59,12 @@ from differential_transformer_replication_tpu.ops.streams import (
 )
 
 
-def _auto_interpret() -> bool:
+def auto_interpret() -> bool:
     """Compiled Mosaic on TPU; interpreter everywhere else (CPU CI)."""
     return jax.default_backend() != "tpu"
+
+
+_auto_interpret = auto_interpret  # internal callers
 
 
 def use_flash(impl: str, dropout_rate: float, rng) -> bool:
@@ -75,13 +78,16 @@ def use_flash(impl: str, dropout_rate: float, rng) -> bool:
     return impl == "pallas" and (dropout_rate == 0.0 or rng is None)
 
 
-def _pick_block(desired: int, total: int) -> int:
+def pick_block(desired: int, total: int) -> int:
     """Largest divisor of ``total`` that is <= desired (block shapes must
     tile the sequence exactly)."""
     b = min(desired, total)
     while total % b:
         b -= 1
     return b
+
+
+_pick_block = pick_block  # internal callers
 
 
 # ---------------------------------------------------------------------------
@@ -93,20 +99,31 @@ def _fwd_kernel(
     q_ref,  # (1, S, block_q, d)
     k_ref,  # (1, S, T, d)
     v_ref,  # (1, T, dv)
-    c_ref,  # (BH, S) float32 coefficient table, whole array in SMEM
-    out_ref,  # (1, block_q, dv)
-    oall_ref=None,  # (1, S, block_q, dv) per-stream outputs (VJP residual)
-    lse_ref=None,  # (1, S, block_q)      per-stream logsumexp (VJP residual)
-    *,
+    off_ref,  # (1, 1) float32 SMEM: causal row offset (0 = aligned causal;
+    #           +-k*Tl for ring chunks whose K lives k shards away)
+    *refs,  # [c_ref (BH, S) SMEM if emit_combined] then the outputs:
+    #         [out_ref (1, block_q, dv) if emit_combined]
+    #         [oall_ref (1, S, block_q, dv), lse_ref (1, S, block_q)
+    #          if save_residuals]
     block_k: int,
     save_residuals: bool,
+    emit_combined: bool = True,
 ):
+    """One online-softmax body for all three forward modes: the combined
+    primal (coeff-weighted sum of streams), the residual-saving VJP
+    forward, and the per-stream ring chunk (no combine; offset-causal)."""
+    if emit_combined:
+        c_ref, *outs = refs
+    else:
+        c_ref, outs = None, list(refs)
+
     S, block_q, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     T = k_ref.shape[2]
     dv = v_ref.shape[2]
     nk = T // block_k
     i = pl.program_id(1)
     q_start = i * block_q
+    off = off_ref[0, 0].astype(jnp.int32)
 
     q = q_ref[0].astype(jnp.float32)  # (S, block_q, d)
     scale = 1.0 / math.sqrt(d)
@@ -128,7 +145,7 @@ def _fwd_kernel(
             col_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where((col_ids <= row_ids)[None, :, :], s, NEG_INF)
+            s = jnp.where((col_ids <= row_ids + off)[None, :, :], s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (S, block_q)
             alpha = jnp.exp(m - m_new)
             p = jnp.exp(s - m_new[:, :, None])
@@ -143,7 +160,8 @@ def _fwd_kernel(
 
         # causal skip: K block j is entirely in the future of this Q block
         return jax.lax.cond(
-            j * block_k <= q_start + block_q - 1, compute, lambda c: c, carry
+            j * block_k <= q_start + block_q - 1 + off, compute, lambda c: c,
+            carry,
         )
 
     m0 = jnp.full((S, block_q), NEG_INF, jnp.float32)
@@ -151,16 +169,24 @@ def _fwd_kernel(
     a0 = jnp.zeros((S, block_q, dv), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
 
-    o_s = acc / l[:, :, None]  # (S, block_q, dv); diagonal keeps l > 0
-    # combine streams with the per-(b,h) scalar coefficients (SMEM)
-    bh = pl.program_id(0)
-    combined = c_ref[bh, 0] * o_s[0]
-    for s in range(1, S):
-        combined += c_ref[bh, s] * o_s[s]
-    out_ref[0] = combined.astype(out_ref.dtype)
+    # aligned-causal rows always see the diagonal (l >= 1); ring chunks can
+    # have fully masked rows, where l_safe keeps o finite and lse lands at
+    # ~NEG_INF so the chunk gets zero weight in the logsumexp merge
+    l_safe = jnp.maximum(l, 1e-30)
+    o_s = acc / l_safe[:, :, None]  # (S, block_q, dv)
+    if emit_combined:
+        # combine streams with the per-(b,h) scalar coefficients (SMEM)
+        bh = pl.program_id(0)
+        out_ref = outs[0]
+        combined = c_ref[bh, 0] * o_s[0]
+        for s in range(1, S):
+            combined += c_ref[bh, s] * o_s[s]
+        out_ref[0] = combined.astype(out_ref.dtype)
+        outs = outs[1:]
     if save_residuals:
+        oall_ref, lse_ref = outs
         oall_ref[0] = o_s.astype(oall_ref.dtype)
-        lse_ref[0] = (m + jnp.log(l)).astype(lse_ref.dtype)
+        lse_ref[0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
 
 
 def _fwd_call(
@@ -178,7 +204,8 @@ def _fwd_call(
     dv = v.shape[-1]
     nq = T // block_q
     kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, save_residuals=save_residuals
+        _fwd_kernel, block_k=block_k, save_residuals=save_residuals,
+        emit_combined=True,
     )
     out_shapes = [jax.ShapeDtypeStruct((BH, T, dv), q.dtype)]
     out_specs = [
@@ -214,6 +241,7 @@ def _fwd_call(
                 (1, S, T, d), lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
             # the whole (BH, S) scalar coefficient table rides in SMEM; a
             # per-bh block would violate Mosaic's (8, 128) tiling check
             pl.BlockSpec((BH, S), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
@@ -221,7 +249,7 @@ def _fwd_call(
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=interpret,
-    )(q, k, v, coeffs)
+    )(q, k, v, jnp.zeros((1, 1), jnp.float32), coeffs)
     if save_residuals:
         return results
     return results[0], None, None
@@ -239,6 +267,8 @@ def _bwd_dq_kernel(
     do_ref,  # (1, S, block_q, dv)  per-stream upstream grad (coeff folded in)
     lse_ref,  # (1, S, block_q)
     delta_ref,  # (1, S, block_q)     rowsum(dO_s * O_s)
+    off_ref,  # (1, 1) float32 SMEM: causal row offset (0 = aligned causal;
+    #           +-kTl for ring chunks whose K lives k shards away)
     dq_ref,  # (1, S, block_q, d)
     *,
     block_k: int,
@@ -248,6 +278,7 @@ def _bwd_dq_kernel(
     nk = T // block_k
     i = pl.program_id(1)
     q_start = i * block_q
+    off = off_ref[0, 0].astype(jnp.int32)
 
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)  # (S, block_q, dv)
@@ -268,7 +299,7 @@ def _bwd_dq_kernel(
             col_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            masked = (col_ids <= row_ids)[None, :, :]
+            masked = (col_ids <= row_ids + off)[None, :, :]
             p = jnp.where(masked, jnp.exp(s - lse[:, :, None]), 0.0)
             dp = jax.lax.dot_general(
                 do, v_j,
@@ -282,7 +313,7 @@ def _bwd_dq_kernel(
                 preferred_element_type=jnp.float32,
             ) * scale
         return jax.lax.cond(
-            j * block_k <= q_start + block_q - 1, compute, lambda x: x, dq
+            j * block_k <= q_start + block_q - 1 + off, compute, lambda x: x, dq
         )
 
     dq0 = jnp.zeros((S, block_q, d), jnp.float32)
@@ -297,6 +328,7 @@ def _bwd_dkv_kernel(
     do_ref,  # (1, S, T, dv)
     lse_ref,  # (1, S, T)
     delta_ref,  # (1, S, T)
+    off_ref,  # (1, 1) float32 SMEM causal row offset (see _bwd_dq_kernel)
     dk_ref,  # (1, S, block_k, d)
     dv_ref,  # (1, block_k, dv)
     *,
@@ -308,6 +340,7 @@ def _bwd_dkv_kernel(
     nq = T // block_q
     j = pl.program_id(1)
     k_start = j * block_k
+    off = off_ref[0, 0].astype(jnp.int32)
 
     k = k_ref[0].astype(jnp.float32)  # (S, block_k, d)
     scale = 1.0 / math.sqrt(d)
@@ -330,7 +363,7 @@ def _bwd_dkv_kernel(
             row_ids = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            masked = (col_ids <= row_ids)[None, :, :]
+            masked = (col_ids <= row_ids + off)[None, :, :]
             p = jnp.where(masked, jnp.exp(s - lse_i[:, :, None]), 0.0)
             # dV = sum_s P_s^T dO_s (coeff already folded into dO_s).
             # Mosaic can't contract two dims at once, so loop streams
@@ -356,7 +389,7 @@ def _bwd_dkv_kernel(
             return dk_new, dv_new
 
         # skip Q blocks entirely before this K block (causal: no grad flows)
-        return jax.lax.cond(i * block_q + block_q - 1 >= k_start, compute,
+        return jax.lax.cond(i * block_q + block_q - 1 + off >= k_start, compute,
                             lambda c: c, carry)
 
     dk0 = jnp.zeros((S, block_k, d), jnp.float32)
@@ -367,11 +400,15 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_call(
-    q, k, v, do_s, lse, delta, *, block_q: int, block_k: int, interpret: bool
+    q, k, v, do_s, lse, delta, offset=None, *,
+    block_q: int, block_k: int, interpret: bool
 ):
     BH, S, T, d = q.shape
     dv_width = v.shape[-1]
     nq, nk = T // block_q, T // block_k
+    if offset is None:
+        offset = jnp.zeros((1, 1), jnp.float32)
+    off_spec = pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=block_k),
@@ -389,12 +426,13 @@ def _bwd_call(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
+            off_spec,
         ],
         out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta)
+    )(q, k, v, do_s, lse, delta, offset)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q),
@@ -412,6 +450,7 @@ def _bwd_call(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
                          memory_space=pltpu.VMEM),
+            off_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, S, block_k, d), lambda b, j: (b, 0, j, 0),
@@ -424,7 +463,7 @@ def _bwd_call(
             jax.ShapeDtypeStruct((BH, T, dv_width), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta)
+    )(q, k, v, do_s, lse, delta, offset)
     return dq, dk, dv
 
 
@@ -475,6 +514,94 @@ def _flash_bwd(blocks, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Chunk op: per-stream (O_s, lse_s) with a causal row offset — the building
+# block for ring (sequence-parallel) flash attention
+# ---------------------------------------------------------------------------
+
+
+def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret):
+    """Per-stream (o_all, lse) with offset-causal masking — the unified
+    forward kernel in its no-combine mode. off = +Tl*k means K lives k
+    shards earlier in the ring (fully visible once off >= T); large
+    negative off masks everything (the chunk then contributes weight
+    exp(-inf) = 0 at merge time)."""
+    BH, S, T, d = q.shape
+    dv = v.shape[-1]
+    nq = T // block_q
+    return pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block_k=block_k, save_residuals=True,
+            emit_combined=False,
+        ),
+        grid=(BH, nq),
+        in_specs=[
+            pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, T, d), lambda b, i: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, S, block_q, dv), lambda b, i: (b, 0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, T, dv), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, T), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, offset)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_chunk_attention(q, k, v, offset, blocks, interpret):
+    """Per-stream offset-causal flash chunk: ``(O_s, lse_s)`` for
+    ``O_s = softmax(Q_s K_s^T / sqrt(d) + offset-causal mask) @ V``.
+
+    q/k: (BH, S, T, d); v: (BH, T, dv); offset: (1, 1) float32 (traced —
+    inside a shard_map ring it is a function of axis_index). Returns
+    (o_all (BH, S, T, dv), lse (BH, S, T)). Chunks combine exactly via the
+    running logsumexp merge (parallel/ring.py)."""
+    return _chunk_fwd_call(
+        q, k, v, offset, block_q=blocks[0], block_k=blocks[1],
+        interpret=interpret,
+    )
+
+
+def _flash_chunk_fwd(q, k, v, offset, blocks, interpret):
+    o_all, lse = _chunk_fwd_call(
+        q, k, v, offset, block_q=blocks[0], block_k=blocks[1],
+        interpret=interpret,
+    )
+    return (o_all, lse), (q, k, v, offset, o_all, lse)
+
+
+def _flash_chunk_bwd(blocks, interpret, res, ct):
+    q, k, v, offset, o_all, lse = res
+    do, dlse = ct  # cotangents for both outputs
+    do32 = do.astype(jnp.float32)
+    # dS = P * (dP_raw - delta + dlse): the lse cotangent folds into the
+    # delta term of the standard flash backward (dlse_i distributes over the
+    # row's probabilities)
+    delta_eff = (
+        jnp.einsum("bstd,bstd->bst", do32, o_all.astype(jnp.float32))
+        - dlse.astype(jnp.float32)
+    )
+    dq, dk, dv = _bwd_call(
+        q, k, v, do.astype(q.dtype), lse, delta_eff, offset,
+        block_q=blocks[2], block_k=blocks[3], interpret=interpret,
+    )
+    return dq, dk, dv, jnp.zeros_like(offset)
+
+
+flash_chunk_attention.defvjp(_flash_chunk_fwd, _flash_chunk_bwd)
 
 
 # ---------------------------------------------------------------------------

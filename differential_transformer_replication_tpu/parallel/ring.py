@@ -34,6 +34,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from differential_transformer_replication_tpu.ops.flash import (
+    auto_interpret,
+    flash_chunk_attention,
+    pick_block,
+)
 from differential_transformer_replication_tpu.ops.streams import (
     NEG_INF,
     diff_coeffs,
@@ -44,6 +49,56 @@ from differential_transformer_replication_tpu.ops.streams import (
 _BATCH_AXES = ("data", "fsdp")
 _SEQ_AXIS = "sequence"
 _HEAD_AXIS = "tensor"
+
+
+def _ring_flash_body(
+    qs: jnp.ndarray,  # (S, Bl, Tl, Hl, d) local shard
+    ks: jnp.ndarray,  # (S, Bl, Tl, Hl, d)
+    v: jnp.ndarray,  # (Bl, Tl, Hl, dv)
+    coeffs: jnp.ndarray,  # (S, Hl) float32
+) -> jnp.ndarray:
+    """Ring body whose per-chunk compute is the fused Pallas chunk kernel
+    (ops/flash.py:flash_chunk_attention) — no Tl x Tl map is materialized
+    even chunk-locally. Chunks merge exactly via the running logsumexp
+    recurrence: with per-chunk normalized outputs o_c and logsumexps
+    lse_c, ``lse' = logaddexp(lse, lse_c)`` and
+    ``o' = o*exp(lse-lse') + o_c*exp(lse_c-lse')``."""
+    S, B, Tl, H, d = qs.shape
+    dv = v.shape[-1]
+    p = jax.lax.axis_size(_SEQ_AXIS)
+    my = jax.lax.axis_index(_SEQ_AXIS)
+    interpret = auto_interpret()
+    bq = pick_block(128, Tl)
+    bk = pick_block(128, Tl)
+    blocks = (bq, bk, bq, bk)
+
+    # (S, B, Tl, H, d) -> (B*H, S, Tl, d)
+    q_r = qs.transpose(1, 3, 0, 2, 4).reshape(B * H, S, Tl, d)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def step(t, carry):
+        o, lse, ks_t, v_t = carry
+        src = jax.lax.rem(my - t + p, p)
+        off = ((my - src) * Tl).astype(jnp.float32).reshape(1, 1)
+        k_r = ks_t.transpose(1, 3, 0, 2, 4).reshape(B * H, S, Tl, d)
+        v_r = v_t.transpose(0, 2, 1, 3).reshape(B * H, Tl, dv)
+        o_c, lse_c = flash_chunk_attention(q_r, k_r, v_r, off, blocks, interpret)
+        lse_new = jnp.logaddexp(lse, lse_c)
+        w_old = jnp.exp(lse - lse_new)[..., None]
+        w_new = jnp.exp(lse_c - lse_new)[..., None]
+        o_new = o * w_old + o_c.astype(jnp.float32) * w_new
+        ks_n = jax.lax.ppermute(ks_t, _SEQ_AXIS, perm)
+        v_n = jax.lax.ppermute(v_t, _SEQ_AXIS, perm)
+        return o_new, lse_new, ks_n, v_n
+
+    o0 = jnp.zeros((B * H, S, Tl, dv), jnp.float32)
+    lse0 = jnp.full((B * H, S, Tl), NEG_INF, jnp.float32)
+    o, lse, _, _ = jax.lax.fori_loop(0, p, step, (o0, lse0, ks, v))
+
+    # combine streams with the per-head coefficients, back to (B, Tl, H, dv)
+    o_bh = o.reshape(B, H, S, Tl, dv)
+    out = jnp.einsum("sh,bhstd->bhtd", coeffs.astype(jnp.float32), o_bh)
+    return out.transpose(0, 2, 1, 3).astype(v.dtype)
 
 
 def _ring_shard_body(
@@ -103,16 +158,23 @@ def ring_multi_stream_attention(
     v: jnp.ndarray,  # (B, T, H, dv) global
     coeffs: jnp.ndarray,  # (S, H) float32
     mesh: Mesh,
+    impl: str = "xla",
 ) -> jnp.ndarray:
     """Causal multi-stream attention with the sequence dim ring-sharded
     over ``mesh``'s ``sequence`` axis. Global shapes in, global out —
     callable from inside an outer jit; composes with data/fsdp batch
-    sharding and tensor head sharding."""
+    sharding and tensor head sharding.
+
+    ``impl``: "xla" computes each chunk with dense masked softmax (Tl x Tl
+    chunk-local maps); "pallas" runs the fused flash chunk kernel inside
+    the ring, so even chunk-local memory stays O(Tl) — ring flash
+    attention, the long-context configuration."""
     qk_spec = P(None, _BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
     v_spec = P(_BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
     c_spec = P(None, _HEAD_AXIS)
+    body = _ring_flash_body if impl == "pallas" else _ring_shard_body
     inner = jax.shard_map(
-        _ring_shard_body,
+        body,
         mesh=mesh,
         in_specs=(qk_spec, qk_spec, v_spec, c_spec),
         out_specs=v_spec,
@@ -121,25 +183,27 @@ def ring_multi_stream_attention(
     return inner(qs, ks, v, coeffs)
 
 
-def ring_vanilla_attention(q, k, v, mesh: Mesh):
+def ring_vanilla_attention(q, k, v, mesh: Mesh, impl: str = "xla"):
     """Sequence-parallel form of ops.attention.vanilla_attention."""
     return ring_multi_stream_attention(
-        q[None], k[None], v, vanilla_coeffs(q.shape[2]), mesh
+        q[None], k[None], v, vanilla_coeffs(q.shape[2]), mesh, impl
     )
 
 
-def ring_diff_attention(q1, k1, q2, k2, v, lam, mesh: Mesh):
+def ring_diff_attention(q1, k1, q2, k2, v, lam, mesh: Mesh, impl: str = "xla"):
     """Sequence-parallel form of ops.attention.diff_attention:
     coeffs [1, -lambda] (diff_transformer.py:70)."""
     qs = jnp.stack([q1, q2])
     ks = jnp.stack([k1, k2])
-    return ring_multi_stream_attention(qs, ks, v, diff_coeffs(lam), mesh)
+    return ring_multi_stream_attention(qs, ks, v, diff_coeffs(lam), mesh, impl)
 
 
-def ring_ndiff_attention(qs, ks, v, lams, signs, mesh: Mesh):
+def ring_ndiff_attention(qs, ks, v, lams, signs, mesh: Mesh, impl: str = "xla"):
     """Sequence-parallel form of ops.attention.ndiff_attention: coeffs
     sign_s * lambda_{s,h} (Ndiff_transformer.py:119-123)."""
-    return ring_multi_stream_attention(qs, ks, v, ndiff_coeffs(lams, signs), mesh)
+    return ring_multi_stream_attention(
+        qs, ks, v, ndiff_coeffs(lams, signs), mesh, impl
+    )
 
 
 def use_ring(mesh: Optional[Mesh]) -> bool:
