@@ -31,13 +31,14 @@ dropout is NOT fused — the reference trains with dropout=0.0 (train.py:64);
 models fall back to the XLA path when dropout is active (rate > 0 AND an
 rng is supplied).
 
-VMEM envelope: each grid step holds the full per-(b,h) K/V (forward, dq)
-or Q/dO (dkv) in VMEM, so per-chip sequence length is bounded by roughly
-S*T*(d+dv)*2 bytes <= ~12 MB — T up to ~8k for the flagship diff shapes
-(verified compiling/running at T=4096 on v5e). Longer contexts are the
-sequence-parallel path's job (parallel/ring attention shards T across the
-mesh before the kernel sees it); a K-grid-tiled kernel variant can lift
-the single-chip bound later if needed.
+VMEM envelope (measured on v5e at the flagship diff shapes): each grid
+step holds the full per-(b,h) K/V (forward, dq) or Q/dO (dkv) in VMEM.
+Training (fwd+bwd) compiles and runs at T=4096 and fails Mosaic
+allocation from T=5120; forward-only works through T=8192. Longer
+contexts are the sequence-parallel path's job — parallel/ring.py shards
+T across the mesh, and with impl="pallas" runs this kernel per chunk
+(flash_chunk_attention), so the envelope applies to T/num_shards. A
+K-grid-tiled kernel variant could lift the single-chip bound later.
 """
 
 from __future__ import annotations
