@@ -95,6 +95,7 @@ class MeshConfig:
     TPU-native replacement: axes map onto ICI.
     """
 
+    pipeline: int = 1  # pipeline parallel (GPipe stages, parallel/pipeline.py)
     data: int = 1  # data parallel (batch sharding + gradient psum)
     fsdp: int = 1  # parameter/optimizer sharding over the data axis group
     tensor: int = 1  # tensor parallel (head / ffn-hidden sharding)
@@ -102,11 +103,16 @@ class MeshConfig:
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
-        return ("data", "fsdp", "tensor", "sequence")
+        # pipeline is the LAST (fastest-varying, stride-1) axis so
+        # consecutive stages are adjacent in jax.devices() enumeration
+        # order — the best default for the ppermute activation handoff
+        # (true physical torus adjacency would need
+        # jax.experimental.mesh_utils.create_device_mesh on big slices)
+        return ("data", "fsdp", "tensor", "sequence", "pipeline")
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.data, self.fsdp, self.tensor, self.sequence)
+        return (self.data, self.fsdp, self.tensor, self.sequence, self.pipeline)
 
     @property
     def n_devices(self) -> int:

@@ -1,6 +1,7 @@
 from differential_transformer_replication_tpu.models.registry import (
     init_model,
     model_forward,
+    model_module,
     param_count,
 )
 from differential_transformer_replication_tpu.models.generate import generate
@@ -13,6 +14,7 @@ from differential_transformer_replication_tpu.models.decode import (
 __all__ = [
     "init_model",
     "model_forward",
+    "model_module",
     "param_count",
     "generate",
     "generate_cached",

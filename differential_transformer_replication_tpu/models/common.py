@@ -80,6 +80,15 @@ def apply_ffn(
 
 
 
+def apply_tail(x: jnp.ndarray, params: dict) -> jnp.ndarray:
+    """Final LayerNorm + untied lm head — identical across the three
+    families (control.py:126-127, diff_transformer.py:164-165,
+    Ndiff_transformer.py:220-221). ``params`` is the model params dict
+    (or any dict carrying ``ln_f``/``lm_head``)."""
+    x = apply_layer_norm(x, params["ln_f"])
+    return linear(x, params["lm_head"])
+
+
 def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     """Mean cross-entropy over all (B*T) positions, matching the flattened
     ``F.cross_entropy`` call (control.py:153-159). Computed in float32."""
@@ -94,3 +103,26 @@ def split_rng(rng: Optional[jax.Array], n: int):
     if rng is None:
         return (None,) * n
     return tuple(jax.random.split(rng, n))
+
+
+# ---------------------------------------------------------------------------
+# Blocks-layout conversion — the SINGLE definition of the two layouts:
+# canonical (list of per-layer dicts, what init() builds and checkpoints
+# store) vs layer-stacked (one dict whose leaves carry a leading n_layer
+# axis, what the pipeline-parallel path shards P('pipeline')). Used by
+# parallel/pipeline.py and train/checkpoint.py.
+
+
+def stack_block_list(blocks: list, stack_fn=None) -> dict:
+    """List of per-layer dicts -> one dict of layer-stacked leaves.
+    ``stack_fn`` defaults to ``jnp.stack`` (pass ``np.stack`` for host-side
+    conversion of device_get'd states)."""
+    fn = jnp.stack if stack_fn is None else stack_fn
+    return jax.tree_util.tree_map(lambda *xs: fn(list(xs), axis=0), *blocks)
+
+
+def unstack_block_tree(blocks: dict, n_layer: int) -> list:
+    """Inverse of :func:`stack_block_list`."""
+    return [
+        jax.tree_util.tree_map(lambda x: x[i], blocks) for i in range(n_layer)
+    ]

@@ -34,6 +34,11 @@ from differential_transformer_replication_tpu.ops.flash import use_flash
 from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
 
 
+# learned absolute positions, no RoPE (diff_transformer.py:133-134);
+# consumers that precompute RoPE tables (parallel/pipeline.py) key on this.
+USES_ROPE = False
+
+
 def init(key: jax.Array, cfg: ModelConfig) -> dict:
     H, d, E = cfg.n_head, cfg.head_size, cfg.n_embd
     keys = jax.random.split(key, cfg.n_layer + 3)
@@ -117,6 +122,47 @@ def _attn(
     return common.dropout(out, dropout_rate, r_out)
 
 
+def embed(params: dict, idx: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Token embedding PLUS the learned absolute position table — the only
+    family with one (diff_transformer.py:133-134, 157-159)."""
+    T = idx.shape[-1]
+    if T > cfg.block_size:
+        # The reference raises (nn.Embedding index error) past block_size;
+        # a JAX gather would silently clamp, so fail loudly instead.
+        raise ValueError(f"sequence length {T} exceeds block_size {cfg.block_size}")
+    tok = params["tok_emb"][idx]
+    pos = params["pos_emb"][jnp.arange(T)]  # diff_transformer.py:158
+    return (tok + pos).astype(jnp.dtype(cfg.compute_dtype))
+
+
+def block_forward(
+    x: jnp.ndarray,
+    blk: dict,
+    layer_idx,
+    cfg: ModelConfig,
+    cos: Optional[jnp.ndarray],
+    sin: Optional[jnp.ndarray],
+    mask: jnp.ndarray,
+    rng: Optional[jax.Array] = None,
+    mesh=None,
+) -> jnp.ndarray:
+    """One pre-LN residual block (diff_transformer.py:107-126).
+    ``layer_idx`` is 1-based (diff_transformer.py:161) and may be a static
+    int or a traced integer (the pipeline-parallel layer scan). ``cos``/
+    ``sin`` are part of the uniform per-family signature; this family has
+    no RoPE."""
+    del cos, sin
+    r_attn, r_ffn = common.split_rng(rng, 2)
+    x = x + _attn(
+        common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
+        layer_idx, mask, cfg.dropout, r_attn, cfg.attention_impl, mesh,
+    )
+    return x + common.apply_ffn(
+        common.apply_layer_norm(x, blk["ln2"]), blk["ffn"],
+        cfg.dropout, r_ffn,
+    )
+
+
 def forward(
     params: dict,
     idx: jnp.ndarray,
@@ -127,32 +173,14 @@ def forward(
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """(B, T) int tokens -> (logits (B, T, V), loss or None)."""
     B, T = idx.shape
-    if T > cfg.block_size:
-        # The reference raises (nn.Embedding index error) past block_size;
-        # a JAX gather would silently clamp, so fail loudly instead.
-        raise ValueError(f"sequence length {T} exceeds block_size {cfg.block_size}")
-    compute = jnp.dtype(cfg.compute_dtype)
-    tok = params["tok_emb"][idx]
-    pos = params["pos_emb"][jnp.arange(T)]  # diff_transformer.py:158
-    x = (tok + pos).astype(compute)
+    x = embed(params, idx, cfg)
     mask = causal_mask(T)
     rngs = common.split_rng(rng, cfg.n_layer)
     for li, (blk, r) in enumerate(zip(params["blocks"], rngs), 1):  # 1-based, :161
-        def block_fn(x, blk, r, li=li):
-            r_attn, r_ffn = common.split_rng(r, 2)
-            x = x + _attn(
-                common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
-                li, mask, cfg.dropout, r_attn, cfg.attention_impl, mesh,
-            )
-            return x + common.apply_ffn(
-                common.apply_layer_norm(x, blk["ln2"]), blk["ffn"],
-                cfg.dropout, r_ffn,
-            )
-
+        fn = block_forward
         if cfg.remat:  # recompute this block's activations in the backward
-            block_fn = jax.checkpoint(block_fn)
-        x = block_fn(x, blk, r)
-    x = common.apply_layer_norm(x, params["ln_f"])
-    logits = common.linear(x, params["lm_head"])
+            fn = jax.checkpoint(fn, static_argnums=(2, 3, 8))
+        x = fn(x, blk, li, cfg, None, None, mask, r, mesh)
+    logits = common.apply_tail(x, params)
     loss = None if targets is None else common.cross_entropy_loss(logits, targets)
     return logits, loss

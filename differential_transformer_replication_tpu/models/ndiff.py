@@ -40,6 +40,11 @@ from differential_transformer_replication_tpu.ops.flash import use_flash
 from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
 
 
+# RoPE positions (Ndiff_transformer.py:104-110); consumers that precompute
+# the tables (parallel/pipeline.py) key on this flag.
+USES_ROPE = True
+
+
 def init(key: jax.Array, cfg: ModelConfig) -> dict:
     H, d, E, n = cfg.n_head, cfg.head_size, cfg.n_embd, cfg.n_terms
     keys = jax.random.split(key, cfg.n_layer + 3)
@@ -120,6 +125,37 @@ def _attn(
     return common.dropout(out, dropout_rate, r_out)
 
 
+def embed(params: dict, idx: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Token embedding only — RoPE positions (Ndiff_transformer.py:188, 213)."""
+    return params["tok_emb"][idx].astype(jnp.dtype(cfg.compute_dtype))
+
+
+def block_forward(
+    x: jnp.ndarray,
+    blk: dict,
+    layer_idx,
+    cfg: ModelConfig,
+    cos: Optional[jnp.ndarray],
+    sin: Optional[jnp.ndarray],
+    mask: jnp.ndarray,
+    rng: Optional[jax.Array] = None,
+    mesh=None,
+) -> jnp.ndarray:
+    """One pre-LN residual block (Ndiff_transformer.py:160-179).
+    ``layer_idx`` is 1-based (Ndiff_transformer.py:216) and may be static
+    or traced (the pipeline-parallel layer scan)."""
+    r_attn, r_ffn = common.split_rng(rng, 2)
+    x = x + _attn(
+        common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
+        layer_idx, cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl,
+        mesh,
+    )
+    return x + common.apply_ffn(
+        common.apply_layer_norm(x, blk["ln2"]), blk["ffn"],
+        cfg.dropout, r_ffn,
+    )
+
+
 def forward(
     params: dict,
     idx: jnp.ndarray,
@@ -130,28 +166,15 @@ def forward(
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """(B, T) int tokens -> (logits (B, T, V), loss or None)."""
     B, T = idx.shape
-    compute = jnp.dtype(cfg.compute_dtype)
-    x = params["tok_emb"][idx].astype(compute)  # Ndiff_transformer.py:213
+    x = embed(params, idx, cfg)
     cos, sin = rope_cos_sin(cfg.head_size, T)
     mask = causal_mask(T)
     rngs = common.split_rng(rng, cfg.n_layer)
     for li, (blk, r) in enumerate(zip(params["blocks"], rngs), 1):  # 1-based, :216
-        def block_fn(x, blk, r, li=li):
-            r_attn, r_ffn = common.split_rng(r, 2)
-            x = x + _attn(
-                common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
-                li, cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl,
-                mesh,
-            )
-            return x + common.apply_ffn(
-                common.apply_layer_norm(x, blk["ln2"]), blk["ffn"],
-                cfg.dropout, r_ffn,
-            )
-
+        fn = block_forward
         if cfg.remat:  # recompute this block's activations in the backward
-            block_fn = jax.checkpoint(block_fn)
-        x = block_fn(x, blk, r)
-    x = common.apply_layer_norm(x, params["ln_f"])
-    logits = common.linear(x, params["lm_head"])
+            fn = jax.checkpoint(fn, static_argnums=(2, 3, 8))
+        x = fn(x, blk, li, cfg, cos, sin, mask, r, mesh)
+    logits = common.apply_tail(x, params)
     loss = None if targets is None else common.cross_entropy_loss(logits, targets)
     return logits, loss

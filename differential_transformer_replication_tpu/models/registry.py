@@ -40,3 +40,12 @@ def model_forward(
 
 def param_count(params: dict) -> int:
     return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+def model_module(cfg: ModelConfig):
+    """The family's module, exposing the split forward pieces each family
+    defines with a uniform signature — ``embed(params, idx, cfg)`` and
+    ``block_forward(x, blk, layer_idx, cfg, cos, sin, mask, rng, mesh)`` —
+    used by the pipeline-parallel schedule (parallel/pipeline.py), which
+    must place embed / blocks / lm-head on different stages."""
+    return _MODULES[cfg.model]

@@ -30,13 +30,18 @@ import jax.numpy as jnp
 OUTPUT_SCALE = 1.0 - 0.8
 
 
-def lambda_init_schedule(layer_idx: int) -> float:
+def lambda_init_schedule(layer_idx):
     """Dynamic per-layer lambda_init, 1-based layer index
     (diff_transformer.py:43). Layer 1 -> 0.2, 2 -> 0.3555..., 8 -> 0.7265...
 
-    Computed host-side: ``layer_idx`` is static under jit.
+    Accepts a static Python int (computed host-side, the usual case) or a
+    traced integer (the pipeline-parallel path scans over a stage's layer
+    stack, so the layer index is a loop variable — parallel/pipeline.py).
     """
-    return 0.8 - 0.6 * math.exp(-0.3 * (float(layer_idx) - 1.0))
+    if isinstance(layer_idx, (int, float)):
+        return 0.8 - 0.6 * math.exp(-0.3 * (float(layer_idx) - 1.0))
+    idx = jnp.asarray(layer_idx, jnp.float32)
+    return 0.8 - 0.6 * jnp.exp(-0.3 * (idx - 1.0))
 
 
 def diff_lambda(

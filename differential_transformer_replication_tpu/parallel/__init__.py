@@ -8,6 +8,11 @@ from differential_transformer_replication_tpu.parallel.sharding import (
 from differential_transformer_replication_tpu.parallel.dp_step import (
     make_sharded_train_step,
 )
+from differential_transformer_replication_tpu.parallel.pipeline import (
+    create_pipeline_train_state,
+    make_pipeline_eval_step,
+    make_pipeline_train_step,
+)
 
 __all__ = [
     "create_mesh",
@@ -16,4 +21,7 @@ __all__ = [
     "state_sharding",
     "shard_state",
     "make_sharded_train_step",
+    "create_pipeline_train_state",
+    "make_pipeline_eval_step",
+    "make_pipeline_train_step",
 ]

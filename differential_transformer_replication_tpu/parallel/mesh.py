@@ -11,7 +11,9 @@ Axes:
   - ``data``: batch sharding; gradients all-reduced across it,
   - ``fsdp``: parameter/optimizer sharding (a second data-like axis),
   - ``tensor``: head/FFN-hidden/vocab sharding (Megatron-style),
-  - ``sequence``: context parallelism (ring attention over sequence).
+  - ``sequence``: context parallelism (ring attention over sequence),
+  - ``pipeline``: GPipe stages (parallel/pipeline.py) — last so
+    consecutive stages are adjacent in device-enumeration order.
 """
 
 from __future__ import annotations
@@ -38,6 +40,6 @@ def create_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
 
 
 def single_device_mesh() -> Mesh:
-    """A 1x1x1x1 mesh over the default device — lets the same sharded code
-    paths run unmodified on one chip."""
+    """An all-ones mesh over the default device — lets the same sharded
+    code paths run unmodified on one chip."""
     return create_mesh(MeshConfig(), devices=jax.devices()[:1])

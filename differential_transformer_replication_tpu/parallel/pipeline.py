@@ -1,0 +1,340 @@
+"""Pipeline parallelism: GPipe microbatch scheduling over the mesh's
+``pipeline`` axis.
+
+The reference has no pipeline (or any working distributed) machinery —
+its DDP/NCCL imports are dormant (train.py:7-10, 88; SURVEY.md section
+2.3). This module is the TPU-native scale-out lever the reference never
+built: transformer layers are split into P contiguous stages, one per
+device along the ``pipeline`` mesh axis, and microbatches stream through
+the stages with activations handed to the next stage by
+``jax.lax.ppermute``. The pipeline axis is the LAST, stride-1 mesh axis
+(config.py) so neighboring stages are adjacent in ``jax.devices()``
+enumeration order — a good default for the handoff, though physical
+torus adjacency on large slices is the device-assignment problem
+``mesh_utils.create_device_mesh`` exists for.
+
+Design (the standard SPMD pipelining recipe, cf. the public JAX scaling
+playbook):
+
+  - **Stage-stacked parameters.** The per-layer ``blocks`` list is
+    stacked on a leading layer axis and sharded ``P('pipeline')``: each
+    device holds ``n_layer / P`` consecutive layers and scans over them
+    (``lax.scan``), with the TRACED 1-based layer index
+    ``stage * Lp + j + 1`` feeding the dynamic lambda-init schedule
+    (ops/lambdas.py handles traced indices).
+  - **GPipe schedule.** With M microbatches (the ``grad_acc_steps`` axis
+    of the batch — pipeline microbatching IS gradient accumulation) the
+    loop runs ``M + P - 1`` ticks. At tick t, stage s computes microbatch
+    ``t - s``; stage 0 feeds ``h0[t]``; the last stage collects outputs
+    for microbatch ``t - (P-1)``. Every stage computes every tick (the
+    classic ``(P-1)/(M+P-1)`` bubble is idle-compute on garbage, masked
+    out of the loss), so keep ``M >= P`` for efficiency.
+  - **Embed / head placement.** Embedding and lm-head params are
+    replicated over the pipeline axis; each stage computes the (cheap)
+    embedding of its own feeds, and only the LAST stage's head output
+    enters the loss (``where``-masked, then ``psum`` broadcasts the loss
+    so the shard_map output is replicated).
+  - **Autodiff does 1F1B's work.** ``jax.grad`` through the tick scan
+    transposes each ``ppermute`` into the reverse rotation: the backward
+    pass is automatically the mirrored pipeline, and cotangents for the
+    replicated embed/head params are psummed across the mesh by
+    shard_map's transpose.
+
+Composition: the ``data`` (and ``fsdp``, treated as a second data axis)
+mesh dims shard the microbatch batch dim — grads are averaged across
+them inside the loss (``pmean``), so one shard_mapped function delivers
+PP x DP. ``tensor``/``sequence`` must be 1 when pipeline > 1 (their
+sharding lives in the GSPMD path, parallel/dp_step.py; composing them
+with manual pipelining is out of scope and raises loudly).
+
+Restrictions (checked): ``n_layer % P == 0``, ``dropout == 0`` (the
+reference's default, train.py:64), and — at train-step construction —
+``micro_batch_size`` divisible by data*fsdp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from differential_transformer_replication_tpu.config import ModelConfig, TrainConfig
+from differential_transformer_replication_tpu.models import common, model_module
+from differential_transformer_replication_tpu.ops import causal_mask, rope_cos_sin
+from differential_transformer_replication_tpu.train.optim import make_optimizer
+from differential_transformer_replication_tpu.train.step import create_train_state
+
+_DATA_AXES = ("data", "fsdp")
+_PIPE_AXIS = "pipeline"
+
+
+# ---------------------------------------------------------------------------
+# Param layout: list-of-blocks <-> stage-stacked
+
+
+def stack_blocks(params: dict) -> dict:
+    """Model params with the per-layer ``blocks`` list stacked on a leading
+    layer axis (so it can shard ``P('pipeline')``). All other entries
+    (embeddings, final norm, lm head) pass through unchanged."""
+    out = dict(params)
+    out["blocks"] = common.stack_block_list(params["blocks"])
+    return out
+
+
+def unstack_blocks(params: dict, n_layer: int) -> dict:
+    """Inverse of :func:`stack_blocks` — back to the list layout the
+    single-device/GSPMD paths and ``save_pretrained`` use."""
+    out = dict(params)
+    out["blocks"] = common.unstack_block_tree(params["blocks"], n_layer)
+    return out
+
+
+def _path_names(path) -> list:
+    return [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+
+
+def _pipe_spec(path, leaf) -> P:
+    """Stacked block leaves shard their leading (layer) axis over
+    ``pipeline``; everything else — embed/head params, optimizer scalars —
+    replicates. Optimizer moments mirror the param tree so their paths
+    also contain ``blocks`` and inherit the stage sharding."""
+    if "blocks" in _path_names(path) and getattr(leaf, "ndim", 0) >= 1:
+        return P(_PIPE_AXIS)
+    return P()
+
+
+def pipeline_state_sharding(state, mesh: Mesh):
+    """NamedSharding pytree for a stage-stacked train state."""
+    specs = jax.tree_util.tree_map_with_path(_pipe_spec, state)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+# ---------------------------------------------------------------------------
+# The pipelined loss
+
+
+def _check_pipeline_cfg(model_cfg: ModelConfig, mesh: Mesh) -> int:
+    n_stages = mesh.shape.get(_PIPE_AXIS, 1)
+    if n_stages < 2:
+        raise ValueError(f"pipeline axis must be > 1, got mesh {dict(mesh.shape)}")
+    for ax in ("tensor", "sequence"):
+        if mesh.shape.get(ax, 1) != 1:
+            raise NotImplementedError(
+                f"pipeline parallelism composes with data/fsdp only; mesh has "
+                f"{ax}={mesh.shape[ax]} (use the GSPMD path, parallel/dp_step.py)"
+            )
+    if mesh.shape.get("fsdp", 1) != 1:
+        import warnings
+
+        warnings.warn(
+            "under pipeline parallelism the fsdp axis acts as a SECOND DATA "
+            "axis only: non-block params and all optimizer state are "
+            "replicated, not ZeRO-sharded (parallel/pipeline.py:_pipe_spec). "
+            "Use the GSPMD path (no --pipeline-parallel) for real parameter "
+            "sharding",
+            stacklevel=3,
+        )
+    if model_cfg.n_layer % n_stages:
+        raise ValueError(
+            f"n_layer={model_cfg.n_layer} not divisible by pipeline={n_stages}"
+        )
+    if model_cfg.dropout > 0.0:
+        raise NotImplementedError(
+            "pipeline step runs dropout-free (the reference default, "
+            "train.py:64); per-microbatch rng threading through the GPipe "
+            "schedule is not implemented"
+        )
+    return n_stages
+
+
+def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
+    """Returns ``loss(params_stacked, x, y) -> scalar`` where ``x``/``y``
+    are ``(M, B, T)`` microbatched token/target ids. The scalar is the
+    microbatch-mean loss, averaged over data shards — identical semantics
+    to the grad-accumulation scan in train/step.py."""
+    n_stages = _check_pipeline_cfg(model_cfg, mesh)
+    layers_per_stage = model_cfg.n_layer // n_stages
+    mod = model_module(model_cfg)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def spmd(blocks_loc, rest, x, y):
+        # blocks_loc: stage's stacked layers (leading axis layers_per_stage)
+        # rest: embed/ln_f/lm_head params, replicated; x/y: (M, B_loc, T)
+        stage = jax.lax.axis_index(_PIPE_AXIS)
+        M, B, T = x.shape
+        is_last = stage == n_stages - 1
+
+        h0 = jax.vmap(lambda xi: mod.embed(rest, xi, model_cfg))(x)
+        cos, sin = (
+            rope_cos_sin(model_cfg.head_size, T)
+            if mod.USES_ROPE
+            else (None, None)
+        )
+        mask = causal_mask(T)
+
+        def stage_fn(h):
+            def layer(h, xs):
+                blk, j = xs
+                li = stage * layers_per_stage + j + 1  # 1-based, traced
+                fn = lambda h, blk: mod.block_forward(
+                    h, blk, li, model_cfg, cos, sin, mask
+                )
+                if model_cfg.remat:
+                    fn = jax.checkpoint(fn)
+                return fn(h, blk), None
+
+            h, _ = jax.lax.scan(
+                layer, h, (blocks_loc, jnp.arange(layers_per_stage))
+            )
+            return h
+
+        def tick(carry, t):
+            state, outputs = carry
+            feed = h0[jnp.clip(t, 0, M - 1)]
+            inp = jnp.where(stage == 0, feed, state)
+            out = stage_fn(inp)
+            o_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+            valid = jnp.logical_and(is_last, t - (n_stages - 1) >= 0)
+            cur = jax.lax.dynamic_index_in_dim(outputs, o_idx, 0, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, out, cur), o_idx, 0
+            )
+            state = jax.lax.ppermute(out, _PIPE_AXIS, perm)
+            return (state, outputs), None
+
+        zeros = jnp.zeros_like(h0[0])
+        (_, outputs), _ = jax.lax.scan(
+            tick, (zeros, jnp.zeros_like(h0)), jnp.arange(M + n_stages - 1)
+        )
+
+        # Head + loss, scanned one microbatch at a time so the logits
+        # buffer is (B, T, V) rather than (M, B, T, V) — at the reference
+        # scale (V=12000, T=512) the vmapped form would be the largest
+        # tensor in the step, wasted on P-1 of P stages.
+        def mb_loss(acc, hy):
+            h, yi = hy
+            logits = common.apply_tail(h, rest)
+            return acc + common.cross_entropy_loss(logits, yi), None
+
+        loss_sum, _ = jax.lax.scan(mb_loss, jnp.zeros(()), (outputs, y))
+        loss_loc = jnp.where(is_last, loss_sum / M, 0.0)
+        loss = jax.lax.psum(loss_loc, _PIPE_AXIS)  # broadcast to all stages
+        return jax.lax.pmean(loss, _DATA_AXES)
+
+    smapped = jax.shard_map(
+        spmd,
+        mesh=mesh,
+        in_specs=(P(_PIPE_AXIS), P(), P(None, _DATA_AXES, None),
+                  P(None, _DATA_AXES, None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def loss_fn(params: dict, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        blocks = params["blocks"]
+        rest = {k: v for k, v in params.items() if k != "blocks"}
+        return smapped(blocks, rest, x, y)
+
+    return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# Train / eval steps
+
+
+def create_pipeline_train_state(key: jax.Array, cfg: TrainConfig, mesh: Mesh) -> dict:
+    """Train state in the stage-stacked layout, initialized directly onto
+    the mesh (each stage materializes only its own layers)."""
+    model_cfg = cfg.resolved_model()
+    _check_pipeline_cfg(model_cfg, mesh)
+    tx, _ = make_optimizer(cfg)
+
+    def init(k):
+        state = create_train_state(k, cfg)
+        params = stack_blocks(state["params"])
+        return {
+            "params": params,
+            "opt_state": tx.init(params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    abstract = jax.eval_shape(init, key)
+    sh = pipeline_state_sharding(abstract, mesh)
+    return jax.jit(init, out_shardings=sh)(key)
+
+
+def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict):
+    """``step(state, batch, rng=None) -> (state, metrics)`` — same contract
+    and metrics as the GSPMD step (parallel/dp_step.py), compiled over the
+    pipeline mesh. ``batch['x']``/``['y']`` are ``(A, B, T)``: the
+    grad-accumulation axis doubles as the pipeline microbatch stream."""
+    model_cfg = cfg.resolved_model()
+    n_stages = _check_pipeline_cfg(model_cfg, mesh)
+    data_shards = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    if cfg.micro_batch_size % data_shards:
+        raise ValueError(
+            f"micro_batch_size={cfg.micro_batch_size} not divisible by the "
+            f"data*fsdp shard count {data_shards} (mesh {dict(mesh.shape)})"
+        )
+    if cfg.grad_acc_steps < n_stages:
+        import warnings
+
+        warnings.warn(
+            f"grad_acc_steps={cfg.grad_acc_steps} < pipeline stages "
+            f"{n_stages}: the GPipe bubble dominates; use at least "
+            f"{n_stages} (ideally a few x) microbatches",
+            stacklevel=2,
+        )
+    tx, schedule = make_optimizer(cfg)
+    loss_f = make_pipeline_loss(model_cfg, mesh)
+
+    def raw_step(state, batch, rng=None):
+        del rng  # dropout-free by construction (checked above)
+        loss, grads = jax.value_and_grad(loss_f)(
+            state["params"], batch["x"], batch["y"]
+        )
+        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        metrics = {
+            "loss": loss,
+            "learning_rate": schedule(state["step"]),
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    st_sh = pipeline_state_sharding(state_template, mesh)
+    b_sh = NamedSharding(mesh, P(None, _DATA_AXES, None))
+    jitted = jax.jit(
+        raw_step,
+        in_shardings=(st_sh, {"x": b_sh, "y": b_sh}, None),
+        out_shardings=(st_sh, None),
+        donate_argnums=(0,),
+    )
+
+    def step(state: dict, batch: dict, rng=None):
+        return jitted(state, batch, rng)
+
+    return step
+
+
+def make_pipeline_eval_step(cfg: TrainConfig, mesh: Mesh):
+    """``eval_step(params, x, y) -> loss`` on stage-stacked params; ``x``
+    is a single (B, T) batch, run through the pipeline as one microbatch
+    (bubble-heavy but exact — eval cost is dominated by eval_iters anyway,
+    train.py:125-139)."""
+    model_cfg = cfg.resolved_model()
+    loss_f = make_pipeline_loss(model_cfg, mesh)
+
+    @jax.jit
+    def eval_step(params: dict, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        return loss_f(params, x[None], y[None])
+
+    return eval_step

@@ -25,16 +25,66 @@ import jax
 from flax import serialization
 
 from differential_transformer_replication_tpu.config import ModelConfig, TrainConfig
-from differential_transformer_replication_tpu.models import init_model
+from differential_transformer_replication_tpu.models import common, init_model
+
+
+def _map_blocks(tree, fn):
+    """Apply ``fn`` to every subtree stored under a ``"blocks"`` key,
+    anywhere in the state pytree — params AND the optimizer moments that
+    mirror them (optax namedtuple states are rebuilt field-wise)."""
+    if isinstance(tree, dict):
+        return {
+            k: (fn(v) if k == "blocks" else _map_blocks(v, fn))
+            for k, v in tree.items()
+        }
+    if isinstance(tree, tuple):
+        vals = [_map_blocks(v, fn) for v in tree]
+        if hasattr(tree, "_fields"):  # namedtuple (optax states)
+            return type(tree)(*vals)
+        return tuple(vals)
+    if isinstance(tree, list):
+        return [_map_blocks(v, fn) for v in tree]
+    return tree
+
+
+def _is_stacked(state: dict) -> bool:
+    """Pipeline runs keep ``blocks`` as ONE dict of layer-stacked arrays
+    (parallel/pipeline.py:stack_blocks); the canonical layout is a list of
+    per-layer dicts."""
+    return isinstance(state["params"]["blocks"], dict)
+
+
+def canonicalize_state(state: dict, n_layer: int) -> dict:
+    """Stage-stacked -> canonical list-of-blocks throughout the state
+    (params and mirrored optimizer moments), so the on-disk format — and
+    ``train()``'s return value — is one layout regardless of which
+    parallelism trained it (sample.py and cross-topology resume depend on
+    this). Layout transforms live in models/common.py."""
+    return _map_blocks(
+        state, lambda blocks: common.unstack_block_tree(blocks, n_layer)
+    )
+
+
+def _stack(state: dict) -> dict:
+    """Canonical list-of-blocks -> stage-stacked (inverse of
+    :func:`canonicalize_state`), applied after loading into a pipeline run."""
+    import numpy as np
+
+    return _map_blocks(
+        state, lambda blocks: common.stack_block_list(blocks, stack_fn=np.stack)
+    )
 
 
 def save_checkpoint(
     path: str, state: dict, best_val_loss: float, cfg: TrainConfig
 ) -> None:
     """train.py:310-317 equivalent (model+optimizer+scheduler state; the
-    schedule is stateless here, so `step` covers it)."""
+    schedule is stateless here, so `step` covers it). Always written in
+    the canonical list-of-blocks layout."""
     os.makedirs(path, exist_ok=True)
     state = jax.device_get(state)
+    if _is_stacked(state):
+        state = canonicalize_state(state, cfg.resolved_model().n_layer)
     meta = {
         "best_val_loss": float(best_val_loss),
         "iter_num": int(state["step"]),
@@ -59,13 +109,20 @@ def _atomic_write(dest: str, data: bytes) -> None:
 
 def load_checkpoint(path: str, cfg: TrainConfig, target_state: dict) -> Tuple[dict, float]:
     """Restore (state, best_val_loss). ``target_state`` supplies the pytree
-    structure (create_train_state output)."""
+    structure (create_train_state output). A stage-stacked target (pipeline
+    run) is transparently loaded from the canonical on-disk layout and
+    re-stacked, so checkpoints move freely across parallelism topologies."""
     if not os.path.isfile(os.path.join(path, "state.msgpack")):
         raise FileNotFoundError(
             f"no checkpoint at {path!r} (expected {path}/state.msgpack)"
         )
+    stacked = _is_stacked(target_state)
+    if stacked:
+        target_state = canonicalize_state(target_state, cfg.resolved_model().n_layer)
     with open(os.path.join(path, "state.msgpack"), "rb") as f:
         state = serialization.from_bytes(target_state, f.read())
+    if stacked:
+        state = _stack(state)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     return state, meta["best_val_loss"]

@@ -172,7 +172,30 @@ def train(cfg: TrainConfig) -> dict:
     cfg = cfg.replace(vocab_size=vocab_size)
 
     logger = MetricLogger(cfg)
-    if cfg.mesh.n_devices > 1:
+    if cfg.mesh.pipeline > 1:
+        # Pipeline-parallel path: GPipe schedule over the pipeline axis
+        # (parallel/pipeline.py); eval runs through the same pipeline.
+        from differential_transformer_replication_tpu.parallel import create_mesh
+        from differential_transformer_replication_tpu.parallel.pipeline import (
+            create_pipeline_train_state,
+            make_pipeline_eval_step,
+            make_pipeline_train_step,
+            pipeline_state_sharding,
+        )
+
+        mesh = create_mesh(cfg.mesh)
+        print(f"Mesh: {dict(mesh.shape)}")
+        state = create_pipeline_train_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
+        best_val_loss = float("inf")
+        if cfg.resume_from:
+            host_state = jax.tree_util.tree_map(jax.device_get, state)
+            host_state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, host_state)
+            sh = pipeline_state_sharding(host_state, mesh)
+            state = jax.tree_util.tree_map(jax.device_put, host_state, sh)
+            print(f"Resumed from {cfg.resume_from} at iter {int(jax.device_get(state['step']))}")
+        train_step = make_pipeline_train_step(cfg, mesh, state)
+        eval_step = make_pipeline_eval_step(cfg, mesh)
+    elif cfg.mesh.n_devices > 1:
         # Sharded path: mesh + partitioned step (the DDP/NCCL replacement).
         from differential_transformer_replication_tpu.parallel import (
             create_mesh,
@@ -205,7 +228,8 @@ def train(cfg: TrainConfig) -> dict:
             state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, state)
             print(f"Resumed from {cfg.resume_from} at iter {int(state['step'])}")
         train_step = make_train_step(cfg)
-    eval_step = make_eval_step(cfg, mesh=eval_mesh)
+    if cfg.mesh.pipeline <= 1:
+        eval_step = make_eval_step(cfg, mesh=eval_mesh)
 
     data_rng = np.random.default_rng(cfg.seed)
     eval_rng = np.random.default_rng(cfg.seed + 1)
@@ -290,4 +314,16 @@ def train(cfg: TrainConfig) -> dict:
     finally:
         profiler.close()
         logger.finish()
+    if cfg.mesh.pipeline > 1:
+        # return the canonical list-of-blocks layout, like every other
+        # path, so callers (tools/ppl_gap.py-style eval, model_forward)
+        # work regardless of the training topology
+        from differential_transformer_replication_tpu.train.checkpoint import (
+            canonicalize_state,
+        )
+
+        state = canonicalize_state(
+            jax.tree_util.tree_map(jax.device_get, state),
+            cfg.resolved_model().n_layer,
+        )
     return state

@@ -53,7 +53,9 @@ def make_batch(key, n_micro=1, batch=8, t=16, vocab=128):
 class TestMesh:
     def test_create_mesh_shapes(self):
         mesh = create_mesh(MeshConfig(data=2, fsdp=1, tensor=2, sequence=2))
-        assert mesh.shape == {"data": 2, "fsdp": 1, "tensor": 2, "sequence": 2}
+        assert mesh.shape == {
+            "pipeline": 1, "data": 2, "fsdp": 1, "tensor": 2, "sequence": 2,
+        }
 
     def test_too_many_devices_raises(self):
         with pytest.raises(ValueError, match="devices"):

@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="devices on the fsdp (param-sharding) mesh axis")
     p.add_argument("--sequence-parallel", type=int, default=1,
                    help="devices on the sequence mesh axis (ring attention)")
+    p.add_argument("--pipeline-parallel", type=int, default=1,
+                   help="devices on the pipeline mesh axis (GPipe stages; "
+                        "grad-acc microbatches stream through the stages — "
+                        "use --grad-acc-steps >= stages)")
     return p
 
 
@@ -89,7 +93,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     )
     return TrainConfig(
         model=model,
-        mesh=MeshConfig(data=args.data_parallel, fsdp=args.fsdp,
+        mesh=MeshConfig(pipeline=args.pipeline_parallel,
+                        data=args.data_parallel, fsdp=args.fsdp,
                         tensor=args.tensor_parallel,
                         sequence=args.sequence_parallel),
         dataset=args.dataset,
