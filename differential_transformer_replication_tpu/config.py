@@ -49,6 +49,14 @@ class ModelConfig:
     # activation memory — the standard TPU lever for bigger micro-batches
     # or longer contexts (no reference analog; it keeps all activations).
     remat: bool = False
+    # Fused chunked linear+cross-entropy (ops/losses.py): when set, the
+    # training loss never materializes the (B, T, V) logits — it scans
+    # position-chunks of this size through the lm head with a
+    # recompute-backward. The long-context companion to the flash kernels
+    # (the full logits tensor, not attention, is the memory wall once
+    # flash is on). forward() then returns (None, loss) when targets are
+    # given. None = dense loss (the reference's shape, control.py:153-159).
+    loss_chunk: Optional[int] = None
 
     def __post_init__(self):
         if self.model not in MODEL_KINDS:
@@ -58,6 +66,8 @@ class ModelConfig:
                 "attention_impl must be 'xla' or 'pallas', got "
                 f"{self.attention_impl!r}"
             )
+        if self.loss_chunk is not None and self.loss_chunk < 1:
+            raise ValueError(f"loss_chunk must be positive, got {self.loss_chunk}")
         if self.model == "ndiff" and self.n_terms < 1:
             raise ValueError(
                 "n_terms must be >= 1 (the reference's n_terms=0 config, "

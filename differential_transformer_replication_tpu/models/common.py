@@ -89,6 +89,22 @@ def apply_tail(x: jnp.ndarray, params: dict) -> jnp.ndarray:
     return linear(x, params["lm_head"])
 
 
+def fused_tail_loss(
+    x: jnp.ndarray, params: dict, targets: jnp.ndarray, chunk: int
+) -> jnp.ndarray:
+    """Final LayerNorm + chunked fused lm-head/cross-entropy
+    (ops/losses.py) — the loss of :func:`apply_tail` +
+    :func:`cross_entropy_loss` without ever materializing (B, T, V)
+    logits."""
+    from differential_transformer_replication_tpu.ops.losses import (
+        fused_linear_cross_entropy,
+    )
+
+    x = apply_layer_norm(x, params["ln_f"])
+    p = params["lm_head"]
+    return fused_linear_cross_entropy(x, p["w"], p.get("b"), targets, chunk)
+
+
 def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     """Mean cross-entropy over all (B*T) positions, matching the flattened
     ``F.cross_entropy`` call (control.py:153-159). Computed in float32."""
@@ -96,6 +112,20 @@ def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def tail_and_loss(x, params: dict, cfg, targets):
+    """The shared end-of-forward dispatch for all three families: final
+    LayerNorm + lm head + (optional) loss. With ``cfg.loss_chunk`` set and
+    targets given, routes through the fused chunked loss (ops/losses.py)
+    and returns ``(None, loss)`` — logits are never materialized by
+    design. Otherwise the reference's dense shape: ``(logits, loss|None)``
+    (control.py:147-159)."""
+    if targets is not None and cfg.loss_chunk:
+        return None, fused_tail_loss(x, params, targets, cfg.loss_chunk)
+    logits = apply_tail(x, params)
+    loss = None if targets is None else cross_entropy_loss(logits, targets)
+    return logits, loss
 
 
 def split_rng(rng: Optional[jax.Array], n: int):

@@ -181,6 +181,4 @@ def forward(
         if cfg.remat:  # recompute this block's activations in the backward
             fn = jax.checkpoint(fn, static_argnums=(2, 3, 8))
         x = fn(x, blk, li, cfg, None, None, mask, r, mesh)
-    logits = common.apply_tail(x, params)
-    loss = None if targets is None else common.cross_entropy_loss(logits, targets)
-    return logits, loss
+    return common.tail_and_loss(x, params, cfg, targets)

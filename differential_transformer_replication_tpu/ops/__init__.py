@@ -20,6 +20,9 @@ from differential_transformer_replication_tpu.ops.flash import (
     flash_diff_attention,
     flash_ndiff_attention,
 )
+from differential_transformer_replication_tpu.ops.losses import (
+    fused_linear_cross_entropy,
+)
 
 __all__ = [
     "rope_cos_sin",
@@ -40,4 +43,5 @@ __all__ = [
     "flash_vanilla_attention",
     "flash_diff_attention",
     "flash_ndiff_attention",
+    "fused_linear_cross_entropy",
 ]

@@ -1,0 +1,138 @@
+"""Fused (chunked) linear + cross-entropy loss.
+
+The reference computes the LM loss by materializing full logits and
+calling ``F.cross_entropy`` on the flattened ``(B*T, V)`` tensor
+(control.py:153-159, identical in the other families). The dense
+equivalent here (models/common.py:cross_entropy_loss) does the same — at
+long context that tensor IS the memory wall: (B, T, V) bf16 logits plus
+an fp32 copy for the softmax, e.g. ~1.2 GB at T=16384, V=12000, B=1,
+dwarfing every activation the flash kernels (ops/flash.py) were built to
+avoid.
+
+This op never materializes more than one chunk of logits. Forward scans
+position-chunks of the pre-head activations, computing each chunk's
+logits + log-softmax + target gather on the fly; the custom VJP
+recomputes each chunk's logits in the backward (the same
+trade-the-matmul-for-memory bargain as flash attention) and emits
+``dlogits = softmax - onehot`` chunk-locally, accumulating the lm-head
+weight/bias grads in fp32 carries. Peak extra memory is
+O(chunk * V) instead of O(B * T * V).
+
+Numerics match the dense path operation-for-operation: the chunk matmul
+runs in the activations' compute dtype (bf16 on TPU), logits are then
+upcast to fp32 for log-softmax (models/common.py:cross_entropy_loss), and
+the mean is over all B*T positions. Chunking over positions cannot change
+per-position values — softmax is position-local — so the only deviation
+from dense is fp32 summation order.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_chunks(h2: jnp.ndarray, t1: jnp.ndarray, chunk: int):
+    """(N, E) activations + (N,) targets -> (C, chunk, ...) with a validity
+    mask for the tail padding."""
+    n = h2.shape[0]
+    pad = (-n) % chunk
+    mask = jnp.ones((n,), jnp.float32)
+    if pad:
+        h2 = jnp.pad(h2, ((0, pad), (0, 0)))
+        t1 = jnp.pad(t1, ((0, pad),))
+        mask = jnp.pad(mask, ((0, pad),))
+    c = h2.shape[0] // chunk
+    return (
+        h2.reshape(c, chunk, -1),
+        t1.reshape(c, chunk),
+        mask.reshape(c, chunk),
+    )
+
+
+def _chunk_logp(hc, tc, w, b):
+    """One chunk's fp32 (log-probs at targets, logits) — the dense path's
+    op sequence: compute-dtype matmul, fp32 upcast, log_softmax, gather."""
+    logits = hc @ w.astype(hc.dtype)
+    if b is not None:
+        logits = logits + b.astype(hc.dtype)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, tc[:, None], axis=-1)[:, 0]
+    return ll, logits
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_linear_cross_entropy(
+    h: jnp.ndarray,
+    w: jnp.ndarray,
+    b: Optional[jnp.ndarray],
+    targets: jnp.ndarray,
+    chunk: int = 2048,
+) -> jnp.ndarray:
+    """Mean cross-entropy of ``logits = h @ w (+ b)`` against ``targets``
+    without materializing the full logits tensor.
+
+    ``h``: (..., E) pre-head activations (compute dtype); ``w``: (E, V)
+    fp32 lm-head weight; ``b``: (V,) bias or None; ``targets``: int (...)
+    matching h's leading dims. ``chunk``: positions per scanned block.
+    """
+    h2 = h.reshape(-1, h.shape[-1])
+    t1 = targets.reshape(-1)
+    n = h2.shape[0]
+    hc, tc, mc = _pad_chunks(h2, t1, chunk)
+
+    def body(acc, xs):
+        hcb, tcb, mcb = xs
+        ll, _ = _chunk_logp(hcb, tcb, w, b)
+        return acc + jnp.sum(ll * mcb), None
+
+    loss_sum, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hc, tc, mc))
+    return -loss_sum / n
+
+
+def _fwd(h, w, b, targets, chunk):
+    return fused_linear_cross_entropy(h, w, b, targets, chunk), (h, w, b, targets)
+
+
+def _bwd(chunk, res, g):
+    h, w, b, targets = res
+    h2 = h.reshape(-1, h.shape[-1])
+    t1 = targets.reshape(-1)
+    n = h2.shape[0]
+    hc, tc, mc = _pad_chunks(h2, t1, chunk)
+    # d(loss)/d(logits) per position: (softmax - onehot) * (-(-1)) * g / n;
+    # loss = -sum(ll)/n so dlogits = (softmax - onehot) * g / n
+    scale = (g / n).astype(jnp.float32)
+    wc = w.astype(h.dtype)
+
+    def body(carry, xs):
+        dw_acc, db_acc = carry
+        hcb, tcb, mcb = xs
+        _, logits = _chunk_logp(hcb, tcb, w, b)
+        probs = jax.nn.softmax(logits, axis=-1)
+        dlog = probs.at[jnp.arange(tcb.shape[0]), tcb].add(-1.0)
+        dlog = dlog * (mcb[:, None] * scale)
+        # the dense path's cast structure: fp32 softmax-grad cast to the
+        # compute dtype before the two matmuls, fp32 param-grad accumulate
+        dlog_c = dlog.astype(h.dtype)
+        dh_b = dlog_c @ wc.T
+        dw_b = (hcb.T @ dlog_c).astype(jnp.float32)
+        db_b = jnp.sum(dlog, axis=0)
+        return (dw_acc + dw_b, db_acc + db_b), dh_b
+
+    (dw, db), dh = jax.lax.scan(
+        body,
+        (jnp.zeros(w.shape, jnp.float32), jnp.zeros((w.shape[1],), jnp.float32)),
+        (hc, tc, mc),
+    )
+    dh = dh.reshape(-1, h.shape[-1])[:n].reshape(h.shape)
+    d_targets = jnp.zeros(targets.shape, jax.dtypes.float0)
+    db_out = None if b is None else db.astype(b.dtype)
+    return dh, dw.astype(w.dtype), db_out, d_targets
+
+
+fused_linear_cross_entropy.defvjp(_fwd, _bwd)

@@ -213,11 +213,13 @@ def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
         # Head + loss, scanned one microbatch at a time so the logits
         # buffer is (B, T, V) rather than (M, B, T, V) — at the reference
         # scale (V=12000, T=512) the vmapped form would be the largest
-        # tensor in the step, wasted on P-1 of P stages.
+        # tensor in the step, wasted on P-1 of P stages. tail_and_loss
+        # honors cfg.loss_chunk (the fused chunked head, ops/losses.py)
+        # here too.
         def mb_loss(acc, hy):
             h, yi = hy
-            logits = common.apply_tail(h, rest)
-            return acc + common.cross_entropy_loss(logits, yi), None
+            _, loss = common.tail_and_loss(h, rest, model_cfg, yi)
+            return acc + loss, None
 
         loss_sum, _ = jax.lax.scan(mb_loss, jnp.zeros(()), (outputs, y))
         loss_loc = jnp.where(is_last, loss_sum / M, 0.0)

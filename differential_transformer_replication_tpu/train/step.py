@@ -62,22 +62,33 @@ def make_step_fn(cfg: TrainConfig, mesh=None):
     grad_fn = jax.value_and_grad(loss_fn)
 
     def step(state: dict, batch: dict, rng: Optional[jax.Array] = None):
-        def micro(carry, xs):
-            grads_acc, loss_acc, i = carry
-            x, y = xs
-            r = None if rng is None else jax.random.fold_in(rng, i)
-            loss, grads = grad_fn(state["params"], x, y, model_cfg, r, mesh)
-            grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
-            return (grads_acc, loss_acc + loss, i + 1), None
-
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, state["params"])
-        (grads, loss_sum, _), _ = jax.lax.scan(
-            micro, (zeros, jnp.zeros(()), jnp.zeros((), jnp.int32)),
-            (batch["x"], batch["y"]),
-        )
         n_micro = batch["x"].shape[0]
-        grads = jax.tree_util.tree_map(lambda g: g / n_micro, grads)
-        loss = loss_sum / n_micro
+        if n_micro == 1:
+            # the reference default (grad_acc_steps=1, train.py:68): skip
+            # the scan entirely — the zero-init + accumulate + loop
+            # slice/carry machinery costs ~5% of the step at recipe scale
+            # (measured via profile; the adds alone pass over all 94M
+            # params) for a one-iteration loop
+            r = None if rng is None else jax.random.fold_in(rng, 0)
+            loss, grads = grad_fn(
+                state["params"], batch["x"][0], batch["y"][0], model_cfg, r, mesh
+            )
+        else:
+            def micro(carry, xs):
+                grads_acc, loss_acc, i = carry
+                x, y = xs
+                r = None if rng is None else jax.random.fold_in(rng, i)
+                loss, grads = grad_fn(state["params"], x, y, model_cfg, r, mesh)
+                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+                return (grads_acc, loss_acc + loss, i + 1), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, state["params"])
+            (grads, loss_sum, _), _ = jax.lax.scan(
+                micro, (zeros, jnp.zeros(()), jnp.zeros((), jnp.int32)),
+                (batch["x"], batch["y"]),
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / n_micro, grads)
+            loss = loss_sum / n_micro
 
         updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
         params = optax.apply_updates(state["params"], updates)

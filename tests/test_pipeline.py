@@ -113,6 +113,22 @@ class TestPipelineParity:
         got = make_pipeline_loss(m, mesh)(stack_blocks(params), x, y)
         np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
 
+    def test_loss_chunk_matches(self):
+        # the fused chunked lm-head loss (ops/losses.py, custom_vjp)
+        # composes with the GPipe shard_map schedule
+        m = tiny_model("diff").replace(loss_chunk=8)
+        mesh = create_mesh(MeshConfig(pipeline=4, data=2))
+        params = init_model(jax.random.PRNGKey(0), m)
+        x, y = microbatches(jax.random.PRNGKey(1), m)
+        ref = reference_mean_loss(params, x, y, m)
+        loss_f = make_pipeline_loss(m, mesh)
+        got = loss_f(stack_blocks(params), x, y)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+        g = jax.grad(loss_f)(stack_blocks(params), x, y)
+        assert all(
+            bool(jnp.all(jnp.isfinite(l))) for l in jax.tree_util.tree_leaves(g)
+        )
+
     def test_remat_matches(self):
         m = tiny_model("diff").replace(remat=True)
         mesh = create_mesh(MeshConfig(pipeline=4, data=2))

@@ -36,6 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-terms", type=int, default=m.n_terms)
     p.add_argument("--compute-dtype", default=m.compute_dtype)
     p.add_argument("--attention-impl", choices=("xla", "pallas"), default=m.attention_impl)
+    p.add_argument("--loss-chunk", type=int, default=None,
+                   help="fused chunked lm-head loss: positions per chunk "
+                        "(never materializes full logits; for long context)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks on backward (less activation memory)")
 
@@ -90,6 +93,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         compute_dtype=args.compute_dtype,
         attention_impl=args.attention_impl,
         remat=args.remat,
+        loss_chunk=args.loss_chunk,
     )
     return TrainConfig(
         model=model,
