@@ -109,11 +109,23 @@ def main() -> None:
             }
         )
     )
-    # diagnostics on stderr so stdout stays one JSON line
+    # diagnostics on stderr so stdout stays one JSON line. MFU uses the
+    # standard 6*N*D train-FLOPs estimate over non-embedding params
+    # (matmul-bearing: everything but tok/pos tables) against the v5e
+    # bf16 peak; it is an underestimate (ignores attention's O(T^2) term).
+    from differential_transformer_replication_tpu.models import param_count
+
+    n_params = param_count(state["params"])
+    n_embed = model.vocab_size * model.n_embd + (
+        model.block_size * model.n_embd if model_kind == "diff" else 0
+    )
+    flops_per_tok = 6 * (n_params - n_embed)
+    peak = 197e12  # TPU v5e bf16 peak FLOP/s
     print(
         f"[bench] model={model_kind} attn={attn} device={jax.devices()[0].device_kind} "
         f"micro_batch={micro_batch} block={T} steps={steps} "
-        f"sec/step={dt / steps:.4f} loss={float(metrics['loss']):.4f}",
+        f"sec/step={dt / steps:.4f} loss={float(metrics['loss']):.4f} "
+        f"mfu~{tps * flops_per_tok / peak:.1%}",
         file=sys.stderr,
     )
 

@@ -21,6 +21,9 @@ import jax.numpy as jnp
 
 from differential_transformer_replication_tpu.ops import layer_norm, swiglu
 from differential_transformer_replication_tpu.ops.dropout import dropout
+from differential_transformer_replication_tpu.ops.losses import (
+    fused_linear_cross_entropy,
+)
 
 INIT_STD = 0.02  # control.py:134
 
@@ -96,10 +99,6 @@ def fused_tail_loss(
     (ops/losses.py) — the loss of :func:`apply_tail` +
     :func:`cross_entropy_loss` without ever materializing (B, T, V)
     logits."""
-    from differential_transformer_replication_tpu.ops.losses import (
-        fused_linear_cross_entropy,
-    )
-
     x = apply_layer_norm(x, params["ln_f"])
     p = params["lm_head"]
     return fused_linear_cross_entropy(x, p["w"], p.get("b"), targets, chunk)

@@ -98,6 +98,10 @@ def diff_attention(
         rng1, rng2 = jax.random.split(rng)
     att1 = _probs(q1, k1, mask, dropout_rate, rng1)
     att2 = _probs(q2, k2, mask, dropout_rate, rng2)
+    # NOTE: combining on the maps (not out = att1@v - lam*(att2@v), which
+    # is algebraically equal) measured FASTER — XLA fuses this subtract
+    # into the value matmul, while the restructured form doubles the PV
+    # matmuls (174.8k -> 170.2k tok/s at recipe scale when tried).
     diff = att1 - lam[None, :, None, None] * att2  # fp32 combine
     return jnp.einsum("bhts,bshd->bthd", diff.astype(v.dtype), v)
 

@@ -167,7 +167,6 @@ def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
         M, B, T = x.shape
         is_last = stage == n_stages - 1
 
-        h0 = jax.vmap(lambda xi: mod.embed(rest, xi, model_cfg))(x)
         cos, sin = (
             rope_cos_sin(model_cfg.head_size, T)
             if mod.USES_ROPE
@@ -193,7 +192,11 @@ def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
 
         def tick(carry, t):
             state, outputs = carry
-            feed = h0[jnp.clip(t, 0, M - 1)]
+            # embed the fed microbatch lazily inside the tick (token-id
+            # gather, cheap every tick) instead of prefetching all M
+            # embedded microbatches — that buffer was (M, B, T, E), the
+            # largest tensor in the schedule at long context
+            feed = mod.embed(rest, x[jnp.clip(t, 0, M - 1)], model_cfg)
             inp = jnp.where(stage == 0, feed, state)
             out = stage_fn(inp)
             o_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
@@ -205,9 +208,12 @@ def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
             state = jax.lax.ppermute(out, _PIPE_AXIS, perm)
             return (state, outputs), None
 
-        zeros = jnp.zeros_like(h0[0])
+        E = rest["tok_emb"].shape[-1]
+        compute = jnp.dtype(model_cfg.compute_dtype)
         (_, outputs), _ = jax.lax.scan(
-            tick, (zeros, jnp.zeros_like(h0)), jnp.arange(M + n_stages - 1)
+            tick,
+            (jnp.zeros((B, T, E), compute), jnp.zeros((M, B, T, E), compute)),
+            jnp.arange(M + n_stages - 1),
         )
 
         # Head + loss, scanned one microbatch at a time so the logits
