@@ -185,6 +185,9 @@ class TrainConfig:
 
     # Checkpointing (train.py:307-317 saved; resume is new capability)
     checkpoint_path: str = "best_model.ckpt"
+    # Preemption safety: a resumable last-state checkpoint written on ANY
+    # trainer exit (SIGTERM, Ctrl-C, crash, completion). None disables.
+    last_checkpoint_path: Optional[str] = "last_model.ckpt"
     resume_from: Optional[str] = None
 
     seed: int = 1337  # train.py:329-330

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from differential_transformer_replication_tpu.config import ModelConfig
+from differential_transformer_replication_tpu.models.generate import sample_token
 from differential_transformer_replication_tpu.models import common
 from differential_transformer_replication_tpu.ops import (
     apply_rope,
@@ -193,13 +194,17 @@ def forward_chunk(
     return logits, new_cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+@partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k")
+)
 def generate_cached(
     params: dict,
     idx: jnp.ndarray,
     cfg: ModelConfig,
     max_new_tokens: int,
     rng: jax.Array,
+    temperature: float = 1.0,
+    top_k=None,
 ) -> jnp.ndarray:
     """KV-cached counterpart of models/generate.py: same sampling contract
     (temperature-1 categorical over the last position, prompt included in
@@ -220,8 +225,8 @@ def generate_cached(
     samples = jnp.zeros((B, max_new_tokens), idx.dtype)
 
     rng, key0 = jax.random.split(rng)
-    first = jax.random.categorical(
-        key0, logits[:, -1, :].astype(jnp.float32), axis=-1
+    first = sample_token(
+        key0, logits[:, -1, :].astype(jnp.float32), temperature, top_k
     ).astype(idx.dtype)
     samples = samples.at[:, 0].set(first)
 
@@ -232,8 +237,8 @@ def generate_cached(
         logits, cache = forward_chunk(
             params, prev[:, None], T0 + i - 1, cache, cfg
         )
-        nxt = jax.random.categorical(
-            key, logits[:, -1, :].astype(jnp.float32), axis=-1
+        nxt = sample_token(
+            key, logits[:, -1, :].astype(jnp.float32), temperature, top_k
         ).astype(samples.dtype)
         samples = samples.at[:, i].set(nxt)
         return cache, samples, rng

@@ -26,13 +26,40 @@ from differential_transformer_replication_tpu.config import ModelConfig
 from differential_transformer_replication_tpu.models.registry import model_forward
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def sample_token(
+    key: jax.Array,
+    logits: jnp.ndarray,
+    temperature: float = 1.0,
+    top_k=None,
+) -> jnp.ndarray:
+    """One sampling step over (B, V) fp32 logits -> (B,) token ids.
+
+    Defaults reproduce the reference contract exactly: temperature 1, no
+    top-k (``torch.multinomial`` over softmax, control.py:168-169) — the
+    division by 1.0 is exact, so default draws are bit-identical to a
+    bare ``jax.random.categorical``. ``temperature <= 0`` means greedy
+    argmax; ``top_k`` keeps only the k highest logits (framework
+    extensions beyond the reference, off by default)."""
+    if top_k is not None:
+        k = max(1, min(int(top_k), logits.shape[-1]))  # clamp to [1, V]
+        vals = jax.lax.top_k(logits, k)[0]
+        logits = jnp.where(logits < vals[:, -1:], -jnp.inf, logits)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(key, logits / temperature, axis=-1)
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k")
+)
 def generate(
     params: dict,
     idx: jnp.ndarray,
     cfg: ModelConfig,
     max_new_tokens: int,
     rng: jax.Array,
+    temperature: float = 1.0,
+    top_k=None,
 ) -> jnp.ndarray:
     """idx: (B, T0) prompt with 0 < T0 <= block_size. Returns
     (B, T0 + max_new_tokens), prompt included, like the reference."""
@@ -50,7 +77,7 @@ def generate(
         logits, _ = model_forward(params, window, cfg)
         # logits at the last real position (control.py:167)
         last = logits[:, length - 1, :].astype(jnp.float32)
-        nxt = jax.random.categorical(sample_key, last, axis=-1).astype(window.dtype)
+        nxt = sample_token(sample_key, last, temperature, top_k).astype(window.dtype)
         samples = samples.at[:, i].set(nxt)
 
         def append(w):

@@ -275,12 +275,33 @@ def train(cfg: TrainConfig) -> dict:
     profiler = ProfilerWindow(
         cfg.profile_dir, start=int(jax.device_get(state["step"])) + 10
     )
+    # Preemption safety (SURVEY.md section 5.3 — the reference has none):
+    # SIGTERM requests a graceful stop; the finally block below writes a
+    # resumable last-state checkpoint on ANY exit (preemption, Ctrl-C,
+    # crash mid-run, or normal completion), so `--resume-from
+    # <last_checkpoint_path>` always continues from the latest step.
+    stop_requested = {"flag": False}
+
+    def _on_sigterm(signum, frame):
+        del signum, frame
+        stop_requested["flag"] = True
+
+    import signal
+
+    prev_handler = None
+    try:
+        prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (tests); SIGTERM stays default
     # Host-side iteration counter: the device `state["step"]` advances by
     # exactly 1 per call, and reading it back would force a host-device
     # sync every iteration, breaking async dispatch pipelining.
     iter_num = int(jax.device_get(state["step"]))
     try:
         while iter_num < cfg.max_iters:
+            if stop_requested["flag"]:
+                print(f"SIGTERM received: stopping at iter {iter_num}")
+                break
             batch = draw_batch()
             rng = jax.random.fold_in(dropout_key, iter_num) if use_dropout else None
             state, metrics = train_step(state, batch, rng)
@@ -314,6 +335,15 @@ def train(cfg: TrainConfig) -> dict:
     finally:
         profiler.close()
         logger.finish()
+        if cfg.last_checkpoint_path and is_primary():
+            # resumable last-state checkpoint, written whatever the exit
+            # path (save_checkpoint canonicalizes pipeline layouts). The
+            # SIGTERM handler is still ours here, so a follow-up SIGTERM
+            # during this save cannot kill the write; the atomic rename
+            # inside save_checkpoint protects against harder kills.
+            save_checkpoint(cfg.last_checkpoint_path, state, best_val_loss, cfg)
+        if prev_handler is not None:
+            signal.signal(signal.SIGTERM, prev_handler)
     if cfg.mesh.pipeline > 1:
         # return the canonical list-of-blocks layout, like every other
         # path, so callers (tools/ppl_gap.py-style eval, model_forward)

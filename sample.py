@@ -33,6 +33,10 @@ def main() -> None:
     p.add_argument("--max-new-tokens", type=int, default=200)
     p.add_argument("--n", type=int, default=1, help="samples to draw")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--temperature", type=float, default=1.0,
+                   help="sampling temperature; 0 = greedy (reference: 1.0)")
+    p.add_argument("--top-k", type=int, default=None,
+                   help="keep only the k highest logits (reference: off)")
     args = p.parse_args()
 
     from differential_transformer_replication_tpu.config import (
@@ -80,9 +84,11 @@ def main() -> None:
 
     rng = jax.random.PRNGKey(args.seed)
     if len(ids) + args.max_new_tokens <= model_cfg.block_size:
-        out = generate_cached(params, idx, model_cfg, args.max_new_tokens, rng)
+        out = generate_cached(params, idx, model_cfg, args.max_new_tokens, rng,
+                              temperature=args.temperature, top_k=args.top_k)
     else:  # sliding-window behavior past the context limit
-        out = generate(params, idx, model_cfg, args.max_new_tokens, rng)
+        out = generate(params, idx, model_cfg, args.max_new_tokens, rng,
+                       temperature=args.temperature, top_k=args.top_k)
 
     for i, row in enumerate(jax.device_get(out)):
         print(f"--- sample {i} ---")

@@ -42,6 +42,7 @@ def tiny_cfg(tmp_path, **kw):
         control_head_multiplier=1,
         tokenizer_dir=str(tmp_path / "tokenizer"),
         checkpoint_path=str(tmp_path / "ckpt"),
+        last_checkpoint_path=str(tmp_path / "last_ckpt"),
         metrics_path=str(tmp_path / "metrics.jsonl"),
         seed=7,
     )
@@ -94,6 +95,33 @@ class TestEndToEnd:
         cfg2 = cfg.replace(max_iters=20, resume_from=cfg.checkpoint_path)
         state = train(cfg2)
         assert int(state["step"]) == 20
+
+    def test_last_checkpoint_written_and_resumable(self, tmp_path):
+        """The preemption-safety checkpoint (SURVEY.md section 5.3): the
+        trainer writes a resumable last-state checkpoint on exit — unlike
+        the best-val checkpoint it reflects the FINAL step, so resume
+        continues exactly where the run stopped."""
+        import os
+
+        from differential_transformer_replication_tpu.train.checkpoint import (
+            load_checkpoint,
+        )
+        from differential_transformer_replication_tpu.train.step import (
+            create_train_state,
+        )
+
+        cfg = tiny_cfg(tmp_path, max_iters=12, eval_interval=10)
+        train(cfg)
+        assert os.path.isfile(
+            os.path.join(cfg.last_checkpoint_path, "state.msgpack")
+        )
+        target = jax.device_get(create_train_state(jax.random.PRNGKey(0), cfg))
+        restored, _ = load_checkpoint(cfg.last_checkpoint_path, cfg, target)
+        # best-val was written at iter 10; last reflects the final step 12
+        assert int(restored["step"]) == 12
+        cfg2 = cfg.replace(max_iters=16, resume_from=cfg.last_checkpoint_path)
+        state = train(cfg2)
+        assert int(state["step"]) == 16
 
 
 class TestCheckpoint:

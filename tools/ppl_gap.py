@@ -107,6 +107,7 @@ def main() -> None:
             vocab_size=args.vocab_size,
             seed=args.seed,
             checkpoint_path=f"ppl_gap_{kind}.ckpt",
+            last_checkpoint_path=f"ppl_gap_{kind}_last.ckpt",
             metrics_path=f"ppl_gap_{kind}.jsonl",
         )
         print(f"=== training {kind} ({args.iters} iters) ===")

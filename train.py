@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-iters", type=int, default=t.warmup_iters)
     p.add_argument("--seed", type=int, default=t.seed)
     p.add_argument("--checkpoint-path", default=t.checkpoint_path)
+    p.add_argument("--last-checkpoint-path", default=t.last_checkpoint_path,
+                   help="resumable last-state checkpoint written on any "
+                        "exit (SIGTERM/Ctrl-C/crash/completion); '' disables")
     p.add_argument("--resume-from", default=None)
     p.add_argument("--metrics-path", default=t.metrics_path)
     p.add_argument("--wandb", action="store_true", help="enable the wandb sink")
@@ -115,6 +118,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         warmup_iters=args.warmup_iters,
         seed=args.seed,
         checkpoint_path=args.checkpoint_path,
+        last_checkpoint_path=args.last_checkpoint_path or None,
         resume_from=args.resume_from,
         metrics_path=args.metrics_path,
         use_wandb=args.wandb,
