@@ -40,8 +40,8 @@ def sample_token(
     bare ``jax.random.categorical``. ``temperature <= 0`` means greedy
     argmax; ``top_k`` keeps only the k highest logits (framework
     extensions beyond the reference, off by default)."""
-    if top_k is not None:
-        k = max(1, min(int(top_k), logits.shape[-1]))  # clamp to [1, V]
+    if top_k is not None and int(top_k) > 0:  # <=0 means off (HF convention)
+        k = min(int(top_k), logits.shape[-1])  # clamp to vocab size
         vals = jax.lax.top_k(logits, k)[0]
         logits = jnp.where(logits < vals[:, -1:], -jnp.inf, logits)
     if temperature <= 0.0:

@@ -335,15 +335,24 @@ def train(cfg: TrainConfig) -> dict:
     finally:
         profiler.close()
         logger.finish()
-        if cfg.last_checkpoint_path and is_primary():
-            # resumable last-state checkpoint, written whatever the exit
-            # path (save_checkpoint canonicalizes pipeline layouts). The
-            # SIGTERM handler is still ours here, so a follow-up SIGTERM
-            # during this save cannot kill the write; the atomic rename
-            # inside save_checkpoint protects against harder kills.
-            save_checkpoint(cfg.last_checkpoint_path, state, best_val_loss, cfg)
-        if prev_handler is not None:
-            signal.signal(signal.SIGTERM, prev_handler)
+        try:
+            if cfg.last_checkpoint_path and is_primary():
+                # resumable last-state checkpoint, written whatever the
+                # exit path (save_checkpoint canonicalizes pipeline
+                # layouts). The SIGTERM handler is still ours here, so a
+                # follow-up SIGTERM during this save cannot kill the
+                # write; the atomic rename inside save_checkpoint
+                # protects against harder kills.
+                save_checkpoint(
+                    cfg.last_checkpoint_path, state, best_val_loss, cfg
+                )
+        except Exception as e:  # noqa: BLE001
+            # on the crash path the state itself may be poisoned (device
+            # OOM) — never let the rescue save mask the real exception
+            print(f"last-checkpoint save failed: {e!r}")
+        finally:
+            if prev_handler is not None:
+                signal.signal(signal.SIGTERM, prev_handler)
     if cfg.mesh.pipeline > 1:
         # return the canonical list-of-blocks layout, like every other
         # path, so callers (tools/ppl_gap.py-style eval, model_forward)

@@ -1,0 +1,235 @@
+"""Import reference (PyTorch) checkpoints into this framework.
+
+The reference saves weights in two formats: the best-model training blob
+(``torch.save({'model_state_dict': ...}, 'best_model.pt')``,
+train.py:309-316) and the N-diff ``save_pretrained`` directory
+(``{'model_args', 'model_state'}``, Ndiff_transformer.py:251-265). This
+module maps either state_dict onto this framework's param pytrees for
+all three families, so a user of the reference can bring trained weights
+straight over (and so the test suite can prove cross-implementation
+numerical parity against the reference's own forward pass,
+tests/test_torch_import.py).
+
+Layout translation (names from the reference modules):
+  - torch ``nn.Linear`` stores ``(out, in)``; we store ``(in, out)`` —
+    every weight is transposed,
+  - per-head ``nn.ModuleList`` projections (``heads.{h}.query1`` etc.,
+    diff_transformer.py:26-30) are stacked into our merged-head tensors
+    (``wq: (streams, E, H, d)``),
+  - ``GroupLayerNorm``'s ``(1, 1, C)`` affine params flatten to ``(C,)``,
+  - buffers (``tril``, ``lambda_init``, RoPE ``freqs``) are derived
+    quantities here and are skipped.
+
+torch is imported lazily: the framework never needs it unless a torch
+checkpoint is actually being imported.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from differential_transformer_replication_tpu.config import ModelConfig
+
+
+def _np(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy(), dtype=np.float32)
+
+
+def _lin(sd: dict, prefix: str) -> dict:
+    """torch Linear -> {'w': (in, out)[, 'b': (out,)]}."""
+    p = {"w": _np(sd[prefix + ".weight"]).T}
+    if prefix + ".bias" in sd:
+        p["b"] = _np(sd[prefix + ".bias"])
+    return p
+
+
+def _norm(sd: dict, prefix: str) -> dict:
+    return {
+        "w": _np(sd[prefix + ".weight"]).reshape(-1),
+        "b": _np(sd[prefix + ".bias"]).reshape(-1),
+    }
+
+
+def _ffn(sd: dict, prefix: str) -> dict:
+    """The reference FFN Sequential: SwiGLU (linear_gate/linear_xform) at
+    index 0, down-proj Linear at index 1 (control.py:100-104)."""
+    return {
+        "gate": _lin(sd, f"{prefix}.0.linear_gate"),
+        "xform": _lin(sd, f"{prefix}.0.linear_xform"),
+        "out": _lin(sd, f"{prefix}.1"),
+    }
+
+
+def infer_model_config(sd: dict, dropout: float = 0.0) -> ModelConfig:
+    """Reconstruct a ModelConfig from a reference state_dict's shapes.
+
+    The family is identified structurally: a position table means the
+    2-term DiffTransformer (the only variant with one,
+    diff_transformer.py:133-134); ``attn.heads`` means the vanilla
+    control; ``queries.0`` under diff_attn means the N-term model."""
+    vocab_size, n_embd = _np(sd["token_embedding_table.weight"]).shape
+    n_layer = 1 + max(
+        int(k.split(".")[1]) for k in sd if k.startswith("blocks.")
+    )
+    if "position_embedding_table.weight" in sd:
+        model = "diff"
+        attn = "diff_attn"
+        block_size = _np(sd["position_embedding_table.weight"]).shape[0]
+    elif any(".attn.heads." in k for k in sd):
+        model = "control"
+        attn = "attn"
+        block_size = sd["blocks.0.attn.heads.0.tril"].shape[0]
+    else:
+        model = "ndiff"
+        attn = "diff_attn"
+        block_size = sd["blocks.0.diff_attn.heads.0.tril"].shape[0]
+    # key shape: blocks.{i}.{attn}.heads.{h}.{...}; h is field 4
+    n_head = 1 + max(
+        int(k.split(".")[4])
+        for k in sd
+        if k.startswith(f"blocks.0.{attn}.heads.")
+    )
+    n_terms = 0
+    if model == "ndiff":
+        # blocks.0.diff_attn.heads.0.queries.{t}.weight; t is field 6
+        n_terms = 1 + max(
+            int(k.split(".")[6])
+            for k in sd
+            if k.startswith("blocks.0.diff_attn.heads.0.queries.")
+        )
+    return ModelConfig(
+        model=model,
+        vocab_size=int(vocab_size),
+        n_embd=int(n_embd),
+        n_head=int(n_head),
+        n_layer=int(n_layer),
+        block_size=int(block_size),
+        dropout=dropout,
+        n_terms=max(n_terms, 1) if model == "ndiff" else 4,
+    )
+
+
+def _stack_heads(sd, names, transpose=True):
+    """[per-head torch arrays] -> (E, H, d) (or (H, d) for vectors)."""
+    arrs = [_np(sd[n]) for n in names]
+    if transpose:
+        return np.stack([a.T for a in arrs], axis=1)  # (E, H, d)
+    return np.stack(arrs, axis=0)  # (H, d)
+
+
+def import_reference_state_dict(
+    sd: dict, cfg: Optional[ModelConfig] = None
+) -> Tuple[dict, ModelConfig]:
+    """Reference torch ``state_dict`` -> (this framework's params pytree,
+    inferred-or-given ModelConfig). Values are float32 numpy arrays (the
+    param dtype; compute dtype is applied at forward time)."""
+    if cfg is None:
+        cfg = infer_model_config(sd)
+    H, L = cfg.n_head, cfg.n_layer
+
+    params: dict = {
+        "tok_emb": _np(sd["token_embedding_table.weight"]),
+        "ln_f": _norm(sd, "ln_f"),
+        "lm_head": _lin(sd, "lm_head"),
+    }
+    if cfg.model == "diff":
+        params["pos_emb"] = _np(sd["position_embedding_table.weight"])
+
+    blocks = []
+    for i in range(L):
+        b = f"blocks.{i}"
+        if cfg.model == "control":
+            a = f"{b}.attn"
+            attn = {
+                "wq": _stack_heads(sd, [f"{a}.heads.{h}.query.weight" for h in range(H)]),
+                "wk": _stack_heads(sd, [f"{a}.heads.{h}.key.weight" for h in range(H)]),
+                "wv": _stack_heads(sd, [f"{a}.heads.{h}.value.weight" for h in range(H)]),
+                "out": _lin(sd, f"{a}.proj"),
+            }
+        elif cfg.model == "diff":
+            a = f"{b}.diff_attn"
+            attn = {
+                # streams stacked first: (2, E, H, d) from query1/query2
+                "wq": np.stack([
+                    _stack_heads(sd, [f"{a}.heads.{h}.query{s}.weight" for h in range(H)])
+                    for s in (1, 2)
+                ]),
+                "wk": np.stack([
+                    _stack_heads(sd, [f"{a}.heads.{h}.key{s}.weight" for h in range(H)])
+                    for s in (1, 2)
+                ]),
+                "wv": _stack_heads(sd, [f"{a}.heads.{h}.value.weight" for h in range(H)]),
+                "lambda_q": np.stack([
+                    _stack_heads(sd, [f"{a}.heads.{h}.lambda_q{s}" for h in range(H)], transpose=False)
+                    for s in (1, 2)
+                ]),
+                "lambda_k": np.stack([
+                    _stack_heads(sd, [f"{a}.heads.{h}.lambda_k{s}" for h in range(H)], transpose=False)
+                    for s in (1, 2)
+                ]),
+                "gn": _norm(sd, f"{a}.group_norm"),
+                "out": _lin(sd, f"{a}.proj"),
+            }
+        else:  # ndiff
+            a = f"{b}.diff_attn"
+            n = cfg.n_terms
+            attn = {
+                "wq": np.stack([
+                    _stack_heads(sd, [f"{a}.heads.{h}.queries.{t}.weight" for h in range(H)])
+                    for t in range(n)
+                ]),
+                "wk": np.stack([
+                    _stack_heads(sd, [f"{a}.heads.{h}.keys.{t}.weight" for h in range(H)])
+                    for t in range(n)
+                ]),
+                "wv": _stack_heads(sd, [f"{a}.heads.{h}.value.weight" for h in range(H)]),
+                "lambda_q": np.stack([
+                    _stack_heads(sd, [f"{a}.heads.{h}.lambda_qs.{t}" for h in range(H)], transpose=False)
+                    for t in range(n)
+                ]),
+                "lambda_k": np.stack([
+                    _stack_heads(sd, [f"{a}.heads.{h}.lambda_ks.{t}" for h in range(H)], transpose=False)
+                    for t in range(n)
+                ]),
+                "gn": _norm(sd, f"{a}.group_norm"),
+                "out": _lin(sd, f"{a}.proj"),
+            }
+        blocks.append({
+            "ln1": _norm(sd, f"{b}.ln1"),
+            "attn": attn,
+            "ln2": _norm(sd, f"{b}.ln2"),
+            "ffn": _ffn(sd, f"{b}.ffwd"),
+        })
+    params["blocks"] = blocks
+    return params, cfg
+
+
+def load_reference_checkpoint(path: str) -> Tuple[dict, ModelConfig]:
+    """Load either reference on-disk format:
+
+    - ``best_model.pt`` training blob (train.py:309-316): reads
+      ``model_state_dict``,
+    - ``save_pretrained`` file (Ndiff_transformer.py:251-265): reads
+      ``model_state`` (+ ``model_args`` for dropout/n_terms hints).
+    """
+    import torch
+
+    blob = torch.load(path, map_location="cpu", weights_only=False)
+    if "model_state_dict" in blob:
+        sd = blob["model_state_dict"]
+    elif "model_state" in blob:
+        sd = blob["model_state"]
+    else:
+        raise ValueError(
+            f"unrecognized checkpoint structure at {path!r}: keys "
+            f"{sorted(blob)[:8]} (expected 'model_state_dict' or 'model_state')"
+        )
+    params, cfg = import_reference_state_dict(sd)
+    # honor save_pretrained's model_args where they carry information the
+    # state_dict cannot (dropout; Ndiff_transformer.py:253-260)
+    args = blob.get("model_args")
+    if isinstance(args, dict) and "dropout" in args:
+        cfg = cfg.replace(dropout=float(args["dropout"]))
+    return params, cfg

@@ -1,0 +1,50 @@
+"""Convert a reference (PyTorch) checkpoint to this framework's format.
+
+Reads either reference on-disk format — the ``best_model.pt`` training
+blob (train.py:309-316) or an N-diff ``save_pretrained`` file
+(Ndiff_transformer.py:251-265) — infers the model family and shapes from
+the state_dict, maps the weights onto this framework's param pytree
+(utils/torch_import.py), and writes a ``save_pretrained`` directory that
+``sample.py`` and ``from_pretrained`` consume directly:
+
+    python tools/import_reference_checkpoint.py best_model.pt imported/
+    python sample.py --checkpoint imported/ --tokenizer tokenizer
+
+Cross-implementation parity of the mapping (same logits/loss as the
+reference's own forward) is pinned by tests/test_torch_import.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint", help="reference best_model.pt or save_pretrained file")
+    p.add_argument("out", help="output save_pretrained directory")
+    args = p.parse_args()
+
+    from differential_transformer_replication_tpu.models import param_count
+    from differential_transformer_replication_tpu.train.checkpoint import (
+        save_pretrained,
+    )
+    from differential_transformer_replication_tpu.utils.torch_import import (
+        load_reference_checkpoint,
+    )
+
+    params, cfg = load_reference_checkpoint(args.checkpoint)
+    save_pretrained(args.out, params, cfg)
+    print(
+        f"imported {args.checkpoint} -> {args.out}: model={cfg.model} "
+        f"{cfg.n_layer}L/{cfg.n_embd}d/{cfg.n_head}-head block={cfg.block_size} "
+        f"vocab={cfg.vocab_size} ({param_count(params):,} params)"
+    )
+
+
+if __name__ == "__main__":
+    main()
