@@ -60,7 +60,10 @@ def main() -> None:
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     micro_batch = int(os.environ.get("BENCH_MICRO_BATCH", "32"))
     model_kind = os.environ.get("BENCH_MODEL", "diff")
-    attn = os.environ.get("BENCH_ATTN", "xla")
+    # pallas (the fused flash kernel) measured fastest at recipe scale
+    # since the 512-square training tiles (178.6k vs XLA's 174.8k tok/s)
+    # and dominates at every longer context; BENCH_ATTN=xla to compare.
+    attn = os.environ.get("BENCH_ATTN", "pallas")
 
     model = ModelConfig(
         model=model_kind,
