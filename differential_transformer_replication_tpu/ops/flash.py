@@ -958,8 +958,8 @@ def multi_stream_flash_attention(
     v: jnp.ndarray,  # (B, T, H, dv)
     coeffs: jnp.ndarray,  # (S, H) float32
     *,
-    block_q: int = 128,
-    block_k: int = 512,
+    block_q: int = 512,
+    block_k: int = 1024,
     block_q_train: int = 512,
     block_k_train: int = 512,
     interpret: Optional[bool] = None,
@@ -968,12 +968,13 @@ def multi_stream_flash_attention(
     sqrt(d)) @ V`` without materializing any T x T map. Returns
     (B, T, H, dv).
 
-    Block defaults are the measured v5e optima: inference (no-grad
-    primal) streams wide K blocks; under differentiation the
-    residual-saving forward and both backward kernels use the
-    ``*_train`` square tiles (512 square measured 1.5-2.1x faster than
-    128 square across T=512..8192 with the readback-synced harness;
-    1024-wide tiles fail to compile past T=2048 — VMEM)."""
+    Block defaults are the measured v5e optima (readback-synced harness):
+    the no-grad primal streams (512, 1024) tiles — 15-26% faster than the
+    older (128, 512) across T=512..16384; under differentiation the
+    residual-saving forward and both backward kernels use the ``*_train``
+    512-square tiles, 1.5-2.1x the older 128-square across T=512..8192.
+    1024-wide tiles in the differentiated path fail to compile past
+    T=2048 (VMEM)."""
     if interpret is None:
         interpret = _auto_interpret()
     S, B, T, H, d = qs.shape
