@@ -88,12 +88,19 @@ def _attn(
         ring_vanilla_attention,
         use_ring,
     )
+    from differential_transformer_replication_tpu.parallel.shard_flash import (
+        shard_flash_vanilla_attention,
+        use_shard_flash,
+    )
 
     if use_ring(mesh):
         check_ring_dropout(dropout_rate, r_att)
         out = ring_vanilla_attention(q, k, v, mesh, impl)
     elif use_flash(impl, dropout_rate, r_att):
-        out = flash_vanilla_attention(q, k, v)
+        if use_shard_flash(mesh):
+            out = shard_flash_vanilla_attention(q, k, v, mesh)
+        else:
+            out = flash_vanilla_attention(q, k, v)
     else:
         out = vanilla_attention(
             q, k, v, mask=mask, dropout_rate=dropout_rate, rng=r_att

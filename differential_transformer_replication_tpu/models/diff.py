@@ -104,12 +104,21 @@ def _attn(
         ring_diff_attention,
         use_ring,
     )
+    from differential_transformer_replication_tpu.parallel.shard_flash import (
+        shard_flash_diff_attention,
+        use_shard_flash,
+    )
 
     if use_ring(mesh):
         check_ring_dropout(dropout_rate, r_att)
         out = ring_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam, mesh, impl)
     elif use_flash(impl, dropout_rate, r_att):
-        out = flash_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam)
+        if use_shard_flash(mesh):
+            out = shard_flash_diff_attention(
+                qs[0], ks[0], qs[1], ks[1], v, lam, mesh
+            )
+        else:
+            out = flash_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam)
     else:
         out = diff_attention(
             qs[0], ks[0], qs[1], ks[1], v, lam,

@@ -107,12 +107,19 @@ def _attn(
         ring_ndiff_attention,
         use_ring,
     )
+    from differential_transformer_replication_tpu.parallel.shard_flash import (
+        shard_flash_ndiff_attention,
+        use_shard_flash,
+    )
 
     if use_ring(mesh):
         check_ring_dropout(dropout_rate, r_att)
         out = ring_ndiff_attention(qs, ks, v, lams, ndiff_signs(n), mesh, impl)
     elif use_flash(impl, dropout_rate, r_att):
-        out = flash_ndiff_attention(qs, ks, v, lams, ndiff_signs(n))
+        if use_shard_flash(mesh):
+            out = shard_flash_ndiff_attention(qs, ks, v, lams, ndiff_signs(n), mesh)
+        else:
+            out = flash_ndiff_attention(qs, ks, v, lams, ndiff_signs(n))
     else:
         out = ndiff_attention(
             qs, ks, v, lams, ndiff_signs(n),

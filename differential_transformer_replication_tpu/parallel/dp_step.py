@@ -33,21 +33,10 @@ def make_sharded_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict):
     """Returns ``step(state, batch, rng) -> (state, metrics)`` compiled with
     the mesh's shardings. ``state_template`` (abstract or concrete) supplies
     the pytree structure for sharding inference."""
-    seq_parallel = mesh.shape.get("sequence", 1) > 1
-    if (
-        cfg.model.attention_impl == "pallas"
-        and mesh.devices.size > 1
-        and not seq_parallel
-    ):
-        # GSPMD cannot partition a bare pallas_call: on a multi-device mesh
-        # it would all-gather every attention operand (or fail to compile).
-        # With a >1 sequence axis attention runs the shard_map ring path
-        # instead, so the flash kernel is never reached; otherwise fail
-        # loudly, not slowly.
-        raise NotImplementedError(
-            "attention_impl='pallas' is single-device for now; use 'xla' on "
-            f"multi-device meshes (got {mesh.devices.size} devices)"
-        )
+    # attention_impl='pallas' on a >1-device mesh routes through the
+    # shard_map wrapper (parallel/shard_flash.py) — batch on data/fsdp,
+    # heads on tensor — or the ring path when sequence > 1. GSPMD never
+    # sees a bare pallas_call.
     st_sh = state_sharding(state_template, mesh)
     b_sh = batch_sharding(mesh)
 

@@ -170,24 +170,66 @@ class TestShardedTraining:
         assert sh.spec == P(None, ("data", "fsdp"), "sequence")
 
 
-class TestPallasShardingGuard:
-    def test_pallas_rejected_on_multidevice_mesh_without_sequence(self):
-        """GSPMD can't partition a bare pallas_call; the sharded step must
-        fail loudly (dp_step.py) unless ring attention takes over."""
-        import pytest
-
-        from differential_transformer_replication_tpu.parallel.dp_step import (
-            make_sharded_train_step,
+class TestShardFlash:
+    def test_shard_flash_op_matches_single_device(self):
+        """The shard_map-wrapped flash kernel (parallel/shard_flash.py) on a
+        dp4 x tp2 mesh must equal the plain single-device kernel — batch and
+        head sharding are embarrassingly parallel, so this is pure slicing."""
+        from differential_transformer_replication_tpu.ops.flash import (
+            flash_diff_attention,
+        )
+        from differential_transformer_replication_tpu.parallel.shard_flash import (
+            shard_flash_diff_attention,
         )
 
+        mesh = create_mesh(MeshConfig(data=4, tensor=2))
+        B, T, H, d = 8, 16, 4, 8
+        ks_ = jax.random.split(jax.random.PRNGKey(7), 6)
+        q1, k1, q2, k2 = (
+            jax.random.normal(k, (B, T, H, d), jnp.float32) for k in ks_[:4]
+        )
+        v = jax.random.normal(ks_[4], (B, T, H, 2 * d), jnp.float32)
+        lam = jax.random.uniform(ks_[5], (H,), jnp.float32, 0.1, 0.7)
+
+        ref = flash_diff_attention(q1, k1, q2, k2, v, lam)
+        out = shard_flash_diff_attention(q1, k1, q2, k2, v, lam, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_pallas_sharded_step_matches_single_device(self):
+        """Full train step with attention_impl='pallas' on a dp2 x fsdp2 x
+        tp2 mesh == the single-device pallas step (VERDICT r1 item 2: the
+        north-star 'fused Pallas on v4-8' composition)."""
+        mesh_cfg = MeshConfig(data=2, fsdp=2, tensor=2)
         model = ModelConfig(
-            model="diff", vocab_size=64, n_embd=32, n_head=2, n_layer=1,
+            model="diff", vocab_size=128, n_embd=32, n_head=2, n_layer=2,
             block_size=16, compute_dtype="float32", attention_impl="pallas",
         )
-        cfg = TrainConfig(model=model, mesh=MeshConfig(data=2), vocab_size=64)
-        mesh = create_mesh(MeshConfig(data=2))
-        with pytest.raises(NotImplementedError):
-            make_sharded_train_step(cfg, mesh, {})
+        cfg = make_cfg(mesh=mesh_cfg)
+        cfg = TrainConfig(
+            model=model, mesh=mesh_cfg, vocab_size=128, learning_rate=1e-2,
+            min_lr=1e-3, warmup_iters=2, max_iters=100,
+            control_head_multiplier=1,
+        )
+        batch = make_batch(jax.random.PRNGKey(1))
+
+        state_single = create_train_state(jax.random.PRNGKey(0), cfg)
+        s1, m1 = make_train_step(cfg)(state_single, batch)
+
+        mesh = create_mesh(mesh_cfg)
+        state_sharded = create_sharded_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        step = make_sharded_train_step(cfg, mesh, state_sharded)
+        s2, m2 = step(state_sharded, batch)
+
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s1["params"]),
+            jax.tree_util.tree_leaves(s2["params"]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(jax.device_get(b)), rtol=2e-4, atol=1e-5
+            )
 
     def test_pallas_allowed_with_sequence_axis(self):
         """With a >1 sequence axis the ring path handles attention, so the
