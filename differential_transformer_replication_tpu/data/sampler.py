@@ -52,6 +52,12 @@ class TokenWindows:
                 f"need more than block_size={block_size} tokens, got {len(tokens)}"
             )
         self.block_size = block_size
+        # host copy kept for the multi-host path (host_batches): each
+        # process gathers only its own windows as numpy, then
+        # jax.make_array_from_process_local_data assembles the global
+        # batch (parallel/multihost.py). Host RAM is cheap; the device
+        # copy below is what the hot loop gathers from.
+        self._host_tokens = np.asarray(tokens, dtype=np.int32)
         self.tokens = jnp.asarray(tokens, dtype=jnp.int32)
 
     def __len__(self) -> int:
@@ -93,6 +99,16 @@ class TokenWindows:
             k: v.reshape(n_batches, batch_size, self.block_size)
             for k, v in flat.items()
         }
+
+    def host_batches(self, offsets: np.ndarray) -> dict:
+        """Numpy twin of :meth:`batches`: gather (n_batches, B_local)
+        offsets into host arrays — the per-process local shard that
+        ``parallel.multihost.global_batch`` assembles into one global
+        jax.Array (the DistributedSampler capability, train.py:8-10)."""
+        offsets = np.asarray(offsets)
+        pos = offsets[..., None] + np.arange(self.block_size + 1)
+        grab = self._host_tokens[pos]  # (n, B_local, T+1)
+        return {"x": grab[..., :-1], "y": grab[..., 1:]}
 
     def random_batches(
         self, rng: np.random.Generator, batch_size: int, n_batches: int

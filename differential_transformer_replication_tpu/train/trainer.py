@@ -233,6 +233,28 @@ def train(cfg: TrainConfig) -> dict:
 
     data_rng = np.random.default_rng(cfg.seed)
     eval_rng = np.random.default_rng(cfg.seed + 1)
+
+    # Multi-process pods: every host draws the SAME offsets (the samplers
+    # are seeded identically), takes its own disjoint batch-column slice,
+    # gathers those windows host-side, and
+    # jax.make_array_from_process_local_data assembles the global batch —
+    # the working DistributedSampler replacement (train.py:8-10). Single
+    # process keeps the device-resident gather.
+    from differential_transformer_replication_tpu.parallel.multihost import (
+        global_batch as assemble_global,
+        local_batch_slice,
+        process_count,
+    )
+
+    multihost_data = process_count() > 1 and cfg.mesh.n_devices > 1
+
+    def _materialize(offs: np.ndarray) -> dict:
+        if multihost_data:
+            start, per = local_batch_slice(cfg.micro_batch_size)
+            local = train_ds.host_batches(offs[:, start : start + per])
+            return assemble_global(local, mesh)
+        return train_ds.batches(offs)
+
     if cfg.sampler == "epoch":
         # exact DataLoader-style epoch shuffle (train.py:184-191) via the
         # native O(1)-memory permutation
@@ -252,14 +274,17 @@ def train(cfg: TrainConfig) -> dict:
 
         def draw_batch():
             offs = perm.take(cfg.grad_acc_steps * cfg.micro_batch_size)
-            return train_ds.batches(
+            return _materialize(
                 offs.reshape(cfg.grad_acc_steps, cfg.micro_batch_size)
             )
     elif cfg.sampler == "replacement":
         def draw_batch():
-            return train_ds.random_batches(
-                data_rng, cfg.micro_batch_size, cfg.grad_acc_steps
+            offs = data_rng.integers(
+                0, len(train_ds),
+                size=(cfg.grad_acc_steps, cfg.micro_batch_size),
+                dtype=np.int64,
             )
+            return _materialize(offs)
     else:
         raise ValueError(f"unknown sampler {cfg.sampler!r}")
     dropout_key = jax.random.PRNGKey(cfg.seed + 2)

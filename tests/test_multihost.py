@@ -29,6 +29,47 @@ def test_local_batch_slice():
     assert (start, size) == (0, 32)  # single process owns everything
 
 
+def test_faked_per_host_slices_reassemble_to_single_host_draw():
+    """The trainer's multi-host data path (train/trainer.py:_materialize):
+    every host computes the SAME seeded offsets, slices its own batch
+    columns, and gathers host-side. Fake 4 hosts, reassemble their
+    host_batches, and assert equality with the single-host device draw —
+    the epoch permutation makes this exactly checkable (VERDICT r1
+    item 3)."""
+    from differential_transformer_replication_tpu.data import TokenWindows
+    from differential_transformer_replication_tpu.data.native import (
+        EpochPermutation,
+    )
+
+    tokens = np.arange(512, dtype=np.int32) % 97
+    ds = TokenWindows(tokens, block_size=16)
+    A, B, n_hosts = 2, 8, 4
+    perm = EpochPermutation(len(ds), seed=7)
+    offs = perm.take(A * B).reshape(A, B)
+
+    single = ds.batches(offs)
+
+    per = B // n_hosts
+    parts = [
+        ds.host_batches(offs[:, h * per : (h + 1) * per]) for h in range(n_hosts)
+    ]
+    for key in ("x", "y"):
+        reassembled = np.concatenate([p[key] for p in parts], axis=1)
+        np.testing.assert_array_equal(reassembled, np.asarray(single[key]))
+
+
+def test_host_batches_matches_device_batches():
+    from differential_transformer_replication_tpu.data import TokenWindows
+
+    tokens = (np.arange(300, dtype=np.int32) * 31) % 113
+    ds = TokenWindows(tokens, block_size=8)
+    offs = np.array([[0, 5, 17], [33, 2, 100]])
+    dev = ds.batches(offs)
+    host = ds.host_batches(offs)
+    for key in ("x", "y"):
+        np.testing.assert_array_equal(host[key], np.asarray(dev[key]))
+
+
 def test_global_batch_assembles_sharded_arrays():
     mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=1, sequence=2))
     local = {
