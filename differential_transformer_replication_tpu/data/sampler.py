@@ -74,8 +74,8 @@ class TokenWindows:
         offsets = rng.integers(0, len(self), size=batch_size, dtype=np.int64)
         return self.batch(offsets)
 
-    def sequential_batch(self, batch_index: int, batch_size: int) -> dict:
-        """Unshuffled-loader equivalent (train.py:193-200): batch k covers
+    def sequential_offsets(self, batch_index: int, batch_size: int) -> np.ndarray:
+        """Offsets of the unshuffled-loader batch k (train.py:193-200):
         windows [k*B, (k+1)*B), wrapping at the end (drop_last keeps every
         batch full)."""
         if batch_size > len(self):
@@ -87,7 +87,11 @@ class TokenWindows:
                 f"windows (need more tokens in this split)"
             )
         start = (batch_index * batch_size) % (len(self) - batch_size + 1)
-        return self.batch(np.arange(start, start + batch_size))
+        return np.arange(start, start + batch_size)
+
+    def sequential_batch(self, batch_index: int, batch_size: int) -> dict:
+        """Unshuffled-loader equivalent (train.py:193-200)."""
+        return self.batch(self.sequential_offsets(batch_index, batch_size))
 
     def batches(self, offsets: np.ndarray) -> dict:
         """Gather a stacked (n_batches, B, T) batch from (n_batches, B)

@@ -10,8 +10,15 @@ from differential_transformer_replication_tpu.parallel.dp_step import (
 )
 from differential_transformer_replication_tpu.parallel.pipeline import (
     create_pipeline_train_state,
+    make_pipeline_eval_many,
     make_pipeline_eval_step,
     make_pipeline_train_step,
+)
+from differential_transformer_replication_tpu.parallel.shard_flash import (
+    shard_flash_diff_attention,
+    shard_flash_multi_stream_attention,
+    shard_flash_ndiff_attention,
+    shard_flash_vanilla_attention,
 )
 
 __all__ = [
@@ -22,6 +29,11 @@ __all__ = [
     "shard_state",
     "make_sharded_train_step",
     "create_pipeline_train_state",
+    "make_pipeline_eval_many",
     "make_pipeline_eval_step",
     "make_pipeline_train_step",
+    "shard_flash_multi_stream_attention",
+    "shard_flash_vanilla_attention",
+    "shard_flash_diff_attention",
+    "shard_flash_ndiff_attention",
 ]

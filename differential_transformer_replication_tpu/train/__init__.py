@@ -4,6 +4,7 @@ from differential_transformer_replication_tpu.train.optim import (
 )
 from differential_transformer_replication_tpu.train.step import (
     create_train_state,
+    make_eval_many,
     make_eval_step,
     make_train_step,
 )
@@ -24,6 +25,7 @@ __all__ = [
     "cosine_warmup_schedule",
     "make_optimizer",
     "create_train_state",
+    "make_eval_many",
     "make_eval_step",
     "make_train_step",
     "save_checkpoint",

@@ -128,3 +128,23 @@ def make_eval_step(cfg: TrainConfig, mesh=None):
         return loss_fn(params, x, y, model_cfg, rng=None, mesh=mesh)
 
     return eval_step
+
+
+def make_eval_many(cfg: TrainConfig, mesh=None):
+    """Returns ``eval_many(params, xs, ys) -> (K,) losses``: a single
+    jitted ``lax.scan`` over K stacked eval batches, so an eval pass does
+    ONE device->host sync instead of one per batch (the reference's
+    estimate_loss loop syncs 400 times per eval, train.py:125-139). The
+    per-batch math is identical to :func:`make_eval_step`."""
+    model_cfg = cfg.resolved_model()
+
+    @jax.jit
+    def eval_many(params: dict, xs: jnp.ndarray, ys: jnp.ndarray) -> jnp.ndarray:
+        def body(_, xy):
+            x, y = xy
+            return None, loss_fn(params, x, y, model_cfg, rng=None, mesh=mesh)
+
+        _, losses = jax.lax.scan(body, None, (xs, ys))
+        return losses
+
+    return eval_many

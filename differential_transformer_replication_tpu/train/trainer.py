@@ -29,13 +29,13 @@ from differential_transformer_replication_tpu.train.metrics import MetricLogger
 from differential_transformer_replication_tpu.utils import ProfilerWindow, Throughput
 from differential_transformer_replication_tpu.train.step import (
     create_train_state,
-    make_eval_step,
+    make_eval_many,
     make_train_step,
 )
 
 
 def estimate_loss(
-    eval_step,
+    eval_many,
     params: dict,
     train_ds: TokenWindows,
     val_ds: TokenWindows,
@@ -44,16 +44,34 @@ def estimate_loss(
 ) -> dict:
     """Mean loss over eval_iters batches from each split (train.py:125-139):
     train batches shuffled, val batches sequential from the start — the
-    same draws the reference's two loaders produce."""
+    same draws the reference's two loaders produce.
+
+    ``eval_many(params, xs, ys)`` evaluates ALL eval_iters stacked batches
+    in one device call (a jitted scan, train/step.py:make_eval_many, or
+    the pipeline microbatch stream, parallel/pipeline.py) and returns
+    per-batch losses (or their scalar mean) — one host sync per split
+    instead of one per batch. The rng draw sequence is identical to the
+    old per-batch loop (one ``integers(size=B)`` call per train batch)."""
     out = {}
     for split, ds in (("train", train_ds), ("val", val_ds)):
-        losses = np.empty(cfg.eval_iters, np.float64)
-        for k in range(cfg.eval_iters):
-            if split == "train":
-                batch = ds.random_batch(rng, cfg.micro_batch_size)
-            else:
-                batch = ds.sequential_batch(k, cfg.micro_batch_size)
-            losses[k] = float(eval_step(params, batch["x"], batch["y"]))
+        if split == "train":
+            offs = np.stack(
+                [
+                    rng.integers(0, len(ds), size=cfg.micro_batch_size, dtype=np.int64)
+                    for _ in range(cfg.eval_iters)
+                ]
+            )
+        else:
+            offs = np.stack(
+                [
+                    ds.sequential_offsets(k, cfg.micro_batch_size)
+                    for k in range(cfg.eval_iters)
+                ]
+            )
+        batch = ds.batches(offs)
+        losses = np.asarray(
+            jax.device_get(eval_many(params, batch["x"], batch["y"])), np.float64
+        )
         out[split] = float(losses.mean())
     return out
 
@@ -178,7 +196,7 @@ def train(cfg: TrainConfig) -> dict:
         from differential_transformer_replication_tpu.parallel import create_mesh
         from differential_transformer_replication_tpu.parallel.pipeline import (
             create_pipeline_train_state,
-            make_pipeline_eval_step,
+            make_pipeline_eval_many,
             make_pipeline_train_step,
             pipeline_state_sharding,
         )
@@ -194,7 +212,10 @@ def train(cfg: TrainConfig) -> dict:
             state = jax.tree_util.tree_map(jax.device_put, host_state, sh)
             print(f"Resumed from {cfg.resume_from} at iter {int(jax.device_get(state['step']))}")
         train_step = make_pipeline_train_step(cfg, mesh, state)
-        eval_step = make_pipeline_eval_step(cfg, mesh)
+        # eval feeds all eval_iters batches through the pipeline as ONE
+        # microbatch stream: bubble amortized (P-1)/(K+P-1) instead of
+        # (P-1)/P per batch (VERDICT r1 item 7)
+        eval_many = make_pipeline_eval_many(cfg, mesh)
     elif cfg.mesh.n_devices > 1:
         # Sharded path: mesh + partitioned step (the DDP/NCCL replacement).
         from differential_transformer_replication_tpu.parallel import (
@@ -229,7 +250,7 @@ def train(cfg: TrainConfig) -> dict:
             print(f"Resumed from {cfg.resume_from} at iter {int(state['step'])}")
         train_step = make_train_step(cfg)
     if cfg.mesh.pipeline <= 1:
-        eval_step = make_eval_step(cfg, mesh=eval_mesh)
+        eval_many = make_eval_many(cfg, mesh=eval_mesh)
 
     data_rng = np.random.default_rng(cfg.seed)
     eval_rng = np.random.default_rng(cfg.seed + 1)
@@ -344,7 +365,7 @@ def train(cfg: TrainConfig) -> dict:
 
             if iter_num % cfg.eval_interval == 0:
                 losses = estimate_loss(
-                    eval_step, state["params"], train_ds, val_ds, cfg, eval_rng
+                    eval_many, state["params"], train_ds, val_ds, cfg, eval_rng
                 )
                 logger.log_eval(iter_num, losses["train"], losses["val"])
                 if losses["val"] < best_val_loss:  # train.py:307-317

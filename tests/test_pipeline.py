@@ -216,6 +216,25 @@ class TestPipelineTrainStep:
         _, ref = model_forward(params, x[0], cfg.model, targets=y[0])
         np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
 
+    def test_eval_many_stream_matches_per_batch(self):
+        """Feeding K eval batches as one microbatch stream (bubble
+        amortized (P-1)/(K+P-1), VERDICT r1 item 7) must equal the mean of
+        per-batch pipeline evals."""
+        from differential_transformer_replication_tpu.parallel.pipeline import (
+            make_pipeline_eval_many,
+        )
+
+        cfg = self._cfg()
+        mesh = create_mesh(cfg.mesh)
+        state = create_pipeline_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        eval_step = make_pipeline_eval_step(cfg, mesh)
+        eval_many = make_pipeline_eval_many(cfg, mesh)
+        K = 4
+        x, y = microbatches(jax.random.PRNGKey(3), cfg.model, n_micro=K)
+        got = float(eval_many(state["params"], x, y))
+        singles = [float(eval_step(state["params"], x[k], y[k])) for k in range(K)]
+        np.testing.assert_allclose(got, np.mean(singles), rtol=1e-5)
+
     def test_stack_unstack_roundtrip(self):
         m = tiny_model("ndiff")
         params = init_model(jax.random.PRNGKey(0), m)

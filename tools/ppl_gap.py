@@ -72,7 +72,7 @@ def main() -> None:
         ModelConfig,
         TrainConfig,
     )
-    from differential_transformer_replication_tpu.train.step import make_eval_step
+    from differential_transformer_replication_tpu.train.step import make_eval_many
     from differential_transformer_replication_tpu.train.trainer import (
         build_data,
         estimate_loss,
@@ -119,7 +119,7 @@ def main() -> None:
         tokenizer, vocab_size, train_ds, val_ds = build_data(cfg)
         eval_cfg = cfg.replace(vocab_size=vocab_size)
         losses = estimate_loss(
-            make_eval_step(eval_cfg), state["params"], train_ds, val_ds,
+            make_eval_many(eval_cfg), state["params"], train_ds, val_ds,
             eval_cfg, np.random.default_rng(cfg.seed + 1),
         )
         results[kind] = {
