@@ -186,9 +186,20 @@ class TrainConfig:
     # Checkpointing (train.py:307-317 saved; resume is new capability)
     checkpoint_path: str = "best_model.ckpt"
     # Preemption safety: a resumable last-state checkpoint written on ANY
-    # trainer exit (SIGTERM, Ctrl-C, crash, completion). None disables.
-    last_checkpoint_path: Optional[str] = "last_model.ckpt"
+    # trainer exit (SIGTERM, Ctrl-C, crash, completion). "auto" derives
+    # `<checkpoint_path stem>.last<ext>` so concurrent runs in one
+    # directory never clobber each other's rescue checkpoint; None
+    # disables; any other string is used verbatim.
+    last_checkpoint_path: Optional[str] = "auto"
     resume_from: Optional[str] = None
+
+    def resolved_last_checkpoint_path(self) -> Optional[str]:
+        if self.last_checkpoint_path != "auto":
+            return self.last_checkpoint_path
+        import os
+
+        root, ext = os.path.splitext(self.checkpoint_path)
+        return f"{root}.last{ext or '.ckpt'}"
 
     seed: int = 1337  # train.py:329-330
 

@@ -95,6 +95,33 @@ def pick_block(desired: int, total: int) -> int:
 _pick_block = pick_block  # internal callers
 
 
+# Tile defaults by TPU generation: the 512/1024 tiles are measured v5e
+# optima (readback-synced harness; see multi_stream_flash_attention's
+# docstring), and v5e is also where the 1024-wide train tiles were
+# observed to exhaust VMEM under differentiation — other generations have
+# different VMEM budgets, so unknown kinds get conservative 256-tiles
+# that compile everywhere rather than the widest measured winner.
+# (blocks are (block_q, block_k, block_q_train, block_k_train))
+_TUNED_BLOCKS = {
+    "v5 lite": (512, 1024, 512, 512),
+    "v5e": (512, 1024, 512, 512),
+}
+_CONSERVATIVE_BLOCKS = (256, 512, 256, 256)
+
+
+def default_blocks() -> tuple:
+    """(block_q, block_k, block_q_train, block_k_train) for the current
+    backend: tuned tiles on known TPU kinds, conservative ones elsewhere,
+    tuned for the interpreter (tile size is semantics-free there)."""
+    if jax.default_backend() != "tpu":
+        return _TUNED_BLOCKS["v5 lite"]
+    kind = jax.devices()[0].device_kind.lower()
+    for key, blocks in _TUNED_BLOCKS.items():
+        if key in kind:
+            return blocks
+    return _CONSERVATIVE_BLOCKS
+
+
 # ---------------------------------------------------------------------------
 # Shared kernel math
 # ---------------------------------------------------------------------------
@@ -958,32 +985,34 @@ def multi_stream_flash_attention(
     v: jnp.ndarray,  # (B, T, H, dv)
     coeffs: jnp.ndarray,  # (S, H) float32
     *,
-    block_q: int = 512,
-    block_k: int = 1024,
-    block_q_train: int = 512,
-    block_k_train: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    block_q_train: Optional[int] = None,
+    block_k_train: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Fused causal attention: ``sum_s coeffs[s,h] * softmax(Q_s K_s^T /
     sqrt(d)) @ V`` without materializing any T x T map. Returns
     (B, T, H, dv).
 
-    Block defaults are the measured v5e optima (readback-synced harness):
-    the no-grad primal streams (512, 1024) tiles — 15-26% faster than the
+    Block defaults resolve per device kind (:func:`default_blocks`). On
+    v5e they are the measured optima (readback-synced harness): the
+    no-grad primal streams (512, 1024) tiles — 15-26% faster than the
     older (128, 512) across T=512..16384; under differentiation the
     residual-saving forward and both backward kernels use the ``*_train``
     512-square tiles, 1.5-2.1x the older 128-square across T=512..8192.
     1024-wide tiles in the differentiated path fail to compile past
-    T=2048 (VMEM)."""
+    T=2048 (VMEM) on v5e; unknown TPU kinds fall back to 256-tiles."""
     if interpret is None:
         interpret = _auto_interpret()
+    dq, dk, dqt, dkt = default_blocks()
     S, B, T, H, d = qs.shape
     dv = v.shape[-1]
     blocks = (
-        _pick_block(block_q, T),
-        _pick_block(block_k, T),
-        _pick_block(block_q_train, T),
-        _pick_block(block_k_train, T),
+        _pick_block(block_q if block_q is not None else dq, T),
+        _pick_block(block_k if block_k is not None else dk, T),
+        _pick_block(block_q_train if block_q_train is not None else dqt, T),
+        _pick_block(block_k_train if block_k_train is not None else dkt, T),
     )
     # (S, B, T, H, d) -> (B*H, S, T, d)
     q_r = qs.transpose(1, 3, 0, 2, 4).reshape(B * H, S, T, d)

@@ -343,6 +343,8 @@ def train(cfg: TrainConfig) -> dict:
     # exactly 1 per call, and reading it back would force a host-device
     # sync every iteration, breaking async dispatch pipelining.
     iter_num = int(jax.device_get(state["step"]))
+    metrics = None  # last step's metrics; gates the rescue save below
+    last_ckpt_path = cfg.resolved_last_checkpoint_path()
     try:
         while iter_num < cfg.max_iters:
             if stop_requested["flag"]:
@@ -382,16 +384,29 @@ def train(cfg: TrainConfig) -> dict:
         profiler.close()
         logger.finish()
         try:
-            if cfg.last_checkpoint_path and is_primary():
+            if last_ckpt_path and is_primary():
                 # resumable last-state checkpoint, written whatever the
                 # exit path (save_checkpoint canonicalizes pipeline
                 # layouts). The SIGTERM handler is still ours here, so a
                 # follow-up SIGTERM during this save cannot kill the
                 # write; the atomic rename inside save_checkpoint
                 # protects against harder kills.
-                save_checkpoint(
-                    cfg.last_checkpoint_path, state, best_val_loss, cfg
-                )
+                finite = True
+                if metrics is not None:
+                    # a NaN/diverged state must not overwrite the previous
+                    # good rescue checkpoint — save-exceptions were already
+                    # caught, but bad VALUES were not
+                    finite = bool(
+                        np.isfinite(float(jax.device_get(metrics["loss"])))
+                    )
+                if finite:
+                    save_checkpoint(last_ckpt_path, state, best_val_loss, cfg)
+                else:
+                    print(
+                        f"skipping last-checkpoint rescue save: non-finite "
+                        f"loss at iter {iter_num} (previous checkpoint at "
+                        f"{last_ckpt_path!r} left intact)"
+                    )
         except Exception as e:  # noqa: BLE001
             # on the crash path the state itself may be poisoned (device
             # OOM) — never let the rescue save mask the real exception

@@ -68,7 +68,13 @@ def infer_model_config(sd: dict, dropout: float = 0.0) -> ModelConfig:
     The family is identified structurally: a position table means the
     2-term DiffTransformer (the only variant with one,
     diff_transformer.py:133-134); ``attn.heads`` means the vanilla
-    control; ``queries.0`` under diff_attn means the N-term model."""
+    control; ``queries.0`` under diff_attn means the N-term model.
+
+    Limits of inference: a state_dict carries no training-time
+    hyperparameters, so ``dropout`` is whatever the caller passes
+    (default 0.0 — the reference's training value, train.py:64; inference
+    is unaffected either way), and non-ndiff families take the
+    ModelConfig default ``n_terms`` rather than a fabricated value."""
     vocab_size, n_embd = _np(sd["token_embedding_table.weight"]).shape
     n_layer = 1 + max(
         int(k.split(".")[1]) for k in sd if k.startswith("blocks.")
@@ -99,6 +105,11 @@ def infer_model_config(sd: dict, dropout: float = 0.0) -> ModelConfig:
             for k in sd
             if k.startswith("blocks.0.diff_attn.heads.0.queries.")
         )
+    kwargs = {}
+    if model == "ndiff":
+        kwargs["n_terms"] = max(n_terms, 1)
+    # non-ndiff families keep the ModelConfig default — n_terms is inert
+    # for them, and inventing a value would mis-round-trip the config
     return ModelConfig(
         model=model,
         vocab_size=int(vocab_size),
@@ -107,7 +118,7 @@ def infer_model_config(sd: dict, dropout: float = 0.0) -> ModelConfig:
         n_layer=int(n_layer),
         block_size=int(block_size),
         dropout=dropout,
-        n_terms=max(n_terms, 1) if model == "ndiff" else 4,
+        **kwargs,
     )
 
 

@@ -171,6 +171,17 @@ class TestTrainStep:
         )
         np.testing.assert_allclose(many, singles, rtol=1e-6)
 
+    def test_last_checkpoint_path_auto_derives_from_checkpoint_path(self):
+        """'auto' (the default) derives a sibling of checkpoint_path so
+        concurrent runs in one directory never clobber each other's rescue
+        checkpoint (ADVICE r1)."""
+        cfg = tiny_train_cfg("diff").replace(checkpoint_path="runs/exp7.ckpt")
+        assert cfg.resolved_last_checkpoint_path() == "runs/exp7.last.ckpt"
+        cfg = cfg.replace(last_checkpoint_path=None)
+        assert cfg.resolved_last_checkpoint_path() is None
+        cfg = cfg.replace(last_checkpoint_path="explicit.ckpt")
+        assert cfg.resolved_last_checkpoint_path() == "explicit.ckpt"
+
     def test_control_head_multiplier_applied(self):
         """train.py:226 quirk: control trains with doubled heads."""
         cfg = TrainConfig(model=ModelConfig(model="control", **TINY_MODEL), vocab_size=31)

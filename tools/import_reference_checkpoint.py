@@ -44,6 +44,12 @@ def main() -> None:
         f"{cfg.n_layer}L/{cfg.n_embd}d/{cfg.n_head}-head block={cfg.block_size} "
         f"vocab={cfg.vocab_size} ({param_count(params):,} params)"
     )
+    print(
+        f"note: dropout={cfg.dropout} — best_model.pt blobs carry no "
+        f"training hyperparameters, so this is the reference's training "
+        f"default (train.py:64) unless the checkpoint's model_args said "
+        f"otherwise; it only matters if you fine-tune from the import"
+    )
 
 
 if __name__ == "__main__":
