@@ -106,11 +106,16 @@ def fused_tail_loss(
 
 def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     """Mean cross-entropy over all (B*T) positions, matching the flattened
-    ``F.cross_entropy`` call (control.py:153-159). Computed in float32."""
-    logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    ``F.cross_entropy`` call (control.py:153-159). Computed in float32.
+
+    Written as ``mean(logsumexp - target_logit)``: same math as
+    ``-mean(take(log_softmax))`` (profiled identical on v5e — XLA fuses
+    both forms to the same program), kept in this form because it states
+    the no-materialization intent explicitly."""
+    logits32 = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits32, axis=-1)  # (B, T)
+    tgt = jnp.take_along_axis(logits32, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)
 
 
 def tail_and_loss(x, params: dict, cfg, targets):

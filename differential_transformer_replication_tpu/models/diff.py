@@ -26,12 +26,15 @@ from differential_transformer_replication_tpu.ops import (
     causal_mask,
     diff_attention,
     diff_lambda,
-    flash_diff_attention,
     group_layer_norm,
     lambda_init_schedule,
 )
-from differential_transformer_replication_tpu.ops.flash import use_flash
+from differential_transformer_replication_tpu.ops.flash import (
+    multi_stream_flash_attention,
+    use_flash,
+)
 from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
+from differential_transformer_replication_tpu.ops.streams import diff_coeffs
 
 
 # learned absolute positions, no RoPE (diff_transformer.py:133-134);
@@ -105,7 +108,7 @@ def _attn(
         use_ring,
     )
     from differential_transformer_replication_tpu.parallel.shard_flash import (
-        shard_flash_diff_attention,
+        shard_flash_multi_stream_attention,
         use_shard_flash,
     )
 
@@ -113,12 +116,14 @@ def _attn(
         check_ring_dropout(dropout_rate, r_att)
         out = ring_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam, mesh, impl)
     elif use_flash(impl, dropout_rate, r_att):
+        # pass the stacked streams straight through — slicing qs[0]/qs[1]
+        # only for flash_diff_attention to re-stack them costs real copies
         if use_shard_flash(mesh):
-            out = shard_flash_diff_attention(
-                qs[0], ks[0], qs[1], ks[1], v, lam, mesh
+            out = shard_flash_multi_stream_attention(
+                qs, ks, v, diff_coeffs(lam), mesh
             )
         else:
-            out = flash_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam)
+            out = multi_stream_flash_attention(qs, ks, v, diff_coeffs(lam))
     else:
         out = diff_attention(
             qs[0], ks[0], qs[1], ks[1], v, lam,

@@ -95,16 +95,18 @@ def pick_block(desired: int, total: int) -> int:
 _pick_block = pick_block  # internal callers
 
 
-# Tile defaults by TPU generation: the 512/1024 tiles are measured v5e
-# optima (readback-synced harness; see multi_stream_flash_attention's
-# docstring), and v5e is also where the 1024-wide train tiles were
-# observed to exhaust VMEM under differentiation — other generations have
-# different VMEM budgets, so unknown kinds get conservative 256-tiles
-# that compile everywhere rather than the widest measured winner.
+# Tile defaults by TPU generation, measured via tools/flash_sweep.py on
+# v5e (see multi_stream_flash_attention's docstring). VMEM budgets differ
+# across generations, so unknown kinds get conservative 256-tiles that
+# compile everywhere rather than the widest measured winner.
 # (blocks are (block_q, block_k, block_q_train, block_k_train))
 _TUNED_BLOCKS = {
-    "v5 lite": (512, 1024, 512, 512),
-    "v5e": (512, 1024, 512, 512),
+    # with bf16 MXU operands the 1024-wide K train tile fits VMEM and wins
+    # (tools/flash_sweep.py: +5% at T=512, +24-29% at T=2048-8192 over the
+    # 512-square train tiles); 1024-square train tiles still fail to
+    # compile past T=2048
+    "v5 lite": (512, 1024, 512, 1024),
+    "v5e": (512, 1024, 512, 1024),
 }
 _CONSERVATIVE_BLOCKS = (256, 512, 256, 256)
 
@@ -131,7 +133,11 @@ def _masked_scores(q_blk, k_blk, q_start, k_start, off, scale):
     """The score/mask block every kernel shares: ``(S, bq, bk)`` fp32
     scores ``Q K^T * scale`` with offset-causal masking (column c visible
     to row r iff ``k_start + c <= q_start + r + off``), plus the boolean
-    keep-mask. q_blk: (S, bq, d) fp32; k_blk: (S, bk, d) fp32."""
+    keep-mask. q_blk/k_blk: (S, bq|bk, d) in the STORED dtype — on bf16
+    inputs the MXU runs the native bf16 x bf16 -> fp32 contraction
+    (preferred_element_type), which is what the XLA attention path and
+    the reference's fp16-AMP matmuls (train.py:263) do; upcasting
+    operands to fp32 first would run the MXU at a fraction of peak."""
     bq, bk = q_blk.shape[1], k_blk.shape[1]
     s = jax.lax.dot_general(
         q_blk, k_blk,
@@ -179,7 +185,7 @@ def _fwd_kernel(
     q_start = i * block_q
     off = off_ref[0, 0].astype(jnp.int32)
 
-    q = q_ref[0].astype(jnp.float32)  # (S, block_q, d)
+    q = q_ref[0]  # (S, block_q, d) stored dtype — MXU-native
     scale = 1.0 / math.sqrt(d)
 
     def body(j, carry):
@@ -187,18 +193,18 @@ def _fwd_kernel(
 
         def compute(carry):
             m, l, acc = carry
-            k_j = k_ref[0, :, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            v_j = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            k_j = k_ref[0, :, pl.ds(j * block_k, block_k), :]
+            v_j = v_ref[0, pl.ds(j * block_k, block_k), :]
             s, _ = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (S, block_q)
             alpha = jnp.exp(m - m_new)
             p = jnp.exp(s - m_new[:, :, None])
             l_new = l * alpha + jnp.sum(p, axis=-1)
             pv = jax.lax.dot_general(
-                p, v_j,
+                p.astype(v_j.dtype), v_j,
                 dimension_numbers=(((2,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )  # (S, block_q, dv)
+            )  # (S, block_q, dv) fp32 accum
             acc_new = acc * alpha[:, :, None] + pv
             return m_new, l_new, acc_new
 
@@ -355,9 +361,9 @@ def _tiled_fwd_kernel(
 
     @pl.when(j * block_k <= q_start + block_q - 1 + off)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k_j = k_ref[0].astype(jnp.float32)
-        v_j = v_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k_j = k_ref[0]
+        v_j = v_ref[0]
         s, _ = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
         m = m_scr[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -365,7 +371,7 @@ def _tiled_fwd_kernel(
         p = jnp.exp(s - m_new[:, :, None])
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1)
         pv = jax.lax.dot_general(
-            p, v_j,
+            p.astype(v_j.dtype), v_j,
             dimension_numbers=(((2,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -475,10 +481,10 @@ def _tiled_dq_kernel(
 
     @pl.when(j * block_k <= q_start + block_q - 1 + off)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k_j = k_ref[0].astype(jnp.float32)
-        v_j = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k_j = k_ref[0]
+        v_j = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         s, keep = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
@@ -490,7 +496,7 @@ def _tiled_dq_kernel(
         )
         ds = p * (dp - delta[:, :, None])
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k_j,
+            ds.astype(k_j.dtype), k_j,
             dimension_numbers=(((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale
@@ -528,29 +534,30 @@ def _tiled_dkv_kernel(
 
     @pl.when(i * block_q + block_q - 1 + off >= k_start)
     def _():
-        q_i = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        do_i = do_ref[0].astype(jnp.float32)
+        q_i = q_ref[0]
+        k = k_ref[0]
+        do_i = do_ref[0]
         lse_i = lse_ref[0]
         delta_i = delta_ref[0]
         s, keep = _masked_scores(q_i, k, i * block_q, k_start, off, scale)
         p = jnp.where(keep, jnp.exp(s - lse_i[:, :, None]), 0.0)
+        p_lo = p.astype(do_i.dtype)
         dv_acc = dv_scr[:]
         for s_idx in range(S):
             dv_acc = dv_acc + jax.lax.dot_general(
-                p[s_idx], do_i[s_idx],
+                p_lo[s_idx], do_i[s_idx],
                 dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
         dv_scr[:] = dv_acc
         dp = jax.lax.dot_general(
-            do_i, v_ref[0].astype(jnp.float32),
+            do_i, v_ref[0],
             dimension_numbers=(((2,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_i[:, :, None])
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q_i,
+            ds.astype(q_i.dtype), q_i,
             dimension_numbers=(((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale
@@ -657,16 +664,16 @@ def _bwd_dq_kernel(
     q_start = i * block_q
     off = off_ref[0, 0].astype(jnp.int32)
 
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)  # (S, block_q, dv)
+    q = q_ref[0]
+    do = do_ref[0]  # (S, block_q, dv)
     lse = lse_ref[0]  # (S, block_q) f32
     delta = delta_ref[0]  # (S, block_q) f32
     scale = 1.0 / math.sqrt(d)
 
     def body(j, dq):
         def compute(dq):
-            k_j = k_ref[0, :, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            v_j = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            k_j = k_ref[0, :, pl.ds(j * block_k, block_k), :]
+            v_j = v_ref[0, pl.ds(j * block_k, block_k), :]
             s, keep = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
             p = jnp.where(keep, jnp.exp(s - lse[:, :, None]), 0.0)
             dp = jax.lax.dot_general(
@@ -676,7 +683,7 @@ def _bwd_dq_kernel(
             )  # (S, block_q, block_k)
             ds = p * (dp - delta[:, :, None])
             return dq + jax.lax.dot_general(
-                ds, k_j,
+                ds.astype(k_j.dtype), k_j,
                 dimension_numbers=(((2,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             ) * scale
@@ -710,7 +717,7 @@ def _bwd_dkv_kernel(
     k_start = j * block_k
     off = off_ref[0, 0].astype(jnp.int32)
 
-    k = k_ref[0].astype(jnp.float32)  # (S, block_k, d)
+    k = k_ref[0]  # (S, block_k, d)
     scale = 1.0 / math.sqrt(d)
 
     def body(i, carry):
@@ -718,30 +725,31 @@ def _bwd_dkv_kernel(
 
         def compute(carry):
             dk, dv = carry
-            q_i = q_ref[0, :, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-            do_i = do_ref[0, :, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+            q_i = q_ref[0, :, pl.ds(i * block_q, block_q), :]
+            do_i = do_ref[0, :, pl.ds(i * block_q, block_q), :]
             lse_i = lse_ref[0, :, pl.ds(i * block_q, block_q)]
             delta_i = delta_ref[0, :, pl.ds(i * block_q, block_q)]
             s, keep = _masked_scores(q_i, k, i * block_q, k_start, off, scale)
             p = jnp.where(keep, jnp.exp(s - lse_i[:, :, None]), 0.0)
+            p_lo = p.astype(do_i.dtype)
             # dV = sum_s P_s^T dO_s (coeff already folded into dO_s).
             # Mosaic can't contract two dims at once, so loop streams
             # statically — S is tiny (1, 2, or n_terms).
             dv_new = dv
             for s_idx in range(S):
                 dv_new = dv_new + jax.lax.dot_general(
-                    p[s_idx], do_i[s_idx],
+                    p_lo[s_idx], do_i[s_idx],
                     dimension_numbers=(((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
             dp = jax.lax.dot_general(
-                do_i, v_ref[0].astype(jnp.float32),
+                do_i, v_ref[0],
                 dimension_numbers=(((2,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             ds = p * (dp - delta_i[:, :, None])
             dk_new = dk + jax.lax.dot_general(
-                ds, q_i,
+                ds.astype(q_i.dtype), q_i,
                 dimension_numbers=(((1,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             ) * scale
@@ -996,13 +1004,14 @@ def multi_stream_flash_attention(
     (B, T, H, dv).
 
     Block defaults resolve per device kind (:func:`default_blocks`). On
-    v5e they are the measured optima (readback-synced harness): the
-    no-grad primal streams (512, 1024) tiles — 15-26% faster than the
-    older (128, 512) across T=512..16384; under differentiation the
-    residual-saving forward and both backward kernels use the ``*_train``
-    512-square tiles, 1.5-2.1x the older 128-square across T=512..8192.
-    1024-wide tiles in the differentiated path fail to compile past
-    T=2048 (VMEM) on v5e; unknown TPU kinds fall back to 256-tiles."""
+    v5e they are the measured optima (tools/flash_sweep.py): (512, 1024)
+    for the no-grad primal, and (512, 1024) ``*_train`` tiles for the
+    residual-saving forward and both backward kernels — the 1024-wide K
+    train tile became compilable once the kernels switched to bf16 MXU
+    operands (half the VMEM per tile) and wins by 5-29% over 512-square
+    across T=512..8192. 1024-SQUARE train tiles still fail to compile
+    past T=2048 (VMEM) on v5e; unknown TPU kinds fall back to
+    256-tiles."""
     if interpret is None:
         interpret = _auto_interpret()
     dq, dk, dqt, dkt = default_blocks()
