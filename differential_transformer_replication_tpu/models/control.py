@@ -98,9 +98,13 @@ def _attn(
         out = ring_vanilla_attention(q, k, v, mesh, impl)
     elif use_flash(impl, dropout_rate, r_att):
         if use_shard_flash(mesh):
-            out = shard_flash_vanilla_attention(q, k, v, mesh)
+            out = shard_flash_vanilla_attention(
+                q, k, v, mesh, dropout_rate=dropout_rate, dropout_rng=r_att
+            )
         else:
-            out = flash_vanilla_attention(q, k, v)
+            out = flash_vanilla_attention(
+                q, k, v, dropout_rate=dropout_rate, dropout_rng=r_att
+            )
     else:
         out = vanilla_attention(
             q, k, v, mask=mask, dropout_rate=dropout_rate, rng=r_att

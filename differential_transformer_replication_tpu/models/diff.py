@@ -120,10 +120,14 @@ def _attn(
         # only for flash_diff_attention to re-stack them costs real copies
         if use_shard_flash(mesh):
             out = shard_flash_multi_stream_attention(
-                qs, ks, v, diff_coeffs(lam), mesh
+                qs, ks, v, diff_coeffs(lam), mesh,
+                dropout_rate=dropout_rate, dropout_rng=r_att,
             )
         else:
-            out = multi_stream_flash_attention(qs, ks, v, diff_coeffs(lam))
+            out = multi_stream_flash_attention(
+                qs, ks, v, diff_coeffs(lam),
+                dropout_rate=dropout_rate, dropout_rng=r_att,
+            )
     else:
         out = diff_attention(
             qs[0], ks[0], qs[1], ks[1], v, lam,

@@ -117,9 +117,15 @@ def _attn(
         out = ring_ndiff_attention(qs, ks, v, lams, ndiff_signs(n), mesh, impl)
     elif use_flash(impl, dropout_rate, r_att):
         if use_shard_flash(mesh):
-            out = shard_flash_ndiff_attention(qs, ks, v, lams, ndiff_signs(n), mesh)
+            out = shard_flash_ndiff_attention(
+                qs, ks, v, lams, ndiff_signs(n), mesh,
+                dropout_rate=dropout_rate, dropout_rng=r_att,
+            )
         else:
-            out = flash_ndiff_attention(qs, ks, v, lams, ndiff_signs(n))
+            out = flash_ndiff_attention(
+                qs, ks, v, lams, ndiff_signs(n),
+                dropout_rate=dropout_rate, dropout_rng=r_att,
+            )
     else:
         out = ndiff_attention(
             qs, ks, v, lams, ndiff_signs(n),

@@ -75,12 +75,14 @@ _auto_interpret = auto_interpret  # internal callers
 def use_flash(impl: str, dropout_rate: float, rng) -> bool:
     """Single dispatch predicate shared by all three model families.
 
-    The fused path applies when requested AND attention-prob dropout is
-    inert: rate 0 (the reference's training default, train.py:64) or no rng
-    (eval mode — ops/dropout.py is an identity without a key). Prob-dropout
-    itself is not fused; SURVEY.md section 7.7.
+    Attention-prob dropout is fused in-kernel (counter-based masks; see
+    multi_stream_flash_attention), so the pallas path now applies
+    regardless of the dropout setting. The signature keeps the
+    (rate, rng) arguments so call sites document what the predicate once
+    depended on — both are inert here.
     """
-    return impl == "pallas" and (dropout_rate == 0.0 or rng is None)
+    del dropout_rate, rng
+    return impl == "pallas"
 
 
 def pick_block(desired: int, total: int) -> int:
@@ -125,6 +127,100 @@ def default_blocks() -> tuple:
 
 
 # ---------------------------------------------------------------------------
+# In-kernel attention-probability dropout (diff_transformer.py:58-67: each
+# softmax map is dropped out independently, before the lambda combine).
+#
+# The randomness is a counter-based hash of the GLOBAL (row, col) position,
+# the (b*H + h) grid index, the stream index, and a per-call seed — pure
+# uint32 arithmetic, so the same code runs compiled on TPU and in the
+# Pallas interpreter, and a plain-jnp twin (dropout_keep_reference) can
+# reproduce the kernel's masks bit-exactly for parity tests. Because the
+# mask is a function of global coordinates only, the forward and both
+# backward kernels regenerate identical masks regardless of their tilings.
+# The seed rides an SMEM (1, 1) float32 holding an exact 24-bit integer
+# (no float<->int bitcasting needed in-kernel).
+# ---------------------------------------------------------------------------
+
+
+def _fmix32(x: jnp.ndarray) -> jnp.ndarray:
+    """32-bit finalizer (triple32-style avalanche); wraps mod 2^32."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def dropout_keep_ids(seed_u32, bh, s_idx: int, row_ids, col_ids, rate: float):
+    """Bernoulli(1 - rate) keep mask for global attention positions.
+
+    seed_u32: uint32 scalar; bh: traced int scalar (b*H + h); s_idx:
+    static stream index; row_ids/col_ids: int32 (bq, bk) global q/k
+    positions. Returns bool (bq, bk)."""
+    threshold = jnp.uint32(min(int(round(rate * (2.0**32))), 2**32 - 1))
+    key = _fmix32(
+        seed_u32
+        ^ (bh.astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
+        ^ jnp.uint32(s_idx * 0x27D4EB2F)
+    )
+    x = (
+        row_ids.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+        ^ col_ids.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+    )
+    return _fmix32(x + key) >= threshold
+
+
+def _keep_mask_block(seed_ref, bh, S: int, q_start, k_start, bq: int, bk: int,
+                     rate: float):
+    """(S, bq, bk) keep mask for one score block (kernel-side)."""
+    # f32 -> i32 -> u32: Mosaic has no direct f32->u32 cast; the seed is a
+    # 24-bit integer so the value survives exactly
+    seed_u32 = seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.stack(
+        [dropout_keep_ids(seed_u32, bh, s, rows, cols, rate) for s in range(S)]
+    )
+
+
+def _apply_keep(p, keep, rate: float):
+    """Inverted dropout on (already-softmaxed or unnormalized) probs."""
+    return jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
+
+
+def dropout_seed_from_rng(rng) -> jnp.ndarray:
+    """(1, 1) float32 carrying a 24-bit seed drawn from a jax PRNG key —
+    exactly representable in float32, so SMEM can carry it without
+    bitcasting."""
+    bits = jax.random.bits(rng, (1, 1), jnp.uint32) >> 8
+    return bits.astype(jnp.float32)
+
+
+def dropout_keep_reference(seed: jnp.ndarray, BH: int, S: int, T: int,
+                           rate: float) -> jnp.ndarray:
+    """Plain-jnp twin of the kernels' mask generation: (BH, S, T, T) keep
+    booleans, bit-exact with what the compiled/interpreted kernels use for
+    the same ``seed`` (a (1, 1) float32 from :func:`dropout_seed_from_rng`).
+    Test/oracle use only — it materializes full T x T masks."""
+    seed_u32 = seed[0, 0].astype(jnp.int32).astype(jnp.uint32)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    out = []
+    for bh in range(BH):
+        bh_t = jnp.asarray(bh, jnp.int32)
+        out.append(
+            jnp.stack(
+                [
+                    dropout_keep_ids(seed_u32, bh_t, s, rows, cols, rate)
+                    for s in range(S)
+                ]
+            )
+        )
+    return jnp.stack(out)
+
+
+# ---------------------------------------------------------------------------
 # Shared kernel math
 # ---------------------------------------------------------------------------
 
@@ -161,6 +257,7 @@ def _fwd_kernel(
     v_ref,  # (1, T, dv)
     off_ref,  # (1, 1) float32 SMEM: causal row offset (0 = aligned causal;
     #           +-k*Tl for ring chunks whose K lives k shards away)
+    seed_ref,  # (1, 1) float32 SMEM: dropout seed (unread when rate == 0)
     *refs,  # [c_ref (BH, S) SMEM if emit_combined] then the outputs:
     #         [out_ref (1, block_q, dv) if emit_combined]
     #         [oall_ref (1, S, block_q, dv), lse_ref (1, S, block_q)
@@ -168,6 +265,7 @@ def _fwd_kernel(
     block_k: int,
     save_residuals: bool,
     emit_combined: bool = True,
+    dropout_rate: float = 0.0,
 ):
     """One online-softmax body for all three forward modes: the combined
     primal (coeff-weighted sum of streams), the residual-saving VJP
@@ -181,7 +279,8 @@ def _fwd_kernel(
     T = k_ref.shape[2]
     dv = v_ref.shape[2]
     nk = T // block_k
-    i = pl.program_id(1)
+    bh_id = pl.program_id(0)  # read at top level: the interpreter cannot
+    i = pl.program_id(1)      # lower program_id inside cond/when bodies
     q_start = i * block_q
     off = off_ref[0, 0].astype(jnp.int32)
 
@@ -199,9 +298,18 @@ def _fwd_kernel(
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (S, block_q)
             alpha = jnp.exp(m - m_new)
             p = jnp.exp(s - m_new[:, :, None])
+            # the normalizer accumulates the UNdropped p: softmax first,
+            # then dropout on the normalized map (diff_transformer.py:58-67)
             l_new = l * alpha + jnp.sum(p, axis=-1)
+            p_pv = p
+            if dropout_rate > 0.0:
+                keep = _keep_mask_block(
+                    seed_ref, bh_id, S, q_start, j * block_k,
+                    block_q, block_k, dropout_rate,
+                )
+                p_pv = _apply_keep(p, keep, dropout_rate)
             pv = jax.lax.dot_general(
-                p.astype(v_j.dtype), v_j,
+                p_pv.astype(v_j.dtype), v_j,
                 dimension_numbers=(((2,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )  # (S, block_q, dv) fp32 accum
@@ -249,10 +357,17 @@ def _fwd_call(
     block_k: int,
     save_residuals: bool,
     interpret: bool,
+    dropout_seed: Optional[jnp.ndarray] = None,  # (1, 1) float32
+    dropout_rate: float = 0.0,
 ):
     BH, S, T, d = q.shape
     dv = v.shape[-1]
     nq = T // block_q
+    seed = (
+        dropout_seed
+        if dropout_seed is not None
+        else jnp.zeros((1, 1), jnp.float32)
+    )
     if T > _KV_TILE_THRESHOLD:
         # stream K/V through the grid past the full-residency envelope
         results = _tiled_fwd_call(
@@ -260,13 +375,14 @@ def _fwd_call(
             block_q=block_q, block_k=block_k,
             save_residuals=save_residuals, emit_combined=True,
             interpret=interpret,
+            dropout_seed=seed, dropout_rate=dropout_rate,
         )
         if save_residuals:
             return results
         return results[0], None, None
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, save_residuals=save_residuals,
-        emit_combined=True,
+        emit_combined=True, dropout_rate=dropout_rate,
     )
     out_shapes = [jax.ShapeDtypeStruct((BH, T, dv), q.dtype)]
     out_specs = [
@@ -303,6 +419,7 @@ def _fwd_call(
             ),
             pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
             # the whole (BH, S) scalar coefficient table rides in SMEM; a
             # per-bh block would violate Mosaic's (8, 128) tiling check
             pl.BlockSpec((BH, S), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
@@ -310,7 +427,7 @@ def _fwd_call(
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=interpret,
-    )(q, k, v, jnp.zeros((1, 1), jnp.float32), coeffs)
+    )(q, k, v, jnp.zeros((1, 1), jnp.float32), seed, coeffs)
     if save_residuals:
         return results
     return results[0], None, None
@@ -332,10 +449,12 @@ def _tiled_fwd_kernel(
     k_ref,  # (1, S, block_k, d)    streamed
     v_ref,  # (1, block_k, dv)      streamed
     off_ref,  # (1, 1) float32 SMEM
+    seed_ref,  # (1, 1) float32 SMEM: dropout seed (unread when rate == 0)
     *refs,  # [c_ref if emit_combined] outputs [out][oall, lse] then
     #         scratch: m (S, block_q), l (S, block_q), acc (S, block_q, dv)
     save_residuals: bool,
     emit_combined: bool,
+    dropout_rate: float = 0.0,
 ):
     if emit_combined:
         c_ref, *rest = refs
@@ -369,9 +488,17 @@ def _tiled_fwd_kernel(
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, :, None])
+        # normalizer accumulates the UNdropped p (softmax then dropout)
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1)
+        p_pv = p
+        if dropout_rate > 0.0:
+            keep = _keep_mask_block(
+                seed_ref, bh, S, q_start, j * block_k,
+                block_q, block_k, dropout_rate,
+            )
+            p_pv = _apply_keep(p, keep, dropout_rate)
         pv = jax.lax.dot_general(
-            p.astype(v_j.dtype), v_j,
+            p_pv.astype(v_j.dtype), v_j,
             dimension_numbers=(((2,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -399,10 +526,16 @@ def _tiled_fwd_kernel(
 def _tiled_fwd_call(
     q, k, v, offset, coeffs, *,
     block_q, block_k, save_residuals, emit_combined, interpret,
+    dropout_seed=None, dropout_rate: float = 0.0,
 ):
     BH, S, T, d = q.shape
     dv = v.shape[-1]
     nq, nk = T // block_q, T // block_k
+    seed = (
+        dropout_seed
+        if dropout_seed is not None
+        else jnp.zeros((1, 1), jnp.float32)
+    )
     in_specs = [
         pl.BlockSpec((1, S, block_q, d), lambda b, i, j: (b, 0, i, 0),
                      memory_space=pltpu.VMEM),
@@ -411,8 +544,9 @@ def _tiled_fwd_call(
         pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b, j, 0),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, 1), lambda b, i, j: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1), lambda b, i, j: (0, 0), memory_space=pltpu.SMEM),
     ]
-    inputs = [q, k, v, offset]
+    inputs = [q, k, v, offset, seed]
     if emit_combined:
         in_specs.append(
             pl.BlockSpec((BH, S), lambda b, i, j: (0, 0),
@@ -440,7 +574,7 @@ def _tiled_fwd_call(
     results = pl.pallas_call(
         functools.partial(
             _tiled_fwd_kernel, save_residuals=save_residuals,
-            emit_combined=emit_combined,
+            emit_combined=emit_combined, dropout_rate=dropout_rate,
         ),
         grid=(BH, nq, nk),
         in_specs=in_specs,
@@ -464,11 +598,15 @@ def _tiled_dq_kernel(
     lse_ref,  # (1, S, block_q)
     delta_ref,  # (1, S, block_q)
     off_ref,  # (1, 1) SMEM
+    seed_ref,  # (1, 1) SMEM dropout seed
     dq_ref,  # (1, S, block_q, d)
     dq_scr,  # (S, block_q, d) f32 scratch
+    *,
+    dropout_rate: float = 0.0,
 ):
     S, block_q, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     block_k = k_ref.shape[2]
+    bh_id = pl.program_id(0)  # top-level read (see _tiled_fwd_kernel note)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
     q_start = pl.program_id(1) * block_q
@@ -494,6 +632,13 @@ def _tiled_dq_kernel(
             dimension_numbers=(((2,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if dropout_rate > 0.0:
+            # dP arrives through the dropout: dP~ = mask/keep * (dO V^T)
+            dkeep = _keep_mask_block(
+                seed_ref, bh_id, S, q_start, j * block_k,
+                block_q, block_k, dropout_rate,
+            )
+            dp = _apply_keep(dp, dkeep, dropout_rate)
         ds = p * (dp - delta[:, :, None])
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k_j.dtype), k_j,
@@ -514,13 +659,17 @@ def _tiled_dkv_kernel(
     lse_ref,  # (1, S, block_q)    streamed
     delta_ref,  # (1, S, block_q)  streamed
     off_ref,  # (1, 1) SMEM
+    seed_ref,  # (1, 1) SMEM dropout seed
     dk_ref,  # (1, S, block_k, d)
     dv_ref,  # (1, block_k, dv)
     dk_scr,  # (S, block_k, d) f32
     dv_scr,  # (block_k, dv) f32
+    *,
+    dropout_rate: float = 0.0,
 ):
     S, block_k, d = k_ref.shape[1], k_ref.shape[2], k_ref.shape[3]
     block_q = q_ref.shape[2]
+    bh_id = pl.program_id(0)  # top-level read (see _tiled_fwd_kernel note)
     i = pl.program_id(2)
     nq = pl.num_programs(2)
     k_start = pl.program_id(1) * block_k
@@ -541,9 +690,18 @@ def _tiled_dkv_kernel(
         delta_i = delta_ref[0]
         s, keep = _masked_scores(q_i, k, i * block_q, k_start, off, scale)
         p = jnp.where(keep, jnp.exp(s - lse_i[:, :, None]), 0.0)
-        p_lo = p.astype(do_i.dtype)
+        p_v = p
+        dkeep = None
+        if dropout_rate > 0.0:
+            dkeep = _keep_mask_block(
+                seed_ref, bh_id, S, i * block_q, k_start,
+                block_q, block_k, dropout_rate,
+            )
+            p_v = _apply_keep(p, dkeep, dropout_rate)  # dropped map P~
+        p_lo = p_v.astype(do_i.dtype)
         dv_acc = dv_scr[:]
         for s_idx in range(S):
+            # dV = sum_s P~_s^T dO_s (coeff already folded into dO_s)
             dv_acc = dv_acc + jax.lax.dot_general(
                 p_lo[s_idx], do_i[s_idx],
                 dimension_numbers=(((0,), (0,)), ((), ())),
@@ -555,6 +713,8 @@ def _tiled_dkv_kernel(
             dimension_numbers=(((2,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if dropout_rate > 0.0:
+            dp = _apply_keep(dp, dkeep, dropout_rate)
         ds = p * (dp - delta_i[:, :, None])
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds.astype(q_i.dtype), q_i,
@@ -569,16 +729,22 @@ def _tiled_dkv_kernel(
 
 
 def _tiled_bwd_call(
-    q, k, v, do_s, lse, delta, offset, *, block_q, block_k, interpret
+    q, k, v, do_s, lse, delta, offset, *, block_q, block_k, interpret,
+    dropout_seed=None, dropout_rate: float = 0.0,
 ):
     BH, S, T, d = q.shape
     dv_width = v.shape[-1]
     nq, nk = T // block_q, T // block_k
+    seed = (
+        dropout_seed
+        if dropout_seed is not None
+        else jnp.zeros((1, 1), jnp.float32)
+    )
     off_spec = pl.BlockSpec((1, 1), lambda b, x, y: (0, 0),
                             memory_space=pltpu.SMEM)
 
     dq = pl.pallas_call(
-        _tiled_dq_kernel,
+        functools.partial(_tiled_dq_kernel, dropout_rate=dropout_rate),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, S, block_q, d), lambda b, i, j: (b, 0, i, 0),
@@ -594,16 +760,17 @@ def _tiled_bwd_call(
             pl.BlockSpec((1, S, block_q), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
             off_spec,
+            off_spec,
         ],
         out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i, j: (b, 0, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((S, block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta, offset)
+    )(q, k, v, do_s, lse, delta, offset, seed)
 
     dk, dv = pl.pallas_call(
-        _tiled_dkv_kernel,
+        functools.partial(_tiled_dkv_kernel, dropout_rate=dropout_rate),
         grid=(BH, nk, nq),
         in_specs=[
             pl.BlockSpec((1, S, block_q, d), lambda b, j, i: (b, 0, i, 0),
@@ -618,6 +785,7 @@ def _tiled_bwd_call(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, S, block_q), lambda b, j, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
+            off_spec,
             off_spec,
         ],
         out_specs=[
@@ -635,7 +803,7 @@ def _tiled_bwd_call(
             pltpu.VMEM((block_k, dv_width), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta, offset)
+    )(q, k, v, do_s, lse, delta, offset, seed)
     return dq, dk, dv
 
 
@@ -653,13 +821,16 @@ def _bwd_dq_kernel(
     delta_ref,  # (1, S, block_q)     rowsum(dO_s * O_s)
     off_ref,  # (1, 1) float32 SMEM: causal row offset (0 = aligned causal;
     #           +-kTl for ring chunks whose K lives k shards away)
+    seed_ref,  # (1, 1) float32 SMEM dropout seed
     dq_ref,  # (1, S, block_q, d)
     *,
     block_k: int,
+    dropout_rate: float = 0.0,
 ):
     S, block_q, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     T = k_ref.shape[2]
     nk = T // block_k
+    bh_id = pl.program_id(0)  # top-level read (see _tiled_fwd_kernel note)
     i = pl.program_id(1)
     q_start = i * block_q
     off = off_ref[0, 0].astype(jnp.int32)
@@ -681,6 +852,13 @@ def _bwd_dq_kernel(
                 dimension_numbers=(((2,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )  # (S, block_q, block_k)
+            if dropout_rate > 0.0:
+                # dP arrives through the dropout: dP~ = mask/keep * (dO V^T)
+                dkeep = _keep_mask_block(
+                    seed_ref, bh_id, S, q_start, j * block_k,
+                    block_q, block_k, dropout_rate,
+                )
+                dp = _apply_keep(dp, dkeep, dropout_rate)
             ds = p * (dp - delta[:, :, None])
             return dq + jax.lax.dot_general(
                 ds.astype(k_j.dtype), k_j,
@@ -704,15 +882,18 @@ def _bwd_dkv_kernel(
     lse_ref,  # (1, S, T)
     delta_ref,  # (1, S, T)
     off_ref,  # (1, 1) float32 SMEM causal row offset (see _bwd_dq_kernel)
+    seed_ref,  # (1, 1) float32 SMEM dropout seed
     dk_ref,  # (1, S, block_k, d)
     dv_ref,  # (1, block_k, dv)
     *,
     block_q: int,
+    dropout_rate: float = 0.0,
 ):
     S, block_k, d = k_ref.shape[1], k_ref.shape[2], k_ref.shape[3]
     T = q_ref.shape[2]
     dv_width = v_ref.shape[2]
     nq = T // block_q
+    bh_id = pl.program_id(0)  # top-level read (see _tiled_fwd_kernel note)
     j = pl.program_id(1)
     k_start = j * block_k
     off = off_ref[0, 0].astype(jnp.int32)
@@ -731,8 +912,16 @@ def _bwd_dkv_kernel(
             delta_i = delta_ref[0, :, pl.ds(i * block_q, block_q)]
             s, keep = _masked_scores(q_i, k, i * block_q, k_start, off, scale)
             p = jnp.where(keep, jnp.exp(s - lse_i[:, :, None]), 0.0)
-            p_lo = p.astype(do_i.dtype)
-            # dV = sum_s P_s^T dO_s (coeff already folded into dO_s).
+            p_v = p
+            dkeep = None
+            if dropout_rate > 0.0:
+                dkeep = _keep_mask_block(
+                    seed_ref, bh_id, S, i * block_q, k_start,
+                    block_q, block_k, dropout_rate,
+                )
+                p_v = _apply_keep(p, dkeep, dropout_rate)  # dropped map P~
+            p_lo = p_v.astype(do_i.dtype)
+            # dV = sum_s P~_s^T dO_s (coeff already folded into dO_s).
             # Mosaic can't contract two dims at once, so loop streams
             # statically — S is tiny (1, 2, or n_terms).
             dv_new = dv
@@ -747,6 +936,8 @@ def _bwd_dkv_kernel(
                 dimension_numbers=(((2,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if dropout_rate > 0.0:
+                dp = _apply_keep(dp, dkeep, dropout_rate)
             ds = p * (dp - delta_i[:, :, None])
             dk_new = dk + jax.lax.dot_general(
                 ds.astype(q_i.dtype), q_i,
@@ -768,22 +959,31 @@ def _bwd_dkv_kernel(
 
 def _bwd_call(
     q, k, v, do_s, lse, delta, offset=None, *,
-    block_q: int, block_k: int, interpret: bool
+    block_q: int, block_k: int, interpret: bool,
+    dropout_seed=None, dropout_rate: float = 0.0,
 ):
     BH, S, T, d = q.shape
     dv_width = v.shape[-1]
     nq, nk = T // block_q, T // block_k
     if offset is None:
         offset = jnp.zeros((1, 1), jnp.float32)
+    seed = (
+        dropout_seed
+        if dropout_seed is not None
+        else jnp.zeros((1, 1), jnp.float32)
+    )
     if T > _KV_TILE_THRESHOLD:
         return _tiled_bwd_call(
             q, k, v, do_s, lse, delta, offset,
             block_q=block_q, block_k=block_k, interpret=interpret,
+            dropout_seed=seed, dropout_rate=dropout_rate,
         )
     off_spec = pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_k=block_k),
+        functools.partial(
+            _bwd_dq_kernel, block_k=block_k, dropout_rate=dropout_rate
+        ),
         grid=(BH, nq),
         in_specs=[
             pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
@@ -799,15 +999,18 @@ def _bwd_call(
             pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
             off_spec,
+            off_spec,
         ],
         out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta, offset)
+    )(q, k, v, do_s, lse, delta, offset, seed)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=block_q),
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, dropout_rate=dropout_rate
+        ),
         grid=(BH, nk),
         in_specs=[
             pl.BlockSpec((1, S, T, d), lambda b, j: (b, 0, 0, 0),
@@ -823,6 +1026,7 @@ def _bwd_call(
             pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             off_spec,
+            off_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, S, block_k, d), lambda b, j: (b, 0, j, 0),
@@ -835,7 +1039,7 @@ def _bwd_call(
             jax.ShapeDtypeStruct((BH, T, dv_width), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta, offset)
+    )(q, k, v, do_s, lse, delta, offset, seed)
     return dq, dk, dv
 
 
@@ -844,45 +1048,52 @@ def _bwd_call(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, coeffs, blocks, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, coeffs, seed, blocks, interpret, rate=0.0):
     """``blocks`` = (block_q, block_k, block_q_train, block_k_train).
     The inference primal and the differentiated path want different
-    tilings (measured on v5e: inference is fastest streaming wide K
-    blocks; the residual-saving forward and the backward both prefer
-    square 128 tiles), so they are tuned independently."""
+    tilings, so they are tuned independently. ``seed`` is the (1, 1)
+    float32 dropout seed (dropout_seed_from_rng); ``rate`` the static
+    attention-prob dropout rate — both forward and backward regenerate
+    the same counter-based masks from (seed, global coords)."""
     out, _, _ = _fwd_call(
         q, k, v, coeffs,
         block_q=blocks[0], block_k=blocks[1],
         save_residuals=False, interpret=interpret,
+        dropout_seed=seed, dropout_rate=rate,
     )
     return out
 
 
-def _flash_fwd(q, k, v, coeffs, blocks, interpret):
+def _flash_fwd(q, k, v, coeffs, seed, blocks, interpret, rate=0.0):
     out, o_all, lse = _fwd_call(
         q, k, v, coeffs,
         block_q=blocks[2], block_k=blocks[3],
         save_residuals=True, interpret=interpret,
+        dropout_seed=seed, dropout_rate=rate,
     )
-    return out, (q, k, v, coeffs, o_all, lse)
+    return out, (q, k, v, coeffs, seed, o_all, lse)
 
 
-def _flash_bwd(blocks, interpret, res, g):
-    q, k, v, coeffs, o_all, lse = res
+def _flash_bwd(blocks, interpret, rate, res, g):
+    q, k, v, coeffs, seed, o_all, lse = res
     g32 = g.astype(jnp.float32)
     o32 = o_all.astype(jnp.float32)
     # d(coeff)[bh, s] = <g, O_s>
     dcoeffs = jnp.einsum("btd,bstd->bs", g32, o32)
     # per-stream upstream grad with the combine coefficient folded in
     do_s = (coeffs[:, :, None, None] * g32[:, None, :, :]).astype(q.dtype)
-    # flash backward rowsum: delta_s = rowsum(dO_s * O_s)
+    # flash backward rowsum: delta_s = rowsum(dO_s * O_s). Valid with
+    # dropout too: rowsum(dP~ . P) = rowsum((mask/keep . dA) . P)
+    # = rowsum(dA . P~) = rowsum(dO . O) since elementwise products
+    # commute — so the same residuals serve both regimes.
     delta = jnp.einsum("bstd,bstd->bst", do_s.astype(jnp.float32), o32)
     dq, dk, dv = _bwd_call(
         q, k, v, do_s, lse, delta,
         block_q=blocks[2], block_k=blocks[3], interpret=interpret,
+        dropout_seed=seed, dropout_rate=rate,
     )
-    return dq, dk, dv, dcoeffs.astype(coeffs.dtype)
+    return dq, dk, dv, dcoeffs.astype(coeffs.dtype), jnp.zeros_like(seed)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -923,6 +1134,7 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret):
             pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, S, block_q, dv), lambda b, i: (b, 0, i, 0),
@@ -935,7 +1147,7 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((BH, S, T), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, offset)
+    )(q, k, v, offset, jnp.zeros((1, 1), jnp.float32))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -998,10 +1210,20 @@ def multi_stream_flash_attention(
     block_q_train: Optional[int] = None,
     block_k_train: Optional[int] = None,
     interpret: Optional[bool] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """Fused causal attention: ``sum_s coeffs[s,h] * softmax(Q_s K_s^T /
     sqrt(d)) @ V`` without materializing any T x T map. Returns
     (B, T, H, dv).
+
+    ``dropout_rate`` > 0 with a ``dropout_rng`` key applies attention-
+    probability dropout INSIDE the kernel (each softmax map dropped
+    independently after normalization, inverted scaling — the reference
+    semantics, diff_transformer.py:58-67) via a counter-based hash of the
+    global (stream, b*H+h, row, col) position, so forward and backward
+    regenerate identical masks and no T x T mask is ever materialized.
+    Without a key the rate is inert (eval semantics, like ops/dropout.py).
 
     Block defaults resolve per device kind (:func:`default_blocks`). On
     v5e they are the measured optima (tools/flash_sweep.py): (512, 1024)
@@ -1030,7 +1252,13 @@ def multi_stream_flash_attention(
     c_r = jnp.broadcast_to(
         coeffs.astype(jnp.float32).T[None], (B, H, S)
     ).reshape(B * H, S)
-    out = _flash(q_r, k_r, v_r, c_r, blocks, interpret)  # (BH, T, dv)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        seed = dropout_seed_from_rng(dropout_rng)
+        rate = float(dropout_rate)
+    else:
+        seed = jnp.zeros((1, 1), jnp.float32)
+        rate = 0.0
+    out = _flash(q_r, k_r, v_r, c_r, seed, blocks, interpret, rate)  # (BH, T, dv)
     return out.reshape(B, H, T, dv).transpose(0, 2, 1, 3)
 
 

@@ -56,13 +56,44 @@ def shard_flash_multi_stream_attention(
     v: jnp.ndarray,  # (B, T, H, dv)
     coeffs: jnp.ndarray,  # (S, H) float32
     mesh: Mesh,
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
 ) -> jnp.ndarray:
     """``multi_stream_flash_attention`` with batch sharded over
     data x fsdp and heads over tensor. Global shapes in, global out —
-    callable from inside the outer GSPMD jit."""
+    callable from inside the outer GSPMD jit.
+
+    With active dropout, the replicated rng key is folded with the
+    device's mesh position inside the shard_map body: the kernel keys its
+    masks on the LOCAL (b*H + h) grid index, which repeats across shards,
+    so without the fold every batch/head shard would reuse the same
+    masks."""
     qk_spec = P(None, _BATCH_AXES, None, _HEAD_AXIS, None)
     v_spec = P(_BATCH_AXES, None, _HEAD_AXIS, None)
     c_spec = P(None, _HEAD_AXIS)
+    use_drop = dropout_rate > 0.0 and dropout_rng is not None
+
+    if use_drop:
+        def body(qs_l, ks_l, v_l, c_l, rng):
+            pos = (
+                jax.lax.axis_index(_BATCH_AXES[0]) * mesh.shape[_BATCH_AXES[1]]
+                + jax.lax.axis_index(_BATCH_AXES[1])
+            ) * mesh.shape[_HEAD_AXIS] + jax.lax.axis_index(_HEAD_AXIS)
+            return multi_stream_flash_attention(
+                qs_l, ks_l, v_l, c_l,
+                dropout_rate=dropout_rate,
+                dropout_rng=jax.random.fold_in(rng, pos),
+            )
+
+        inner = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(qk_spec, qk_spec, v_spec, c_spec, P()),
+            out_specs=v_spec,
+            check_vma=False,
+        )
+        return inner(qs, ks, v, coeffs, dropout_rng)
 
     def body(qs_l, ks_l, v_l, c_l):
         return multi_stream_flash_attention(qs_l, ks_l, v_l, c_l)
@@ -77,24 +108,26 @@ def shard_flash_multi_stream_attention(
     return inner(qs, ks, v, coeffs)
 
 
-def shard_flash_vanilla_attention(q, k, v, mesh: Mesh):
+def shard_flash_vanilla_attention(q, k, v, mesh: Mesh, **kw):
     """Mesh form of ops.flash.flash_vanilla_attention."""
     return shard_flash_multi_stream_attention(
-        q[None], k[None], v, vanilla_coeffs(q.shape[2]), mesh
+        q[None], k[None], v, vanilla_coeffs(q.shape[2]), mesh, **kw
     )
 
 
-def shard_flash_diff_attention(q1, k1, q2, k2, v, lam, mesh: Mesh):
+def shard_flash_diff_attention(q1, k1, q2, k2, v, lam, mesh: Mesh, **kw):
     """Mesh form of ops.flash.flash_diff_attention: coeffs [1, -lambda]
     (diff_transformer.py:70)."""
     qs = jnp.stack([q1, q2])
     ks = jnp.stack([k1, k2])
-    return shard_flash_multi_stream_attention(qs, ks, v, diff_coeffs(lam), mesh)
+    return shard_flash_multi_stream_attention(
+        qs, ks, v, diff_coeffs(lam), mesh, **kw
+    )
 
 
-def shard_flash_ndiff_attention(qs, ks, v, lams, signs, mesh: Mesh):
+def shard_flash_ndiff_attention(qs, ks, v, lams, signs, mesh: Mesh, **kw):
     """Mesh form of ops.flash.flash_ndiff_attention: coeffs
     ``sign_s * lambda_{s,h}`` (Ndiff_transformer.py:119-123)."""
     return shard_flash_multi_stream_attention(
-        qs, ks, v, ndiff_coeffs(lams, signs), mesh
+        qs, ks, v, ndiff_coeffs(lams, signs), mesh, **kw
     )

@@ -1,0 +1,193 @@
+"""In-kernel attention-probability dropout (ops/flash.py).
+
+The reference drops out each softmax map independently, after
+normalization, with inverted scaling (diff_transformer.py:58-67). The
+flash kernels implement this with a counter-based hash mask keyed on
+global coordinates; ``dropout_keep_reference`` is the plain-jnp twin of
+the kernel's mask generation, so a dense oracle using the SAME masks must
+match the kernel bit-for-bit (up to fp32 accumulation order) — an exact
+parity test, not a statistical one.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.ops import flash as F
+
+S, B, T, H, d = 2, 2, 32, 2, 8
+DV = 2 * d
+RATE = 0.3
+
+
+def make_inputs(seed=0):
+    ks_ = jax.random.split(jax.random.PRNGKey(seed), 4)
+    qs = jax.random.normal(ks_[0], (S, B, T, H, d), jnp.float32)
+    ks = jax.random.normal(ks_[1], (S, B, T, H, d), jnp.float32)
+    v = jax.random.normal(ks_[2], (B, T, H, DV), jnp.float32)
+    coeffs = jax.random.uniform(ks_[3], (S, H), jnp.float32, 0.2, 1.0)
+    return qs, ks, v, coeffs
+
+
+def dense_with_masks(qs, ks, v, coeffs, keep, rate):
+    """Dense oracle: softmax -> (given) dropout masks -> coeff combine."""
+    scale = 1.0 / np.sqrt(d)
+    scores = jnp.einsum("sbthd,sbuhd->sbhtu", qs, ks).astype(jnp.float32) * scale
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(causal, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)  # (S, B, H, T, T)
+    if keep is not None:
+        keep_r = keep.reshape(B, H, S, T, T).transpose(2, 0, 1, 3, 4)
+        probs = jnp.where(keep_r, probs / (1.0 - rate), 0.0)
+    combined = jnp.einsum("sh,sbhtu->bhtu", coeffs, probs)
+    return jnp.einsum("bhtu,buhe->bthe", combined, v)
+
+
+def test_forward_matches_dense_with_same_masks():
+    qs, ks, v, coeffs = make_inputs()
+    rng = jax.random.PRNGKey(7)
+    got = F.multi_stream_flash_attention(
+        qs, ks, v, coeffs, dropout_rate=RATE, dropout_rng=rng
+    )
+    keep = F.dropout_keep_reference(F.dropout_seed_from_rng(rng), B * H, S, T, RATE)
+    want = dense_with_masks(qs, ks, v, coeffs, keep, RATE)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_grad_matches_dense_with_same_masks():
+    qs, ks, v, coeffs = make_inputs(1)
+    rng = jax.random.PRNGKey(11)
+    keep = F.dropout_keep_reference(F.dropout_seed_from_rng(rng), B * H, S, T, RATE)
+
+    def loss_flash(qs, ks, v):
+        out = F.multi_stream_flash_attention(
+            qs, ks, v, coeffs, dropout_rate=RATE, dropout_rng=rng
+        )
+        return jnp.sum(out * jnp.cos(out))  # nontrivial cotangent
+
+    def loss_dense(qs, ks, v):
+        out = dense_with_masks(qs, ks, v, coeffs, keep, RATE)
+        return jnp.sum(out * jnp.cos(out))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(qs, ks, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(qs, ks, v)
+    for a, b, name in zip(gf, gd, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, err_msg=name
+        )
+
+
+def test_tiled_kernels_match_dense_with_same_masks(monkeypatch):
+    """Force the KV-streamed kernel variants (T > threshold) and check the
+    same exact parity — the tiled fwd/dq/dkv kernels regenerate identical
+    masks from the same global coordinates."""
+    monkeypatch.setattr(F, "_KV_TILE_THRESHOLD", 16)
+    qs, ks, v, coeffs = make_inputs(2)
+    rng = jax.random.PRNGKey(13)
+    keep = F.dropout_keep_reference(F.dropout_seed_from_rng(rng), B * H, S, T, RATE)
+
+    def loss_flash(qs, ks, v):
+        out = F.multi_stream_flash_attention(
+            qs, ks, v, coeffs, dropout_rate=RATE, dropout_rng=rng,
+            block_q=16, block_k=16, block_q_train=16, block_k_train=16,
+        )
+        return jnp.sum(out * out)
+
+    def loss_dense(qs, ks, v):
+        out = dense_with_masks(qs, ks, v, coeffs, keep, RATE)
+        return jnp.sum(out * out)
+
+    np.testing.assert_allclose(
+        float(loss_flash(qs, ks, v)), float(loss_dense(qs, ks, v)), rtol=1e-5
+    )
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(qs, ks, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(qs, ks, v)
+    for a, b, name in zip(gf, gd, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, err_msg=name
+        )
+
+
+def test_rate_zero_is_identity_with_baseline():
+    qs, ks, v, coeffs = make_inputs(3)
+    base = F.multi_stream_flash_attention(qs, ks, v, coeffs)
+    z = F.multi_stream_flash_attention(
+        qs, ks, v, coeffs, dropout_rate=0.0, dropout_rng=jax.random.PRNGKey(0)
+    )
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(z))
+    # no rng key => rate inert (eval semantics, like ops/dropout.py)
+    no_key = F.multi_stream_flash_attention(qs, ks, v, coeffs, dropout_rate=RATE)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(no_key))
+
+
+def test_deterministic_per_key_and_varies_across_keys():
+    qs, ks, v, coeffs = make_inputs(4)
+    a = F.multi_stream_flash_attention(
+        qs, ks, v, coeffs, dropout_rate=RATE, dropout_rng=jax.random.PRNGKey(5)
+    )
+    b = F.multi_stream_flash_attention(
+        qs, ks, v, coeffs, dropout_rate=RATE, dropout_rng=jax.random.PRNGKey(5)
+    )
+    c = F.multi_stream_flash_attention(
+        qs, ks, v, coeffs, dropout_rate=RATE, dropout_rng=jax.random.PRNGKey(6)
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_mask_keep_fraction():
+    keep = F.dropout_keep_reference(
+        F.dropout_seed_from_rng(jax.random.PRNGKey(9)), 4, 2, 64, RATE
+    )
+    frac = float(jnp.mean(keep.astype(jnp.float32)))
+    n = keep.size
+    sigma = np.sqrt(RATE * (1 - RATE) / n)
+    assert abs(frac - (1 - RATE)) < 4 * sigma + 1e-3, frac
+
+
+def test_mask_decorrelated_across_bh_and_streams():
+    keep = F.dropout_keep_reference(
+        F.dropout_seed_from_rng(jax.random.PRNGKey(10)), 2, 2, 64, 0.5
+    )
+    # (BH, S, T, T): any two distinct slices should differ
+    assert not np.array_equal(np.asarray(keep[0]), np.asarray(keep[1]))
+    assert not np.array_equal(np.asarray(keep[0, 0]), np.asarray(keep[0, 1]))
+
+
+@pytest.mark.parametrize("kind", ["control", "diff", "ndiff"])
+def test_model_forward_with_fused_dropout(kind):
+    from differential_transformer_replication_tpu.config import ModelConfig
+    from differential_transformer_replication_tpu.models import (
+        init_model,
+        model_forward,
+    )
+
+    cfg = ModelConfig(
+        model=kind, vocab_size=64, n_embd=32, n_head=2, n_layer=2,
+        block_size=16, dropout=0.25, compute_dtype="float32",
+        attention_impl="pallas",
+    )
+    params = init_model(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    y = jnp.roll(x, -1, -1)
+    _, loss_train = model_forward(
+        params, x, cfg, targets=y, rng=jax.random.PRNGKey(2)
+    )
+    _, loss_eval = model_forward(params, x, cfg, targets=y, rng=None)
+    assert np.isfinite(float(loss_train)) and np.isfinite(float(loss_eval))
+    # dropout active on the train path only
+    assert float(loss_train) != float(loss_eval)
+    # gradient flows through the fused dropout
+    g = jax.grad(
+        lambda p: model_forward(
+            p, x, cfg, targets=y, rng=jax.random.PRNGKey(2)
+        )[1]
+    )(params)
+    gn = float(
+        jnp.sqrt(
+            sum(jnp.sum(a.astype(jnp.float32) ** 2)
+                for a in jax.tree_util.tree_leaves(g))
+        )
+    )
+    assert np.isfinite(gn) and gn > 0
