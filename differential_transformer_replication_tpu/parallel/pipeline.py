@@ -47,9 +47,10 @@ PP x DP. ``tensor``/``sequence`` must be 1 when pipeline > 1 (their
 sharding lives in the GSPMD path, parallel/dp_step.py; composing them
 with manual pipelining is out of scope and raises loudly).
 
-Restrictions (checked): ``n_layer % P == 0``, ``dropout == 0`` (the
-reference's default, train.py:64), and — at train-step construction —
-``micro_batch_size`` divisible by data*fsdp.
+Restrictions (checked): ``n_layer % P == 0`` and — at train-step
+construction — ``micro_batch_size`` divisible by data*fsdp. Dropout is
+supported: the step's rng is folded per (data-shard, microbatch, layer)
+through the tick schedule (make_pipeline_loss).
 """
 
 from __future__ import annotations
@@ -141,31 +142,40 @@ def _check_pipeline_cfg(model_cfg: ModelConfig, mesh: Mesh) -> int:
         raise ValueError(
             f"n_layer={model_cfg.n_layer} not divisible by pipeline={n_stages}"
         )
-    if model_cfg.dropout > 0.0:
-        raise NotImplementedError(
-            "pipeline step runs dropout-free (the reference default, "
-            "train.py:64); per-microbatch rng threading through the GPipe "
-            "schedule is not implemented"
-        )
     return n_stages
 
 
 def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
-    """Returns ``loss(params_stacked, x, y) -> scalar`` where ``x``/``y``
-    are ``(M, B, T)`` microbatched token/target ids. The scalar is the
-    microbatch-mean loss, averaged over data shards — identical semantics
-    to the grad-accumulation scan in train/step.py."""
+    """Returns ``loss(params_stacked, x, y, rng=None) -> scalar`` where
+    ``x``/``y`` are ``(M, B, T)`` microbatched token/target ids. The
+    scalar is the microbatch-mean loss, averaged over data shards —
+    identical semantics to the grad-accumulation scan in train/step.py.
+
+    With ``rng`` given and ``model_cfg.dropout > 0``, dropout is live:
+    each (data-shard, microbatch, layer) gets an independent key — the
+    base key is folded with the shard's mesh position, then with the
+    microbatch index inside the tick, and block_forward splits per
+    layer. Without a key, dropout is inert (eval semantics)."""
     n_stages = _check_pipeline_cfg(model_cfg, mesh)
     layers_per_stage = model_cfg.n_layer // n_stages
     mod = model_module(model_cfg)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-    def spmd(blocks_loc, rest, x, y):
+    def spmd(blocks_loc, rest, x, y, rng):
         # blocks_loc: stage's stacked layers (leading axis layers_per_stage)
         # rest: embed/ln_f/lm_head params, replicated; x/y: (M, B_loc, T)
+        # rng: (2,) uint32 key or None (traced; replicated spec)
         stage = jax.lax.axis_index(_PIPE_AXIS)
         M, B, T = x.shape
         is_last = stage == n_stages - 1
+        if rng is not None:
+            # distinct masks per data shard (the batch is sharded, so the
+            # same key on every shard would reuse masks across examples)
+            pos = (
+                jax.lax.axis_index(_DATA_AXES[0]) * mesh.shape[_DATA_AXES[1]]
+                + jax.lax.axis_index(_DATA_AXES[1])
+            )
+            rng = jax.random.fold_in(rng, pos)
 
         cos, sin = (
             rope_cos_sin(model_cfg.head_size, T)
@@ -174,12 +184,13 @@ def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
         )
         mask = causal_mask(T)
 
-        def stage_fn(h):
+        def stage_fn(h, mb_rng):
             def layer(h, xs):
                 blk, j = xs
                 li = stage * layers_per_stage + j + 1  # 1-based, traced
+                r = None if mb_rng is None else jax.random.fold_in(mb_rng, li)
                 fn = lambda h, blk: mod.block_forward(
-                    h, blk, li, model_cfg, cos, sin, mask
+                    h, blk, li, model_cfg, cos, sin, mask, r
                 )
                 if model_cfg.remat:
                     fn = jax.checkpoint(fn)
@@ -198,7 +209,11 @@ def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
             # largest tensor in the schedule at long context
             feed = mod.embed(rest, x[jnp.clip(t, 0, M - 1)], model_cfg)
             inp = jnp.where(stage == 0, feed, state)
-            out = stage_fn(inp)
+            # the microbatch this stage works on at tick t (clipped garbage
+            # during bubble ticks — its output is never used)
+            mb = jnp.clip(t - stage, 0, M - 1)
+            mb_rng = None if rng is None else jax.random.fold_in(rng, mb)
+            out = stage_fn(inp, mb_rng)
             o_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
             valid = jnp.logical_and(is_last, t - (n_stages - 1) >= 0)
 
@@ -232,19 +247,31 @@ def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
         loss = jax.lax.psum(loss_loc, _PIPE_AXIS)  # broadcast to all stages
         return jax.lax.pmean(loss, _DATA_AXES)
 
-    smapped = jax.shard_map(
+    data_specs = (P(_PIPE_AXIS), P(), P(None, _DATA_AXES, None),
+                  P(None, _DATA_AXES, None))
+    smapped_plain = jax.shard_map(
+        lambda b, r, x, y: spmd(b, r, x, y, None),
+        mesh=mesh,
+        in_specs=data_specs,
+        out_specs=P(),
+        check_vma=False,
+    )
+    smapped_dropout = jax.shard_map(
         spmd,
         mesh=mesh,
-        in_specs=(P(_PIPE_AXIS), P(), P(None, _DATA_AXES, None),
-                  P(None, _DATA_AXES, None)),
+        in_specs=data_specs + (P(),),
         out_specs=P(),
         check_vma=False,
     )
 
-    def loss_fn(params: dict, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    def loss_fn(
+        params: dict, x: jnp.ndarray, y: jnp.ndarray, rng=None
+    ) -> jnp.ndarray:
         blocks = params["blocks"]
         rest = {k: v for k, v in params.items() if k != "blocks"}
-        return smapped(blocks, rest, x, y)
+        if rng is not None and model_cfg.dropout > 0.0:
+            return smapped_dropout(blocks, rest, x, y, rng)
+        return smapped_plain(blocks, rest, x, y)
 
     return loss_fn
 
@@ -300,9 +327,8 @@ def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict)
     loss_f = make_pipeline_loss(model_cfg, mesh)
 
     def raw_step(state, batch, rng=None):
-        del rng  # dropout-free by construction (checked above)
         loss, grads = jax.value_and_grad(loss_f)(
-            state["params"], batch["x"], batch["y"]
+            state["params"], batch["x"], batch["y"], rng
         )
         updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
         params = optax.apply_updates(state["params"], updates)
