@@ -84,7 +84,6 @@ def _attn(
     # lazy import: parallel/__init__ pulls in the training stack, which
     # imports models — importing at call (trace) time breaks the cycle
     from differential_transformer_replication_tpu.parallel.ring import (
-        check_ring_dropout,
         ring_vanilla_attention,
         use_ring,
     )
@@ -94,8 +93,10 @@ def _attn(
     )
 
     if use_ring(mesh):
-        check_ring_dropout(dropout_rate, r_att)
-        out = ring_vanilla_attention(q, k, v, mesh, impl)
+        out = ring_vanilla_attention(
+            q, k, v, mesh, impl,
+            dropout_rate=dropout_rate, dropout_rng=r_att,
+        )
     elif use_flash(impl, dropout_rate, r_att):
         if use_shard_flash(mesh):
             out = shard_flash_vanilla_attention(

@@ -103,7 +103,6 @@ def _attn(
     # lazy import: parallel/__init__ pulls in the training stack, which
     # imports models — importing at call (trace) time breaks the cycle
     from differential_transformer_replication_tpu.parallel.ring import (
-        check_ring_dropout,
         ring_diff_attention,
         use_ring,
     )
@@ -113,8 +112,10 @@ def _attn(
     )
 
     if use_ring(mesh):
-        check_ring_dropout(dropout_rate, r_att)
-        out = ring_diff_attention(qs[0], ks[0], qs[1], ks[1], v, lam, mesh, impl)
+        out = ring_diff_attention(
+            qs[0], ks[0], qs[1], ks[1], v, lam, mesh, impl,
+            dropout_rate=dropout_rate, dropout_rng=r_att,
+        )
     elif use_flash(impl, dropout_rate, r_att):
         # pass the stacked streams straight through — slicing qs[0]/qs[1]
         # only for flash_diff_attention to re-stack them costs real copies

@@ -26,10 +26,10 @@ backward, generalized to S streams. The per-stream outputs O_s are saved
 from the forward so that d(coeff) and the flash "delta" rowsum need no
 extra recompute pass.
 
-Restrictions (documented per SURVEY.md section 7.7): attention-probability
-dropout is NOT fused — the reference trains with dropout=0.0 (train.py:64);
-models fall back to the XLA path when dropout is active (rate > 0 AND an
-rng is supplied).
+Attention-probability dropout (diff_transformer.py:58-67) is fused
+in-kernel: counter-based hash masks of the global coordinates, identical
+across forward/backward and across tilings — see the dropout section
+below and tests/test_flash_dropout.py.
 
 Two kernel generations, dispatched on T (measured on v5e at the
 flagship diff shapes):
@@ -172,13 +172,22 @@ def dropout_keep_ids(seed_u32, bh, s_idx: int, row_ids, col_ids, rate: float):
 
 
 def _keep_mask_block(seed_ref, bh, S: int, q_start, k_start, bq: int, bk: int,
-                     rate: float):
-    """(S, bq, bk) keep mask for one score block (kernel-side)."""
+                     rate: float, off=None):
+    """(S, bq, bk) keep mask for one score block (kernel-side).
+
+    ``off`` is the ring-chunk causal offset: subtracting it from the
+    column coordinate recovers a per-device-unique K position
+    (``k_local - off = k_global - my*Tl``), so on the sequence-parallel
+    ring every (q, k) pair hashes distinctly across the rotation steps
+    while the aligned paths (off=0) keep plain global coordinates —
+    which is also what dropout_keep_reference reproduces."""
     # f32 -> i32 -> u32: Mosaic has no direct f32->u32 cast; the seed is a
     # 24-bit integer so the value survives exactly
     seed_u32 = seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
     rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    if off is not None:
+        cols = cols - off
     return jnp.stack(
         [dropout_keep_ids(seed_u32, bh, s, rows, cols, rate) for s in range(S)]
     )
@@ -305,7 +314,7 @@ def _fwd_kernel(
             if dropout_rate > 0.0:
                 keep = _keep_mask_block(
                     seed_ref, bh_id, S, q_start, j * block_k,
-                    block_q, block_k, dropout_rate,
+                    block_q, block_k, dropout_rate, off,
                 )
                 p_pv = _apply_keep(p, keep, dropout_rate)
             pv = jax.lax.dot_general(
@@ -494,7 +503,7 @@ def _tiled_fwd_kernel(
         if dropout_rate > 0.0:
             keep = _keep_mask_block(
                 seed_ref, bh, S, q_start, j * block_k,
-                block_q, block_k, dropout_rate,
+                block_q, block_k, dropout_rate, off,
             )
             p_pv = _apply_keep(p, keep, dropout_rate)
         pv = jax.lax.dot_general(
@@ -636,7 +645,7 @@ def _tiled_dq_kernel(
             # dP arrives through the dropout: dP~ = mask/keep * (dO V^T)
             dkeep = _keep_mask_block(
                 seed_ref, bh_id, S, q_start, j * block_k,
-                block_q, block_k, dropout_rate,
+                block_q, block_k, dropout_rate, off,
             )
             dp = _apply_keep(dp, dkeep, dropout_rate)
         ds = p * (dp - delta[:, :, None])
@@ -695,7 +704,7 @@ def _tiled_dkv_kernel(
         if dropout_rate > 0.0:
             dkeep = _keep_mask_block(
                 seed_ref, bh_id, S, i * block_q, k_start,
-                block_q, block_k, dropout_rate,
+                block_q, block_k, dropout_rate, off,
             )
             p_v = _apply_keep(p, dkeep, dropout_rate)  # dropped map P~
         p_lo = p_v.astype(do_i.dtype)
@@ -856,7 +865,7 @@ def _bwd_dq_kernel(
                 # dP arrives through the dropout: dP~ = mask/keep * (dO V^T)
                 dkeep = _keep_mask_block(
                     seed_ref, bh_id, S, q_start, j * block_k,
-                    block_q, block_k, dropout_rate,
+                    block_q, block_k, dropout_rate, off,
                 )
                 dp = _apply_keep(dp, dkeep, dropout_rate)
             ds = p * (dp - delta[:, :, None])
@@ -917,7 +926,7 @@ def _bwd_dkv_kernel(
             if dropout_rate > 0.0:
                 dkeep = _keep_mask_block(
                     seed_ref, bh_id, S, i * block_q, k_start,
-                    block_q, block_k, dropout_rate,
+                    block_q, block_k, dropout_rate, off,
                 )
                 p_v = _apply_keep(p, dkeep, dropout_rate)  # dropped map P~
             p_lo = p_v.astype(do_i.dtype)
@@ -1105,7 +1114,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # ---------------------------------------------------------------------------
 
 
-def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret):
+def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret,
+                    dropout_seed=None, dropout_rate: float = 0.0):
     """Per-stream (o_all, lse) with offset-causal masking — the unified
     forward kernel in its no-combine mode. off = +Tl*k means K lives k
     shards earlier in the ring (fully visible once off >= T); large
@@ -1114,16 +1124,22 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret):
     BH, S, T, d = q.shape
     dv = v.shape[-1]
     nq = T // block_q
+    seed = (
+        dropout_seed
+        if dropout_seed is not None
+        else jnp.zeros((1, 1), jnp.float32)
+    )
     if T > _KV_TILE_THRESHOLD:
         return _tiled_fwd_call(
             q, k, v, offset, None,
             block_q=block_q, block_k=block_k,
             save_residuals=True, emit_combined=False, interpret=interpret,
+            dropout_seed=seed, dropout_rate=dropout_rate,
         )
     return pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_k=block_k, save_residuals=True,
-            emit_combined=False,
+            emit_combined=False, dropout_rate=dropout_rate,
         ),
         grid=(BH, nq),
         in_specs=[
@@ -1147,39 +1163,45 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((BH, S, T), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, offset, jnp.zeros((1, 1), jnp.float32))
+    )(q, k, v, offset, seed)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_chunk_attention(q, k, v, offset, blocks, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def flash_chunk_attention(q, k, v, offset, seed, blocks, interpret, rate=0.0):
     """Per-stream offset-causal flash chunk: ``(O_s, lse_s)`` for
-    ``O_s = softmax(Q_s K_s^T / sqrt(d) + offset-causal mask) @ V``.
+    ``O_s = [dropout](softmax(Q_s K_s^T / sqrt(d) + offset-causal
+    mask)) @ V``.
 
     q/k: (BH, S, T, d); v: (BH, T, dv); offset: (1, 1) float32 (traced —
-    inside a shard_map ring it is a function of axis_index). Returns
-    (o_all (BH, S, T, dv), lse (BH, S, T)). Chunks combine exactly via the
-    running logsumexp merge (parallel/ring.py)."""
+    inside a shard_map ring it is a function of axis_index); ``seed`` a
+    (1, 1) float32 dropout seed (zeros when rate == 0). Returns
+    (o_all (BH, S, T, dv), lse (BH, S, T)); lse accumulates the UNdropped
+    probabilities, so chunks still combine exactly via the running
+    logsumexp merge (parallel/ring.py) — softmax-then-dropout semantics
+    globally. Dropout masks hash (row, col - off), which is unique per
+    (q, k) pair across the ring rotation on a given device."""
     return _chunk_fwd_call(
         q, k, v, offset, block_q=blocks[0], block_k=blocks[1],
-        interpret=interpret,
+        interpret=interpret, dropout_seed=seed, dropout_rate=rate,
     )
 
 
-def _flash_chunk_fwd(q, k, v, offset, blocks, interpret):
+def _flash_chunk_fwd(q, k, v, offset, seed, blocks, interpret, rate=0.0):
     o_all, lse = _chunk_fwd_call(
         q, k, v, offset, block_q=blocks[0], block_k=blocks[1],
-        interpret=interpret,
+        interpret=interpret, dropout_seed=seed, dropout_rate=rate,
     )
-    return (o_all, lse), (q, k, v, offset, o_all, lse)
+    return (o_all, lse), (q, k, v, offset, seed, o_all, lse)
 
 
-def _flash_chunk_bwd(blocks, interpret, res, ct):
-    q, k, v, offset, o_all, lse = res
+def _flash_chunk_bwd(blocks, interpret, rate, res, ct):
+    q, k, v, offset, seed, o_all, lse = res
     do, dlse = ct  # cotangents for both outputs
     do32 = do.astype(jnp.float32)
     # dS = P * (dP_raw - delta + dlse): the lse cotangent folds into the
     # delta term of the standard flash backward (dlse_i distributes over the
-    # row's probabilities)
+    # row's probabilities). With dropout, only the dP term is masked (the
+    # lse path sees undropped probabilities), which the kernels implement.
     delta_eff = (
         jnp.einsum("bstd,bstd->bst", do32, o_all.astype(jnp.float32))
         - dlse.astype(jnp.float32)
@@ -1187,8 +1209,9 @@ def _flash_chunk_bwd(blocks, interpret, res, ct):
     dq, dk, dv = _bwd_call(
         q, k, v, do.astype(q.dtype), lse, delta_eff, offset,
         block_q=blocks[2], block_k=blocks[3], interpret=interpret,
+        dropout_seed=seed, dropout_rate=rate,
     )
-    return dq, dk, dv, jnp.zeros_like(offset)
+    return dq, dk, dv, jnp.zeros_like(offset), jnp.zeros_like(seed)
 
 
 flash_chunk_attention.defvjp(_flash_chunk_fwd, _flash_chunk_bwd)

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from differential_transformer_replication_tpu.ops.flash import (
     auto_interpret,
+    dropout_seed_from_rng,
     flash_chunk_attention,
     pick_block,
 )
@@ -56,13 +57,22 @@ def _ring_flash_body(
     ks: jnp.ndarray,  # (S, Bl, Tl, Hl, d)
     v: jnp.ndarray,  # (Bl, Tl, Hl, dv)
     coeffs: jnp.ndarray,  # (S, Hl) float32
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
 ) -> jnp.ndarray:
     """Ring body whose per-chunk compute is the fused Pallas chunk kernel
     (ops/flash.py:flash_chunk_attention) — no Tl x Tl map is materialized
     even chunk-locally. Chunks merge exactly via the running logsumexp
     recurrence: with per-chunk normalized outputs o_c and logsumexps
     lse_c, ``lse' = logaddexp(lse, lse_c)`` and
-    ``o' = o*exp(lse-lse') + o_c*exp(lse_c-lse')``."""
+    ``o' = o*exp(lse-lse') + o_c*exp(lse_c-lse')``.
+
+    Dropout composes: each chunk drops its probabilities in-kernel after
+    local normalization, the lse carries the UNdropped sums, and the
+    merge re-weights exactly as in the dropout-free case — globally
+    softmax-then-dropout. Masks hash (row, col - off), unique per (q, k)
+    pair across the rotation; the caller folds the mesh position into
+    the rng so shards decorrelate."""
     S, B, Tl, H, d = qs.shape
     dv = v.shape[-1]
     p = jax.lax.axis_size(_SEQ_AXIS)
@@ -71,6 +81,13 @@ def _ring_flash_body(
     bq = pick_block(128, Tl)
     bk = pick_block(128, Tl)
     blocks = (bq, bk, bq, bk)
+    use_drop = dropout_rate > 0.0 and dropout_rng is not None
+    rate = float(dropout_rate) if use_drop else 0.0
+    seed = (
+        dropout_seed_from_rng(dropout_rng)
+        if use_drop
+        else jnp.zeros((1, 1), jnp.float32)
+    )
 
     # (S, B, Tl, H, d) -> (B*H, S, Tl, d)
     q_r = qs.transpose(1, 3, 0, 2, 4).reshape(B * H, S, Tl, d)
@@ -82,7 +99,9 @@ def _ring_flash_body(
         off = ((my - src) * Tl).astype(jnp.float32).reshape(1, 1)
         k_r = ks_t.transpose(1, 3, 0, 2, 4).reshape(B * H, S, Tl, d)
         v_r = v_t.transpose(0, 2, 1, 3).reshape(B * H, Tl, dv)
-        o_c, lse_c = flash_chunk_attention(q_r, k_r, v_r, off, blocks, interpret)
+        o_c, lse_c = flash_chunk_attention(
+            q_r, k_r, v_r, off, seed, blocks, interpret, rate
+        )
         lse_new = jnp.logaddexp(lse, lse_c)
         w_old = jnp.exp(lse - lse_new)[..., None]
         w_new = jnp.exp(lse_c - lse_new)[..., None]
@@ -106,15 +125,22 @@ def _ring_shard_body(
     ks: jnp.ndarray,  # (S, Bl, Tl, Hl, d)
     v: jnp.ndarray,  # (Bl, Tl, Hl, dv)
     coeffs: jnp.ndarray,  # (S, Hl) float32
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
 ) -> jnp.ndarray:
     """Runs on each device inside shard_map. Rotates (ks, v) around the
     ``sequence`` ring; accumulates S online-softmax streams against the
-    local Q shard."""
+    local Q shard. Dropout (when a key is given) is applied to each
+    step's probabilities before the PV accumulation while the normalizer
+    keeps the undropped sums — softmax-then-dropout semantics globally;
+    autodiff handles the backward (no mask regeneration needed on this
+    dense path)."""
     S, B, Tl, H, d = qs.shape
     dv = v.shape[-1]
     p = jax.lax.axis_size(_SEQ_AXIS)
     my = jax.lax.axis_index(_SEQ_AXIS)
     scale = 1.0 / math.sqrt(d)
+    use_drop = dropout_rate > 0.0 and dropout_rng is not None
 
     q32 = qs.astype(jnp.float32)
     rows = my * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
@@ -132,8 +158,15 @@ def _ring_shard_body(
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
         pr = jnp.exp(s - m_new[..., None])  # (S, B, H, Tl, Tl)
-        l_new = l * alpha + jnp.sum(pr, axis=-1)
-        pv = jnp.einsum("sbhtu,buhe->sbhte", pr, v_t.astype(jnp.float32))
+        l_new = l * alpha + jnp.sum(pr, axis=-1)  # UNdropped normalizer
+        pr_pv = pr
+        if use_drop:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(dropout_rng, t), 1.0 - dropout_rate,
+                pr.shape,
+            )
+            pr_pv = jnp.where(keep, pr / (1.0 - dropout_rate), 0.0)
+        pv = jnp.einsum("sbhtu,buhe->sbhte", pr_pv, v_t.astype(jnp.float32))
         acc_new = acc * alpha[..., None] + pv
         # rotate KV to the next device; the last step's rotation restores
         # the original placement (and keeps every step's collective uniform)
@@ -159,6 +192,9 @@ def ring_multi_stream_attention(
     coeffs: jnp.ndarray,  # (S, H) float32
     mesh: Mesh,
     impl: str = "xla",
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
 ) -> jnp.ndarray:
     """Causal multi-stream attention with the sequence dim ring-sharded
     over ``mesh``'s ``sequence`` axis. Global shapes in, global out —
@@ -168,13 +204,39 @@ def ring_multi_stream_attention(
     ``impl``: "xla" computes each chunk with dense masked softmax (Tl x Tl
     chunk-local maps); "pallas" runs the fused flash chunk kernel inside
     the ring, so even chunk-local memory stays O(Tl) — ring flash
-    attention, the long-context configuration."""
+    attention, the long-context configuration.
+
+    With ``dropout_rate`` > 0 and a key, attention-prob dropout is live
+    on both impls (each map dropped after normalization, inverted
+    scaling); the replicated key is folded with the device's full mesh
+    position inside the body so every shard draws independent masks."""
     qk_spec = P(None, _BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
     v_spec = P(_BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
     c_spec = P(None, _HEAD_AXIS)
-    body = _ring_flash_body if impl == "pallas" else _ring_shard_body
+    body_fn = _ring_flash_body if impl == "pallas" else _ring_shard_body
+    use_drop = dropout_rate > 0.0 and dropout_rng is not None
+
+    if use_drop:
+        def body(qs_l, ks_l, v_l, c_l, rng):
+            pos = jax.lax.axis_index(_BATCH_AXES[0])
+            for ax in (_BATCH_AXES[1], _HEAD_AXIS, _SEQ_AXIS):
+                pos = pos * mesh.shape[ax] + jax.lax.axis_index(ax)
+            return body_fn(
+                qs_l, ks_l, v_l, c_l, dropout_rate,
+                jax.random.fold_in(rng, pos),
+            )
+
+        inner = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(qk_spec, qk_spec, v_spec, c_spec, P()),
+            out_specs=v_spec,
+            check_vma=False,
+        )
+        return inner(qs, ks, v, coeffs, dropout_rng)
+
     inner = jax.shard_map(
-        body,
+        lambda a, b, c, d: body_fn(a, b, c, d),
         mesh=mesh,
         in_specs=(qk_spec, qk_spec, v_spec, c_spec),
         out_specs=v_spec,
@@ -183,26 +245,32 @@ def ring_multi_stream_attention(
     return inner(qs, ks, v, coeffs)
 
 
-def ring_vanilla_attention(q, k, v, mesh: Mesh, impl: str = "xla"):
+def ring_vanilla_attention(q, k, v, mesh: Mesh, impl: str = "xla", **kw):
     """Sequence-parallel form of ops.attention.vanilla_attention."""
     return ring_multi_stream_attention(
-        q[None], k[None], v, vanilla_coeffs(q.shape[2]), mesh, impl
+        q[None], k[None], v, vanilla_coeffs(q.shape[2]), mesh, impl, **kw
     )
 
 
-def ring_diff_attention(q1, k1, q2, k2, v, lam, mesh: Mesh, impl: str = "xla"):
+def ring_diff_attention(
+    q1, k1, q2, k2, v, lam, mesh: Mesh, impl: str = "xla", **kw
+):
     """Sequence-parallel form of ops.attention.diff_attention:
     coeffs [1, -lambda] (diff_transformer.py:70)."""
     qs = jnp.stack([q1, q2])
     ks = jnp.stack([k1, k2])
-    return ring_multi_stream_attention(qs, ks, v, diff_coeffs(lam), mesh, impl)
+    return ring_multi_stream_attention(
+        qs, ks, v, diff_coeffs(lam), mesh, impl, **kw
+    )
 
 
-def ring_ndiff_attention(qs, ks, v, lams, signs, mesh: Mesh, impl: str = "xla"):
+def ring_ndiff_attention(
+    qs, ks, v, lams, signs, mesh: Mesh, impl: str = "xla", **kw
+):
     """Sequence-parallel form of ops.attention.ndiff_attention: coeffs
     sign_s * lambda_{s,h} (Ndiff_transformer.py:119-123)."""
     return ring_multi_stream_attention(
-        qs, ks, v, ndiff_coeffs(lams, signs), mesh, impl
+        qs, ks, v, ndiff_coeffs(lams, signs), mesh, impl, **kw
     )
 
 
@@ -210,17 +278,3 @@ def use_ring(mesh: Optional[Mesh]) -> bool:
     """Ring attention applies when a mesh with a >1 sequence axis is
     threaded into the forward."""
     return mesh is not None and mesh.shape.get(_SEQ_AXIS, 1) > 1
-
-
-def check_ring_dropout(dropout_rate: float, rng) -> None:
-    """The ring path does not implement attention-prob dropout (like the
-    flash kernel, SURVEY.md section 7.7) — but unlike flash there is no
-    dense fallback that preserves the sequence sharding, so training with
-    active dropout on a sequence-parallel mesh must fail loudly instead
-    of silently dropping the regularizer. Both args are trace-static."""
-    if dropout_rate > 0.0 and rng is not None:
-        raise NotImplementedError(
-            "attention-prob dropout is not supported on the sequence-"
-            "parallel ring path; train with dropout=0.0 (the reference "
-            "default, train.py:64) or a sequence=1 mesh"
-        )

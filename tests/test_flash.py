@@ -25,6 +25,11 @@ from differential_transformer_replication_tpu.ops import (
 B, T, H, D = 2, 64, 2, 16
 
 
+def _zseed():
+    """No-dropout seed operand for the chunk op."""
+    return jnp.zeros((1, 1), jnp.float32)
+
+
 def _rand(key, *shape):
     return jax.random.normal(key, shape, jnp.float32)
 
@@ -202,12 +207,12 @@ class TestKVTiled:
         for off_val in (0.0, 64.0, -64.0):
             off = jnp.full((1, 1), off_val, jnp.float32)
             o_t, lse_t = flash.flash_chunk_attention(
-                q, k, v, off, (32, 16, 32, 16), True
+                q, k, v, off, _zseed(), (32, 16, 32, 16), True
             )
             with pytest.MonkeyPatch.context() as mp:
                 mp.setattr(flash, "_KV_TILE_THRESHOLD", 4096)
                 o_u, lse_u = flash.flash_chunk_attention(
-                    q, k, v, off, (32, 16, 32, 16), True
+                    q, k, v, off, _zseed(), (32, 16, 32, 16), True
                 )
             np.testing.assert_allclose(o_t, o_u, rtol=1e-5, atol=1e-6)
             np.testing.assert_allclose(lse_t, lse_u, rtol=1e-5, atol=1e-5)
@@ -226,7 +231,7 @@ class TestKVTiled:
 
         def loss(q, k, v):
             o, lse = flash.flash_chunk_attention(
-                q, k, v, off, (32, 16, 32, 16), True
+                q, k, v, off, _zseed(), (32, 16, 32, 16), True
             )
             return jnp.sum(o * jnp.cos(o)) + jnp.sum(
                 jnp.where(lse > -1e29, lse, 0.0)
