@@ -435,6 +435,9 @@ def _fwd_call(
         ],
         out_specs=out_specs,
         out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
         interpret=interpret,
     )(q, k, v, jnp.zeros((1, 1), jnp.float32), seed, coeffs)
     if save_residuals:
@@ -594,6 +597,9 @@ def _tiled_fwd_call(
             pltpu.VMEM((S, block_q), jnp.float32),
             pltpu.VMEM((S, block_q, dv), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(*inputs)
     return results
@@ -775,6 +781,9 @@ def _tiled_bwd_call(
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((S, block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(q, k, v, do_s, lse, delta, offset, seed)
 
@@ -811,6 +820,9 @@ def _tiled_bwd_call(
             pltpu.VMEM((S, block_k, d), jnp.float32),
             pltpu.VMEM((block_k, dv_width), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(q, k, v, do_s, lse, delta, offset, seed)
     return dq, dk, dv
@@ -1013,6 +1025,9 @@ def _bwd_call(
         out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
         interpret=interpret,
     )(q, k, v, do_s, lse, delta, offset, seed)
 
@@ -1047,6 +1062,9 @@ def _bwd_call(
             jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
             jax.ShapeDtypeStruct((BH, T, dv_width), v.dtype),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
         interpret=interpret,
     )(q, k, v, do_s, lse, delta, offset, seed)
     return dq, dk, dv
@@ -1162,6 +1180,9 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret,
             jax.ShapeDtypeStruct((BH, S, T, dv), q.dtype),
             jax.ShapeDtypeStruct((BH, S, T), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
         interpret=interpret,
     )(q, k, v, offset, seed)
 
