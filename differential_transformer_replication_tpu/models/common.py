@@ -139,6 +139,63 @@ def split_rng(rng: Optional[jax.Array], n: int):
     return tuple(jax.random.split(rng, n))
 
 
+def dispatch_attention(
+    qs: jnp.ndarray,  # (S, B, T, H, d) stacked streams
+    ks: jnp.ndarray,  # (S, B, T, H, d)
+    v: jnp.ndarray,  # (B, T, H, dv)
+    coeffs: jnp.ndarray,  # (S, H) float32 combine coefficients
+    dense_fn,
+    *,
+    impl: str,
+    mesh,
+    dropout_rate: float,
+    rng: Optional[jax.Array],
+) -> jnp.ndarray:
+    """The attention-backend dispatch shared by all three families.
+
+    Every family's attention is the same multi-stream form
+    (ops/streams.py), so backend selection is family-independent:
+      1. >1 ``sequence`` mesh axis  -> ring attention (parallel/ring.py),
+      2. impl == "pallas", >1-device mesh -> shard_map'd flash
+         (parallel/shard_flash.py),
+      3. impl == "pallas"           -> fused flash kernel (ops/flash.py),
+      4. otherwise                  -> ``dense_fn()``, the family's XLA
+         reference op (ops/attention.py) closed over its own arguments.
+    All parallel backends take the dropout (rate, rng) pair; dense_fn
+    applies its own dropout internally.
+    """
+    # lazy import: parallel/__init__ pulls in the training stack, which
+    # imports models — importing at call (trace) time breaks the cycle
+    from differential_transformer_replication_tpu.ops.flash import (
+        multi_stream_flash_attention,
+        use_flash,
+    )
+    from differential_transformer_replication_tpu.parallel.ring import (
+        ring_multi_stream_attention,
+        use_ring,
+    )
+    from differential_transformer_replication_tpu.parallel.shard_flash import (
+        shard_flash_multi_stream_attention,
+        use_shard_flash,
+    )
+
+    if use_ring(mesh):
+        return ring_multi_stream_attention(
+            qs, ks, v, coeffs, mesh, impl,
+            dropout_rate=dropout_rate, dropout_rng=rng,
+        )
+    if use_flash(impl, dropout_rate, rng):
+        if use_shard_flash(mesh):
+            return shard_flash_multi_stream_attention(
+                qs, ks, v, coeffs, mesh,
+                dropout_rate=dropout_rate, dropout_rng=rng,
+            )
+        return multi_stream_flash_attention(
+            qs, ks, v, coeffs, dropout_rate=dropout_rate, dropout_rng=rng
+        )
+    return dense_fn()
+
+
 # ---------------------------------------------------------------------------
 # Blocks-layout conversion — the SINGLE definition of the two layouts:
 # canonical (list of per-layer dicts, what init() builds and checkpoints

@@ -20,11 +20,10 @@ from differential_transformer_replication_tpu.models import common
 from differential_transformer_replication_tpu.ops import (
     apply_rope,
     causal_mask,
-    flash_vanilla_attention,
     rope_cos_sin,
     vanilla_attention,
 )
-from differential_transformer_replication_tpu.ops.flash import use_flash
+from differential_transformer_replication_tpu.ops.streams import vanilla_coeffs
 
 
 # RoPE is this family's position encoding (control.py:47-48); consumers
@@ -81,35 +80,14 @@ def _attn(
     v = jnp.einsum("bte,ehd->bthd", x, p["wv"].astype(x.dtype))
     q = apply_rope(q, cos, sin)  # control.py:47-48
     k = apply_rope(k, cos, sin)
-    # lazy import: parallel/__init__ pulls in the training stack, which
-    # imports models — importing at call (trace) time breaks the cycle
-    from differential_transformer_replication_tpu.parallel.ring import (
-        ring_vanilla_attention,
-        use_ring,
-    )
-    from differential_transformer_replication_tpu.parallel.shard_flash import (
-        shard_flash_vanilla_attention,
-        use_shard_flash,
-    )
-
-    if use_ring(mesh):
-        out = ring_vanilla_attention(
-            q, k, v, mesh, impl,
-            dropout_rate=dropout_rate, dropout_rng=r_att,
-        )
-    elif use_flash(impl, dropout_rate, r_att):
-        if use_shard_flash(mesh):
-            out = shard_flash_vanilla_attention(
-                q, k, v, mesh, dropout_rate=dropout_rate, dropout_rng=r_att
-            )
-        else:
-            out = flash_vanilla_attention(
-                q, k, v, dropout_rate=dropout_rate, dropout_rng=r_att
-            )
-    else:
-        out = vanilla_attention(
+    out = common.dispatch_attention(
+        q[None], k[None], v, vanilla_coeffs(q.shape[2]),
+        # the dense XLA reference op (control.py:52-62)
+        lambda: vanilla_attention(
             q, k, v, mask=mask, dropout_rate=dropout_rate, rng=r_att
-        )
+        ),
+        impl=impl, mesh=mesh, dropout_rate=dropout_rate, rng=r_att,
+    )
     out = out.reshape(B, T, -1)  # concat heads (control.py:76)
     out = common.linear(out, p["out"])
     return common.dropout(out, dropout_rate, r_out)  # control.py:77

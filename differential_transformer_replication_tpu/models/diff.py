@@ -29,10 +29,6 @@ from differential_transformer_replication_tpu.ops import (
     group_layer_norm,
     lambda_init_schedule,
 )
-from differential_transformer_replication_tpu.ops.flash import (
-    multi_stream_flash_attention,
-    use_flash,
-)
 from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
 from differential_transformer_replication_tpu.ops.streams import diff_coeffs
 
@@ -100,40 +96,15 @@ def _attn(
         p["lambda_q"][1], p["lambda_k"][1],
         lambda_init_schedule(layer_idx),
     )  # (H,) fp32
-    # lazy import: parallel/__init__ pulls in the training stack, which
-    # imports models — importing at call (trace) time breaks the cycle
-    from differential_transformer_replication_tpu.parallel.ring import (
-        ring_diff_attention,
-        use_ring,
-    )
-    from differential_transformer_replication_tpu.parallel.shard_flash import (
-        shard_flash_multi_stream_attention,
-        use_shard_flash,
-    )
-
-    if use_ring(mesh):
-        out = ring_diff_attention(
-            qs[0], ks[0], qs[1], ks[1], v, lam, mesh, impl,
-            dropout_rate=dropout_rate, dropout_rng=r_att,
-        )
-    elif use_flash(impl, dropout_rate, r_att):
-        # pass the stacked streams straight through — slicing qs[0]/qs[1]
-        # only for flash_diff_attention to re-stack them costs real copies
-        if use_shard_flash(mesh):
-            out = shard_flash_multi_stream_attention(
-                qs, ks, v, diff_coeffs(lam), mesh,
-                dropout_rate=dropout_rate, dropout_rng=r_att,
-            )
-        else:
-            out = multi_stream_flash_attention(
-                qs, ks, v, diff_coeffs(lam),
-                dropout_rate=dropout_rate, dropout_rng=r_att,
-            )
-    else:
-        out = diff_attention(
+    out = common.dispatch_attention(
+        qs, ks, v, diff_coeffs(lam),
+        # the dense XLA reference op (att1 - lam*att2, diff_transformer.py:70)
+        lambda: diff_attention(
             qs[0], ks[0], qs[1], ks[1], v, lam,
             mask=mask, dropout_rate=dropout_rate, rng=r_att,
-        )
+        ),
+        impl=impl, mesh=mesh, dropout_rate=dropout_rate, rng=r_att,
+    )
     out = out.reshape(B, T, -1)  # concat heads (diff_transformer.py:89)
     out = group_layer_norm(out, p["gn"]["w"], p["gn"]["b"])  # :90
     out = out * OUTPUT_SCALE  # constant 0.2, :91

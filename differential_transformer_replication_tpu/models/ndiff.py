@@ -28,7 +28,6 @@ from differential_transformer_replication_tpu.models import common
 from differential_transformer_replication_tpu.ops import (
     apply_rope,
     causal_mask,
-    flash_ndiff_attention,
     group_layer_norm,
     lambda_init_schedule,
     ndiff_attention,
@@ -36,8 +35,8 @@ from differential_transformer_replication_tpu.ops import (
     ndiff_signs,
     rope_cos_sin,
 )
-from differential_transformer_replication_tpu.ops.flash import use_flash
 from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
+from differential_transformer_replication_tpu.ops.streams import ndiff_coeffs
 
 
 # RoPE positions (Ndiff_transformer.py:104-110); consumers that precompute
@@ -100,38 +99,15 @@ def _attn(
     qs = apply_rope(qs, cos, sin)
     ks = apply_rope(ks, cos, sin)
     lams = ndiff_lambdas(p["lambda_q"], p["lambda_k"], lambda_init_schedule(layer_idx))
-    # lazy import: parallel/__init__ pulls in the training stack, which
-    # imports models — importing at call (trace) time breaks the cycle
-    from differential_transformer_replication_tpu.parallel.ring import (
-        ring_ndiff_attention,
-        use_ring,
-    )
-    from differential_transformer_replication_tpu.parallel.shard_flash import (
-        shard_flash_ndiff_attention,
-        use_shard_flash,
-    )
-
-    if use_ring(mesh):
-        out = ring_ndiff_attention(
-            qs, ks, v, lams, ndiff_signs(n), mesh, impl,
-            dropout_rate=dropout_rate, dropout_rng=r_att,
-        )
-    elif use_flash(impl, dropout_rate, r_att):
-        if use_shard_flash(mesh):
-            out = shard_flash_ndiff_attention(
-                qs, ks, v, lams, ndiff_signs(n), mesh,
-                dropout_rate=dropout_rate, dropout_rng=r_att,
-            )
-        else:
-            out = flash_ndiff_attention(
-                qs, ks, v, lams, ndiff_signs(n),
-                dropout_rate=dropout_rate, dropout_rng=r_att,
-            )
-    else:
-        out = ndiff_attention(
+    out = common.dispatch_attention(
+        qs, ks, v, ndiff_coeffs(lams, ndiff_signs(n)),
+        # the dense XLA reference op (Ndiff_transformer.py:95-126)
+        lambda: ndiff_attention(
             qs, ks, v, lams, ndiff_signs(n),
             mask=mask, dropout_rate=dropout_rate, rng=r_att,
-        )
+        ),
+        impl=impl, mesh=mesh, dropout_rate=dropout_rate, rng=r_att,
+    )
     out = out.reshape(B, T, -1)  # concat heads (Ndiff_transformer.py:142)
     out = group_layer_norm(out, p["gn"]["w"], p["gn"]["b"])  # :143
     out = out * OUTPUT_SCALE  # constant 0.2, :144
