@@ -1283,11 +1283,20 @@ def multi_stream_flash_attention(
     dq, dk, dqt, dkt = default_blocks()
     S, B, T, H, d = qs.shape
     dv = v.shape[-1]
+    bkt = block_k_train if block_k_train is not None else dkt
+    if 1024 < T <= _KV_TILE_THRESHOLD and block_k_train is None:
+        # the RESIDENT backward kernels hold full-T q/do plus the K/V
+        # block: with the 1024-wide train K tile their fp32 p/dp/ds
+        # blocks exceed v5e's 16M scoped VMEM from T=2048 (measured
+        # under the full model; the bare-op sweep happens to fit). The
+        # KV-tiled kernels past _KV_TILE_THRESHOLD hold only O(block)
+        # state, so they keep the wide tile.
+        bkt = min(bkt, 512)
     blocks = (
         _pick_block(block_q if block_q is not None else dq, T),
         _pick_block(block_k if block_k is not None else dk, T),
         _pick_block(block_q_train if block_q_train is not None else dqt, T),
-        _pick_block(block_k_train if block_k_train is not None else dkt, T),
+        _pick_block(bkt, T),
     )
     # (S, B, T, H, d) -> (B*H, S, T, d)
     q_r = qs.transpose(1, 3, 0, 2, 4).reshape(B * H, S, T, d)
