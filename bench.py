@@ -61,9 +61,8 @@ def main() -> None:
     micro_batch = int(os.environ.get("BENCH_MICRO_BATCH", "32"))
     model_kind = os.environ.get("BENCH_MODEL", "diff")
     # pallas (the fused flash kernel) measured fastest at recipe scale
-    # (181.9k vs XLA's 174.8k tok/s with bf16 MXU operands + 1024-wide
-    # train K tiles) and dominates at every longer context;
-    # BENCH_ATTN=xla to compare.
+    # (182.3k vs XLA's 174.8k tok/s with bf16 MXU operands) and dominates
+    # at every longer context; BENCH_ATTN=xla to compare.
     attn = os.environ.get("BENCH_ATTN", "pallas")
     loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0")) or None
 

@@ -103,10 +103,11 @@ _pick_block = pick_block  # internal callers
 # compile everywhere rather than the widest measured winner.
 # (blocks are (block_q, block_k, block_q_train, block_k_train))
 _TUNED_BLOCKS = {
-    # with bf16 MXU operands the 1024-wide K train tile fits VMEM and wins
-    # (tools/flash_sweep.py: +5% at T=512, +24-29% at T=2048-8192 over the
-    # 512-square train tiles); 1024-square train tiles still fail to
-    # compile past T=2048
+    # with bf16 MXU operands the 1024-wide K train tile fits VMEM in the
+    # bare-op sweeps (tools/flash_sweep.py: +5% at T=512, +24-29% at
+    # T=2048-8192 over 512-square) — but see the T-dependent cap in
+    # multi_stream_flash_attention: the resident bwd kernels can't afford
+    # it at 1024 < T <= _KV_TILE_THRESHOLD under the full model
     "v5 lite": (512, 1024, 512, 1024),
     "v5e": (512, 1024, 512, 1024),
 }
@@ -1269,14 +1270,17 @@ def multi_stream_flash_attention(
     regenerate identical masks and no T x T mask is ever materialized.
     Without a key the rate is inert (eval semantics, like ops/dropout.py).
 
-    Block defaults resolve per device kind (:func:`default_blocks`). On
-    v5e they are the measured optima (tools/flash_sweep.py): (512, 1024)
-    for the no-grad primal, and (512, 1024) ``*_train`` tiles for the
-    residual-saving forward and both backward kernels — the 1024-wide K
+    Block defaults resolve per device kind (:func:`default_blocks`) with
+    one T-dependent cap below. On v5e the tuned tiles are (512, 1024)
+    for the no-grad primal and for the training path — the 1024-wide K
     train tile became compilable once the kernels switched to bf16 MXU
-    operands (half the VMEM per tile) and wins by 5-29% over 512-square
-    across T=512..8192. 1024-SQUARE train tiles still fail to compile
-    past T=2048 (VMEM) on v5e; unknown TPU kinds fall back to
+    operands (half the VMEM per tile) and measured 5-29% faster than
+    512-square in bare-op sweeps (tools/flash_sweep.py). BUT in the
+    RESIDENT backward region (1024 < T <= _KV_TILE_THRESHOLD, where the
+    bwd kernels hold full-T q/do) the wide tile exhausts v5e's scoped
+    VMEM under the full model, so the default train K tile is capped to
+    512 there; the KV-tiled kernels past the threshold hold O(block)
+    state and keep the wide tile. Unknown TPU kinds fall back to
     256-tiles."""
     if interpret is None:
         interpret = _auto_interpret()
