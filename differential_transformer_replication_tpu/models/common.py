@@ -150,6 +150,7 @@ def dispatch_attention(
     mesh,
     dropout_rate: float,
     rng: Optional[jax.Array],
+    flash_fn=None,
 ) -> jnp.ndarray:
     """The attention-backend dispatch shared by all three families.
 
@@ -163,6 +164,12 @@ def dispatch_attention(
          reference op (ops/attention.py) closed over its own arguments.
     All parallel backends take the dropout (rate, rng) pair; dense_fn
     applies its own dropout internally.
+
+    ``flash_fn`` (optional, () -> (B, T, H, dv)) overrides branch 3: a
+    family that can project straight into the kernel's (B*H, S, T, d)
+    layout supplies a closure calling multi_stream_flash_attention_bh,
+    skipping the stacked-layout transposes on the hot single-device path
+    (XLA does not eliminate them otherwise; see models/diff.py).
     """
     # lazy import: parallel/__init__ pulls in the training stack, which
     # imports models — importing at call (trace) time breaks the cycle
@@ -190,6 +197,8 @@ def dispatch_attention(
                 qs, ks, v, coeffs, mesh,
                 dropout_rate=dropout_rate, dropout_rng=rng,
             )
+        if flash_fn is not None:
+            return flash_fn()
         return multi_stream_flash_attention(
             qs, ks, v, coeffs, dropout_rate=dropout_rate, dropout_rng=rng
         )
