@@ -1,0 +1,136 @@
+"""Per-op profile of the flagship train step on the real TPU.
+
+Captures a few steps under ``jax.profiler.trace`` and prints the
+device-side XLA op breakdown (grouped + top ops) by parsing the xplane
+protobuf with tensorflow's bundled proto (present in this image). This
+is the workflow that produced the step decompositions in BASELINE.md.
+
+    python tools/profile_step.py [--steps 5] [--attn pallas] [--top 25]
+
+The reference has no profiling at all (SURVEY.md section 5.1 — its only
+instrument is GPU-memory prints); this plus utils/profiling.py
+(ProfilerWindow, Throughput) is the TPU-native observability stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import re
+import sys
+import tempfile
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def capture(args) -> str:
+    import jax
+    import jax.numpy as jnp
+
+    from differential_transformer_replication_tpu.config import (
+        ModelConfig,
+        TrainConfig,
+    )
+    from differential_transformer_replication_tpu.train.step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    model = ModelConfig(
+        model=args.model, vocab_size=12000, n_embd=768, n_head=4, n_layer=8,
+        block_size=args.block_size, dropout=0.0, compute_dtype="bfloat16",
+        attention_impl=args.attn,
+    )
+    cfg = TrainConfig(
+        model=model, micro_batch_size=args.micro_batch, grad_acc_steps=1
+    )
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    step = make_train_step(cfg)
+    x = jax.random.randint(
+        jax.random.PRNGKey(1), (1, args.micro_batch, model.block_size), 0,
+        model.vocab_size,
+    )
+    batch = {"x": x, "y": jnp.roll(x, -1, -1)}
+    for _ in range(3):  # compile + warm
+        state, m = step(state, batch)
+    _ = float(m["loss"])  # sync (block_until_ready lies on axon; BASELINE.md)
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="profile_step_")
+    with jax.profiler.trace(out_dir):
+        for _ in range(args.steps):
+            state, m = step(state, batch)
+        _ = float(m["loss"])
+    return out_dir
+
+
+def report(out_dir: str, steps: int, top: int) -> None:
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError:
+        print(
+            f"trace written to {out_dir} — tensorflow's xplane proto is not "
+            f"importable here; open the trace in TensorBoard instead"
+        )
+        return
+
+    paths = glob.glob(f"{out_dir}/plugins/profile/*/*.xplane.pb")
+    if not paths:
+        print(f"no xplane.pb under {out_dir}")
+        return
+    xs = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    tpu = [p for p in xs.planes if p.name.startswith("/device:TPU")]
+    if not tpu:
+        print(f"no TPU plane in the trace (planes: {[p.name for p in xs.planes]})")
+        return
+    plane = tpu[0]
+    meta = plane.event_metadata
+    line = max(
+        (l for l in plane.lines if l.name == "XLA Ops"),
+        key=lambda l: len(l.events),
+        default=None,
+    )
+    if line is None:
+        print("no 'XLA Ops' line in the TPU plane")
+        return
+
+    totals: dict = defaultdict(float)
+    counts: dict = defaultdict(int)
+    groups: dict = defaultdict(float)
+    for ev in line.events:
+        name = meta[ev.metadata_id].name
+        ms = ev.duration_ps / 1e9
+        totals[name] += ms
+        counts[name] += 1
+        m = re.match(r"%([a-zA-Z_\.]+)", name)
+        groups[m.group(1) if m else name[:24]] += ms
+
+    total = sum(totals.values())
+    print(f"device busy: {total / steps:.2f} ms/step over {steps} steps\n")
+    print("grouped by op family (ms/step):")
+    for k, ms in sorted(groups.items(), key=lambda kv: -kv[1])[:15]:
+        print(f"  {ms / steps:8.3f}  {k}")
+    print(f"\ntop {top} ops (ms/step):")
+    for name, ms in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"  {ms / steps:7.3f} x{counts[name] // steps:3d}  {name[:110]}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--micro-batch", type=int, default=32)
+    p.add_argument("--block-size", type=int, default=512)
+    p.add_argument("--model", default="diff", choices=["control", "diff", "ndiff"])
+    p.add_argument("--attn", default="pallas", choices=["xla", "pallas"])
+    p.add_argument("--top", type=int, default=25)
+    p.add_argument("--out", default=None, help="trace dir (default: temp)")
+    args = p.parse_args()
+    out_dir = capture(args)
+    report(out_dir, args.steps, args.top)
+
+
+if __name__ == "__main__":
+    main()
