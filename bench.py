@@ -61,8 +61,9 @@ def main() -> None:
     micro_batch = int(os.environ.get("BENCH_MICRO_BATCH", "32"))
     model_kind = os.environ.get("BENCH_MODEL", "diff")
     # pallas (the fused flash kernel) measured fastest at recipe scale
-    # (182.3k vs XLA's 174.8k tok/s with bf16 MXU operands) and dominates
-    # at every longer context; BENCH_ATTN=xla to compare.
+    # (186.0k vs XLA's ~175k tok/s with bf16 MXU operands + the custom
+    # cross-entropy backward) and dominates at every longer context;
+    # BENCH_ATTN=xla to compare.
     attn = os.environ.get("BENCH_ATTN", "pallas")
     loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0")) or None
 
