@@ -15,10 +15,12 @@ from differential_transformer_replication_tpu.ops.attention import (
     ndiff_attention,
 )
 from differential_transformer_replication_tpu.ops.flash import (
-    multi_stream_flash_attention,
-    flash_vanilla_attention,
+    flash_chunk_attention,
     flash_diff_attention,
     flash_ndiff_attention,
+    flash_vanilla_attention,
+    multi_stream_flash_attention,
+    multi_stream_flash_attention_bh,
 )
 from differential_transformer_replication_tpu.ops.losses import (
     fused_linear_cross_entropy,
@@ -40,6 +42,8 @@ __all__ = [
     "diff_attention",
     "ndiff_attention",
     "multi_stream_flash_attention",
+    "multi_stream_flash_attention_bh",
+    "flash_chunk_attention",
     "flash_vanilla_attention",
     "flash_diff_attention",
     "flash_ndiff_attention",
