@@ -206,7 +206,7 @@ def train(cfg: TrainConfig) -> dict:
         state = create_pipeline_train_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
         best_val_loss = float("inf")
         if cfg.resume_from:
-            host_state = jax.tree_util.tree_map(jax.device_get, state)
+            host_state = jax.device_get(state)
             host_state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, host_state)
             sh = pipeline_state_sharding(host_state, mesh)
             state = jax.tree_util.tree_map(jax.device_put, host_state, sh)
@@ -236,7 +236,7 @@ def train(cfg: TrainConfig) -> dict:
         state = create_sharded_train_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
         best_val_loss = float("inf")
         if cfg.resume_from:
-            host_state = jax.tree_util.tree_map(jax.device_get, state)
+            host_state = jax.device_get(state)
             host_state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, host_state)
             state = shard_state(host_state, mesh)
             print(f"Resumed from {cfg.resume_from} at iter {int(jax.device_get(state['step']))}")
@@ -423,7 +423,7 @@ def train(cfg: TrainConfig) -> dict:
         )
 
         state = canonicalize_state(
-            jax.tree_util.tree_map(jax.device_get, state),
+            jax.device_get(state),
             cfg.resolved_model().n_layer,
         )
     return state
