@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -102,16 +103,20 @@ def main() -> None:
     # service shows +-30% contention noise on short runs (measured via
     # tools/flash_sweep.py repeats); the fastest window is the least-
     # contended estimate of the chip's actual throughput.
-    windows = int(os.environ.get("BENCH_WINDOWS", "3"))
-    dt = float("inf")
+    windows = max(1, int(os.environ.get("BENCH_WINDOWS", "3")))
+    window_secs = []
     for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(steps):
             state, metrics = step(state, batch)
         _ = float(metrics["loss"])
-        dt = min(dt, time.perf_counter() - t0)
+        window_secs.append(time.perf_counter() - t0)
+    dt = min(window_secs)
+    dt_median = statistics.median(window_secs)
 
-    tps = steps * micro_batch * T / dt
+    tok_per_window = steps * micro_batch * T
+    tps = tok_per_window / dt
+    tps_median = tok_per_window / dt_median
 
     # MFU accounting. 6*N*D is the standard train-FLOPs estimate over
     # non-embedding params; the attention-inclusive number adds the
@@ -151,6 +156,12 @@ def main() -> None:
                 ),
                 "mfu_6nd": round(tps * flops_per_tok / peak, 3),
                 "mfu_attn_incl": round(tps * flops_per_tok_attn / peak, 3),
+                # dispersion across the timing windows, machine-readable:
+                # `value` is min-of-N (least-contended estimate on the
+                # shared chip); median + raw windows let readers compare
+                # like-for-like estimators across rounds (ADVICE r2)
+                "tokens_per_sec_median": round(tps_median, 1),
+                "window_secs": [round(w, 4) for w in window_secs],
             }
         )
     )
@@ -158,6 +169,7 @@ def main() -> None:
     print(
         f"[bench] model={model_kind} attn={attn} device={jax.devices()[0].device_kind} "
         f"micro_batch={micro_batch} block={T} steps={steps} "
+        f"tok/s best..median={tps:.0f}..{tps_median:.0f} "
         f"sec/step={dt / steps:.4f} loss={float(metrics['loss']):.4f} "
         f"mfu~{tps * flops_per_tok / peak:.1%} "
         f"(attn-incl {tps * flops_per_tok_attn / peak:.1%})",

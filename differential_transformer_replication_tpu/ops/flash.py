@@ -138,8 +138,12 @@ def default_blocks() -> tuple:
 # reproduce the kernel's masks bit-exactly for parity tests. Because the
 # mask is a function of global coordinates only, the forward and both
 # backward kernels regenerate identical masks regardless of their tilings.
-# The seed rides an SMEM (1, 1) float32 holding an exact 24-bit integer
-# (no float<->int bitcasting needed in-kernel).
+# The seed rides an SMEM (1, 2) float32 holding two exact 24-bit integers
+# (no float<->int bitcasting needed in-kernel); the two words enter the
+# hash at different rounds (dropout_keep_ids), so cross-call mask-field
+# collisions need both words to match (~2^-48 per pair) and distinct
+# (layer, step) calls don't birthday-collide over a full 40k-step training
+# run the way a single 24-bit word would (~6k draws).
 # ---------------------------------------------------------------------------
 
 
@@ -153,15 +157,21 @@ def _fmix32(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
-def dropout_keep_ids(seed_u32, bh, s_idx: int, row_ids, col_ids, rate: float):
+def dropout_keep_ids(seed_w0, seed_w1, bh, s_idx: int, row_ids, col_ids,
+                     rate: float):
     """Bernoulli(1 - rate) keep mask for global attention positions.
 
-    seed_u32: uint32 scalar; bh: traced int scalar (b*H + h); s_idx:
-    static stream index; row_ids/col_ids: int32 (bq, bk) global q/k
-    positions. Returns bool (bq, bk)."""
+    seed_w0/seed_w1: uint32 scalars (the two 24-bit seed words); bh:
+    traced int scalar (b*H + h); s_idx: static stream index;
+    row_ids/col_ids: int32 (bq, bk) global q/k positions. Returns bool
+    (bq, bk). The two seed words enter at DIFFERENT rounds of the hash
+    (w0 in the inner key, w1 xor'd between the finalizer rounds), so two
+    calls regenerate the same mask field only if both 24-bit words
+    collide jointly — ~2^-48 per pair, not the ~2^-32 a single folded
+    key would give."""
     threshold = jnp.uint32(min(int(round(rate * (2.0**32))), 2**32 - 1))
     key = _fmix32(
-        seed_u32
+        seed_w0
         ^ (bh.astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
         ^ jnp.uint32(s_idx * 0x27D4EB2F)
     )
@@ -169,7 +179,16 @@ def dropout_keep_ids(seed_u32, bh, s_idx: int, row_ids, col_ids, rate: float):
         row_ids.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
         ^ col_ids.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
     )
-    return _fmix32(x + key) >= threshold
+    return _fmix32(_fmix32(x + key) ^ (seed_w1 * jnp.uint32(0x9E3779B1))) >= threshold
+
+
+def _read_seed_words(seed_ref):
+    """The seed's two exact-24-bit float32 words as uint32 scalars. Works
+    on the SMEM ref in-kernel and on the (1, 2) array in the jnp twin —
+    both index as [0, i]."""
+    w0 = seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+    w1 = seed_ref[0, 1].astype(jnp.int32).astype(jnp.uint32)
+    return w0, w1
 
 
 def _keep_mask_block(seed_ref, bh, S: int, q_start, k_start, bq: int, bk: int,
@@ -182,15 +201,15 @@ def _keep_mask_block(seed_ref, bh, S: int, q_start, k_start, bq: int, bk: int,
     ring every (q, k) pair hashes distinctly across the rotation steps
     while the aligned paths (off=0) keep plain global coordinates —
     which is also what dropout_keep_reference reproduces."""
-    # f32 -> i32 -> u32: Mosaic has no direct f32->u32 cast; the seed is a
-    # 24-bit integer so the value survives exactly
-    seed_u32 = seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+    # f32 -> i32 -> u32: Mosaic has no direct f32->u32 cast; each seed word
+    # is a 24-bit integer so the value survives exactly
+    w0, w1 = _read_seed_words(seed_ref)
     rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     if off is not None:
         cols = cols - off
     return jnp.stack(
-        [dropout_keep_ids(seed_u32, bh, s, rows, cols, rate) for s in range(S)]
+        [dropout_keep_ids(w0, w1, bh, s, rows, cols, rate) for s in range(S)]
     )
 
 
@@ -200,10 +219,10 @@ def _apply_keep(p, keep, rate: float):
 
 
 def dropout_seed_from_rng(rng) -> jnp.ndarray:
-    """(1, 1) float32 carrying a 24-bit seed drawn from a jax PRNG key —
-    exactly representable in float32, so SMEM can carry it without
-    bitcasting."""
-    bits = jax.random.bits(rng, (1, 1), jnp.uint32) >> 8
+    """(1, 2) float32 carrying two 24-bit seed words (48 bits total) drawn
+    from a jax PRNG key — each exactly representable in float32, so SMEM
+    can carry them without bitcasting."""
+    bits = jax.random.bits(rng, (1, 2), jnp.uint32) >> 8
     return bits.astype(jnp.float32)
 
 
@@ -211,9 +230,9 @@ def dropout_keep_reference(seed: jnp.ndarray, BH: int, S: int, T: int,
                            rate: float) -> jnp.ndarray:
     """Plain-jnp twin of the kernels' mask generation: (BH, S, T, T) keep
     booleans, bit-exact with what the compiled/interpreted kernels use for
-    the same ``seed`` (a (1, 1) float32 from :func:`dropout_seed_from_rng`).
+    the same ``seed`` (a (1, 2) float32 from :func:`dropout_seed_from_rng`).
     Test/oracle use only — it materializes full T x T masks."""
-    seed_u32 = seed[0, 0].astype(jnp.int32).astype(jnp.uint32)
+    w0, w1 = _read_seed_words(seed)
     rows = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
     out = []
@@ -222,7 +241,7 @@ def dropout_keep_reference(seed: jnp.ndarray, BH: int, S: int, T: int,
         out.append(
             jnp.stack(
                 [
-                    dropout_keep_ids(seed_u32, bh_t, s, rows, cols, rate)
+                    dropout_keep_ids(w0, w1, bh_t, s, rows, cols, rate)
                     for s in range(S)
                 ]
             )
@@ -267,7 +286,7 @@ def _fwd_kernel(
     v_ref,  # (1, T, dv)
     off_ref,  # (1, 1) float32 SMEM: causal row offset (0 = aligned causal;
     #           +-k*Tl for ring chunks whose K lives k shards away)
-    seed_ref,  # (1, 1) float32 SMEM: dropout seed (unread when rate == 0)
+    seed_ref,  # (1, 2) float32 SMEM: dropout seed (unread when rate == 0)
     *refs,  # [c_ref (BH, S) SMEM if emit_combined] then the outputs:
     #         [out_ref (1, block_q, dv) if emit_combined]
     #         [oall_ref (1, S, block_q, dv), lse_ref (1, S, block_q)
@@ -367,7 +386,7 @@ def _fwd_call(
     block_k: int,
     save_residuals: bool,
     interpret: bool,
-    dropout_seed: Optional[jnp.ndarray] = None,  # (1, 1) float32
+    dropout_seed: Optional[jnp.ndarray] = None,  # (1, 2) float32
     dropout_rate: float = 0.0,
 ):
     BH, S, T, d = q.shape
@@ -376,7 +395,7 @@ def _fwd_call(
     seed = (
         dropout_seed
         if dropout_seed is not None
-        else jnp.zeros((1, 1), jnp.float32)
+        else jnp.zeros((1, 2), jnp.float32)
     )
     if T > _KV_TILE_THRESHOLD:
         # stream K/V through the grid past the full-residency envelope
@@ -429,7 +448,7 @@ def _fwd_call(
             ),
             pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
             # the whole (BH, S) scalar coefficient table rides in SMEM; a
             # per-bh block would violate Mosaic's (8, 128) tiling check
             pl.BlockSpec((BH, S), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
@@ -462,7 +481,7 @@ def _tiled_fwd_kernel(
     k_ref,  # (1, S, block_k, d)    streamed
     v_ref,  # (1, block_k, dv)      streamed
     off_ref,  # (1, 1) float32 SMEM
-    seed_ref,  # (1, 1) float32 SMEM: dropout seed (unread when rate == 0)
+    seed_ref,  # (1, 2) float32 SMEM: dropout seed (unread when rate == 0)
     *refs,  # [c_ref if emit_combined] outputs [out][oall, lse] then
     #         scratch: m (S, block_q), l (S, block_q), acc (S, block_q, dv)
     save_residuals: bool,
@@ -547,7 +566,7 @@ def _tiled_fwd_call(
     seed = (
         dropout_seed
         if dropout_seed is not None
-        else jnp.zeros((1, 1), jnp.float32)
+        else jnp.zeros((1, 2), jnp.float32)
     )
     in_specs = [
         pl.BlockSpec((1, S, block_q, d), lambda b, i, j: (b, 0, i, 0),
@@ -557,7 +576,7 @@ def _tiled_fwd_call(
         pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b, j, 0),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, 1), lambda b, i, j: (0, 0), memory_space=pltpu.SMEM),
-        pl.BlockSpec((1, 1), lambda b, i, j: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 2), lambda b, i, j: (0, 0), memory_space=pltpu.SMEM),
     ]
     inputs = [q, k, v, offset, seed]
     if emit_combined:
@@ -614,7 +633,7 @@ def _tiled_dq_kernel(
     lse_ref,  # (1, S, block_q)
     delta_ref,  # (1, S, block_q)
     off_ref,  # (1, 1) SMEM
-    seed_ref,  # (1, 1) SMEM dropout seed
+    seed_ref,  # (1, 2) SMEM dropout seed
     dq_ref,  # (1, S, block_q, d)
     dq_scr,  # (S, block_q, d) f32 scratch
     *,
@@ -675,7 +694,7 @@ def _tiled_dkv_kernel(
     lse_ref,  # (1, S, block_q)    streamed
     delta_ref,  # (1, S, block_q)  streamed
     off_ref,  # (1, 1) SMEM
-    seed_ref,  # (1, 1) SMEM dropout seed
+    seed_ref,  # (1, 2) SMEM dropout seed
     dk_ref,  # (1, S, block_k, d)
     dv_ref,  # (1, block_k, dv)
     dk_scr,  # (S, block_k, d) f32
@@ -754,10 +773,12 @@ def _tiled_bwd_call(
     seed = (
         dropout_seed
         if dropout_seed is not None
-        else jnp.zeros((1, 1), jnp.float32)
+        else jnp.zeros((1, 2), jnp.float32)
     )
     off_spec = pl.BlockSpec((1, 1), lambda b, x, y: (0, 0),
                             memory_space=pltpu.SMEM)
+    seed_spec = pl.BlockSpec((1, 2), lambda b, x, y: (0, 0),
+                             memory_space=pltpu.SMEM)
 
     dq = pl.pallas_call(
         functools.partial(_tiled_dq_kernel, dropout_rate=dropout_rate),
@@ -776,7 +797,7 @@ def _tiled_bwd_call(
             pl.BlockSpec((1, S, block_q), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
             off_spec,
-            off_spec,
+            seed_spec,
         ],
         out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i, j: (b, 0, i, 0),
                                memory_space=pltpu.VMEM),
@@ -805,7 +826,7 @@ def _tiled_bwd_call(
             pl.BlockSpec((1, S, block_q), lambda b, j, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
             off_spec,
-            off_spec,
+            seed_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, S, block_k, d), lambda b, j, i: (b, 0, j, 0),
@@ -843,7 +864,7 @@ def _bwd_dq_kernel(
     delta_ref,  # (1, S, block_q)     rowsum(dO_s * O_s)
     off_ref,  # (1, 1) float32 SMEM: causal row offset (0 = aligned causal;
     #           +-kTl for ring chunks whose K lives k shards away)
-    seed_ref,  # (1, 1) float32 SMEM dropout seed
+    seed_ref,  # (1, 2) float32 SMEM dropout seed
     dq_ref,  # (1, S, block_q, d)
     *,
     block_k: int,
@@ -904,7 +925,7 @@ def _bwd_dkv_kernel(
     lse_ref,  # (1, S, T)
     delta_ref,  # (1, S, T)
     off_ref,  # (1, 1) float32 SMEM causal row offset (see _bwd_dq_kernel)
-    seed_ref,  # (1, 1) float32 SMEM dropout seed
+    seed_ref,  # (1, 2) float32 SMEM dropout seed
     dk_ref,  # (1, S, block_k, d)
     dv_ref,  # (1, block_k, dv)
     *,
@@ -992,7 +1013,7 @@ def _bwd_call(
     seed = (
         dropout_seed
         if dropout_seed is not None
-        else jnp.zeros((1, 1), jnp.float32)
+        else jnp.zeros((1, 2), jnp.float32)
     )
     if T > _KV_TILE_THRESHOLD:
         return _tiled_bwd_call(
@@ -1001,6 +1022,7 @@ def _bwd_call(
             dropout_seed=seed, dropout_rate=dropout_rate,
         )
     off_spec = pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
+    seed_spec = pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -1021,7 +1043,7 @@ def _bwd_call(
             pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
             off_spec,
-            off_spec,
+            seed_spec,
         ],
         out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
                                memory_space=pltpu.VMEM),
@@ -1051,7 +1073,7 @@ def _bwd_call(
             pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             off_spec,
-            off_spec,
+            seed_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, S, block_k, d), lambda b, j: (b, 0, j, 0),
@@ -1080,7 +1102,7 @@ def _bwd_call(
 def _flash(q, k, v, coeffs, seed, blocks, interpret, rate=0.0):
     """``blocks`` = (block_q, block_k, block_q_train, block_k_train).
     The inference primal and the differentiated path want different
-    tilings, so they are tuned independently. ``seed`` is the (1, 1)
+    tilings, so they are tuned independently. ``seed`` is the (1, 2)
     float32 dropout seed (dropout_seed_from_rng); ``rate`` the static
     attention-prob dropout rate — both forward and backward regenerate
     the same counter-based masks from (seed, global coords)."""
@@ -1146,7 +1168,7 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret,
     seed = (
         dropout_seed
         if dropout_seed is not None
-        else jnp.zeros((1, 1), jnp.float32)
+        else jnp.zeros((1, 2), jnp.float32)
     )
     if T > _KV_TILE_THRESHOLD:
         return _tiled_fwd_call(
@@ -1169,7 +1191,7 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret,
             pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, S, block_q, dv), lambda b, i: (b, 0, i, 0),
@@ -1196,7 +1218,7 @@ def flash_chunk_attention(q, k, v, offset, seed, blocks, interpret, rate=0.0):
 
     q/k: (BH, S, T, d); v: (BH, T, dv); offset: (1, 1) float32 (traced —
     inside a shard_map ring it is a function of axis_index); ``seed`` a
-    (1, 1) float32 dropout seed (zeros when rate == 0). Returns
+    (1, 2) float32 dropout seed (zeros when rate == 0). Returns
     (o_all (BH, S, T, dv), lse (BH, S, T)); lse accumulates the UNdropped
     probabilities, so chunks still combine exactly via the running
     logsumexp merge (parallel/ring.py) — softmax-then-dropout semantics
@@ -1284,7 +1306,6 @@ def multi_stream_flash_attention(
     256-tiles."""
     if interpret is None:
         interpret = _auto_interpret()
-    dq, dk, dqt, dkt = default_blocks()
     S, B, T, H, d = qs.shape
     dv = v.shape[-1]
     # (S, B, T, H, d) -> (B*H, S, T, d)
@@ -1350,7 +1371,7 @@ def multi_stream_flash_attention_bh(
         seed = dropout_seed_from_rng(dropout_rng)
         rate = float(dropout_rate)
     else:
-        seed = jnp.zeros((1, 1), jnp.float32)
+        seed = jnp.zeros((1, 2), jnp.float32)
         rate = 0.0
     return _flash(q_r, k_r, v_r, c_r, seed, blocks, interpret, rate)
 

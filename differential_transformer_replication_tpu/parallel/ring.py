@@ -86,7 +86,7 @@ def _ring_flash_body(
     seed = (
         dropout_seed_from_rng(dropout_rng)
         if use_drop
-        else jnp.zeros((1, 1), jnp.float32)
+        else jnp.zeros((1, 2), jnp.float32)
     )
 
     # (S, B, Tl, H, d) -> (B*H, S, Tl, d)

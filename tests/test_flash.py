@@ -27,7 +27,7 @@ B, T, H, D = 2, 64, 2, 16
 
 def _zseed():
     """No-dropout seed operand for the chunk op."""
-    return jnp.zeros((1, 1), jnp.float32)
+    return jnp.zeros((1, 2), jnp.float32)
 
 
 def _rand(key, *shape):
