@@ -80,9 +80,23 @@ def save_checkpoint(
 ) -> None:
     """train.py:310-317 equivalent (model+optimizer+scheduler state; the
     schedule is stateless here, so `step` covers it). Always written in
-    the canonical list-of-blocks layout."""
+    the canonical list-of-blocks layout.
+
+    Multi-process safe: EVERY process must call this (the host gather is
+    a collective over non-addressable shards, parallel/multihost.py);
+    only the primary touches the filesystem. On pods the checkpoint path
+    must therefore live on storage every rank can read (NFS/GCS-style
+    shared mount) for a later resume — load_checkpoint reads the file on
+    every rank, the standard multi-host checkpointing contract."""
+    from differential_transformer_replication_tpu.parallel.multihost import (
+        gather_to_host,
+        is_primary,
+    )
+
+    state = gather_to_host(state)
+    if not is_primary():
+        return
     os.makedirs(path, exist_ok=True)
-    state = jax.device_get(state)
     if _is_stacked(state):
         state = canonicalize_state(state, cfg.resolved_model().n_layer)
     meta = {

@@ -41,6 +41,7 @@ def estimate_loss(
     val_ds: TokenWindows,
     cfg: TrainConfig,
     rng: np.random.Generator,
+    materialize=None,
 ) -> dict:
     """Mean loss over eval_iters batches from each split (train.py:125-139):
     train batches shuffled, val batches sequential from the start — the
@@ -51,7 +52,15 @@ def estimate_loss(
     the pipeline microbatch stream, parallel/pipeline.py) and returns
     per-batch losses (or their scalar mean) — one host sync per split
     instead of one per batch. The rng draw sequence is identical to the
-    old per-batch loop (one ``integers(size=B)`` call per train batch)."""
+    old per-batch loop (one ``integers(size=B)`` call per train batch).
+
+    ``materialize(ds, offs)`` turns (eval_iters, B) window offsets into a
+    device batch dict. The trainer passes its ``_materialize`` so eval
+    batches ride the SAME per-process-slice + global-assembly path as
+    training batches on multi-process pods (every process computes
+    identical offsets from the identically-seeded rng, so the slices are
+    consistent); the default is the single-host device-side gather."""
+    mat = materialize if materialize is not None else (lambda ds, offs: ds.batches(offs))
     out = {}
     for split, ds in (("train", train_ds), ("val", val_ds)):
         if split == "train":
@@ -68,7 +77,7 @@ def estimate_loss(
                     for k in range(cfg.eval_iters)
                 ]
             )
-        batch = ds.batches(offs)
+        batch = mat(ds, offs)
         losses = np.asarray(
             jax.device_get(eval_many(params, batch["x"], batch["y"])), np.float64
         )
@@ -179,6 +188,7 @@ def build_data(cfg: TrainConfig):
 def train(cfg: TrainConfig) -> dict:
     """Run the full recipe; returns the final train state."""
     from differential_transformer_replication_tpu.parallel.multihost import (
+        gather_to_host,
         initialize as distributed_initialize,
         is_primary,
     )
@@ -206,7 +216,7 @@ def train(cfg: TrainConfig) -> dict:
         state = create_pipeline_train_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
         best_val_loss = float("inf")
         if cfg.resume_from:
-            host_state = jax.device_get(state)
+            host_state = gather_to_host(state)
             host_state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, host_state)
             sh = pipeline_state_sharding(host_state, mesh)
             state = jax.tree_util.tree_map(jax.device_put, host_state, sh)
@@ -236,7 +246,13 @@ def train(cfg: TrainConfig) -> dict:
         state = create_sharded_train_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
         best_val_loss = float("inf")
         if cfg.resume_from:
-            host_state = jax.device_get(state)
+            # the freshly-initialized state supplies the target pytree; on
+            # multi-process pods its fsdp/tensor shards live on other
+            # hosts' devices, so the host copy must be the collective
+            # gather, and the re-placement below relies on device_put
+            # accepting a global sharding when every process holds the
+            # same full host value (which load_checkpoint guarantees)
+            host_state = gather_to_host(state)
             host_state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, host_state)
             state = shard_state(host_state, mesh)
             print(f"Resumed from {cfg.resume_from} at iter {int(jax.device_get(state['step']))}")
@@ -269,12 +285,15 @@ def train(cfg: TrainConfig) -> dict:
 
     multihost_data = process_count() > 1 and cfg.mesh.n_devices > 1
 
-    def _materialize(offs: np.ndarray) -> dict:
+    def _materialize(ds, offs: np.ndarray) -> dict:
+        # (A|K, B) offsets -> device batch dict; used by BOTH the training
+        # draw and eval (estimate_loss), so every data path is per-process
+        # sliced + globally assembled on pods
         if multihost_data:
             start, per = local_batch_slice(cfg.micro_batch_size)
-            local = train_ds.host_batches(offs[:, start : start + per])
+            local = ds.host_batches(offs[:, start : start + per])
             return assemble_global(local, mesh)
-        return train_ds.batches(offs)
+        return ds.batches(offs)
 
     if cfg.sampler == "epoch":
         # exact DataLoader-style epoch shuffle (train.py:184-191) via the
@@ -296,7 +315,7 @@ def train(cfg: TrainConfig) -> dict:
         def draw_batch():
             offs = perm.take(cfg.grad_acc_steps * cfg.micro_batch_size)
             return _materialize(
-                offs.reshape(cfg.grad_acc_steps, cfg.micro_batch_size)
+                train_ds, offs.reshape(cfg.grad_acc_steps, cfg.micro_batch_size)
             )
     elif cfg.sampler == "replacement":
         def draw_batch():
@@ -305,7 +324,7 @@ def train(cfg: TrainConfig) -> dict:
                 size=(cfg.grad_acc_steps, cfg.micro_batch_size),
                 dtype=np.int64,
             )
-            return _materialize(offs)
+            return _materialize(train_ds, offs)
     else:
         raise ValueError(f"unknown sampler {cfg.sampler!r}")
     dropout_key = jax.random.PRNGKey(cfg.seed + 2)
@@ -332,6 +351,27 @@ def train(cfg: TrainConfig) -> dict:
         del signum, frame
         stop_requested["flag"] = True
 
+    def _agreed_stop(iter_num: int) -> bool:
+        """Whether to break the train loop THIS iteration. Single-process:
+        the local SIGTERM flag, checked every iteration. Multi-process:
+        the flag is OR-reduced across ranks at log_interval boundaries
+        (where logging already forces a host sync), so every rank breaks
+        at the SAME iteration — a rank leaving the loop early while peers
+        still run train_step psums would mismatch collectives and hang
+        the pod. Scheduler preemptions deliver SIGTERM to each rank at
+        slightly different times; the agreement absorbs that skew at the
+        cost of up to log_interval extra steps of grace period."""
+        if process_count() == 1:
+            return stop_requested["flag"]
+        if iter_num % cfg.log_interval != 0:
+            return False
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.float32(1.0 if stop_requested["flag"] else 0.0)
+        )
+        return bool(np.asarray(flags).sum() > 0)
+
     import signal
 
     prev_handler = None
@@ -347,8 +387,9 @@ def train(cfg: TrainConfig) -> dict:
     last_ckpt_path = cfg.resolved_last_checkpoint_path()
     try:
         while iter_num < cfg.max_iters:
-            if stop_requested["flag"]:
-                print(f"SIGTERM received: stopping at iter {iter_num}")
+            if _agreed_stop(iter_num):
+                if is_primary():
+                    print(f"SIGTERM received: stopping at iter {iter_num}")
                 break
             batch = draw_batch()
             rng = jax.random.fold_in(dropout_key, iter_num) if use_dropout else None
@@ -367,30 +408,59 @@ def train(cfg: TrainConfig) -> dict:
 
             if iter_num % cfg.eval_interval == 0:
                 losses = estimate_loss(
-                    eval_many, state["params"], train_ds, val_ds, cfg, eval_rng
+                    eval_many, state["params"], train_ds, val_ds, cfg, eval_rng,
+                    materialize=_materialize,
                 )
                 logger.log_eval(iter_num, losses["train"], losses["val"])
                 if losses["val"] < best_val_loss:  # train.py:307-317
                     best_val_loss = losses["val"]
-                    if is_primary():  # one writer on multi-host
+                    if is_primary():
                         print(f"Saving best model with val loss: {best_val_loss:.4f}")
-                        save_checkpoint(cfg.checkpoint_path, state, best_val_loss, cfg)
+                    # collective host-gather inside; the primary writes
+                    save_checkpoint(cfg.checkpoint_path, state, best_val_loss, cfg)
 
         dt = time.time() - t0
         if dt > 0:
             print(f"Training done: {tokens_seen} tokens in {dt:.1f}s "
                   f"({tokens_seen / dt:.0f} tokens/sec)")
     finally:
-        profiler.close()
-        logger.finish()
+        # these closes must not derail the rescue logic below, and above
+        # all must not derail it ASYMMETRICALLY across ranks (a flush
+        # error on one host only), so they are contained here
+        for closer in (profiler.close, logger.finish):
+            try:
+                closer()
+            except Exception as e:  # noqa: BLE001
+                print(f"shutdown cleanup failed (continuing): {e!r}")
+        import sys as _sys
+
+        # On MULTI-process runs the rescue save embeds a collective
+        # (gather_to_host); if this process is unwinding an exception the
+        # other ranks may be anywhere (still in a train_step psum, or
+        # crashed differently), and issuing a mismatched collective here
+        # would turn one rank's crash into a fleet-wide hang. Skip the
+        # rescue on that path — jax's coordination service tears the job
+        # down when this process exits, and the last periodic
+        # best-checkpoint remains. Deliberately NOT agreed via an
+        # OR-reduce across ranks: that agreement would itself be a
+        # collective issued from an asymmetric path (peers of a mid-loop
+        # crash are still inside train_step psums, not here), i.e. the
+        # exact hazard being avoided. Normal completion and the SIGTERM
+        # graceful stop exit the loop in lockstep on every rank
+        # (_agreed_stop), so their collective save is safe.
+        # Single-process keeps the save on every exit path, crashes
+        # included.
+        crashed = _sys.exc_info()[0] is not None
+        skip_collective_rescue = crashed and process_count() > 1
         try:
-            if last_ckpt_path and is_primary():
+            if last_ckpt_path and not skip_collective_rescue:
                 # resumable last-state checkpoint, written whatever the
                 # exit path (save_checkpoint canonicalizes pipeline
-                # layouts). The SIGTERM handler is still ours here, so a
-                # follow-up SIGTERM during this save cannot kill the
-                # write; the atomic rename inside save_checkpoint
-                # protects against harder kills.
+                # layouts; every process participates in its collective
+                # gather, the primary writes). The SIGTERM handler is
+                # still ours here, so a follow-up SIGTERM during this
+                # save cannot kill the write; the atomic rename inside
+                # save_checkpoint protects against harder kills.
                 finite = True
                 if metrics is not None:
                     # a NaN/diverged state must not overwrite the previous
@@ -401,7 +471,7 @@ def train(cfg: TrainConfig) -> dict:
                     )
                 if finite:
                     save_checkpoint(last_ckpt_path, state, best_val_loss, cfg)
-                else:
+                elif is_primary():
                     print(
                         f"skipping last-checkpoint rescue save: non-finite "
                         f"loss at iter {iter_num} (previous checkpoint at "
@@ -423,7 +493,7 @@ def train(cfg: TrainConfig) -> dict:
         )
 
         state = canonicalize_state(
-            jax.device_get(state),
+            gather_to_host(state),
             cfg.resolved_model().n_layer,
         )
     return state

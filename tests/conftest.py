@@ -35,6 +35,7 @@ import pytest  # noqa: E402
 # name the measured-slow tests/classes/modules (--durations=40 run,
 # 2026-07-30); everything else is marked quick.
 _SLOW_PATTERNS = (
+    "test_multihost_2proc.py",
     "test_pipeline.py",
     "test_remat.py",
     "test_runtime.py::TestEndToEnd",

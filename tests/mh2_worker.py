@@ -1,0 +1,105 @@
+"""Worker for the REAL 2-process distributed test (test_multihost_2proc.py).
+
+Run as:  python mh2_worker.py <rank> <port> <workdir>
+
+Each worker is one jax process with 4 virtual CPU devices (the parent
+sets XLA_FLAGS); ``jax.distributed.initialize`` joins them into one
+8-device 2-process runtime — the genuine multi-process regime the
+single-process faked-slice tests (test_multihost.py) cannot reach. The
+worker runs the FULL trainer (train/trainer.py) twice: sharded steps
+over a data×fsdp mesh with a batched eval and checkpoint saves, then a
+resume from the rescue checkpoint — train data, eval data, and
+checkpoint save/load, the three paths that must survive non-addressable
+sharded state.
+
+Platform/collectives config must happen before any backend use: the
+container's sitecustomize imports jax (and pins JAX_PLATFORMS) at
+interpreter start, so env vars alone are too late — same trick as
+tests/conftest.py.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    rank, port, workdir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", False)
+    # cross-process CPU collectives (the psum/allgather between the two
+    # processes) need an explicit implementation; TPU pods don't (ICI/DCN)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.local_devices()) == 4, jax.local_devices()
+    assert len(jax.devices()) == 8, jax.devices()
+
+    # per-rank cwd: the corpus/tokenizer cache is cwd-relative and both
+    # ranks build it independently (deterministic — same seed, same bytes)
+    cwd = os.path.join(workdir, f"rank{rank}")
+    os.makedirs(cwd, exist_ok=True)
+    os.chdir(cwd)
+
+    from jax.experimental import multihost_utils
+
+    from differential_transformer_replication_tpu.config import (
+        MeshConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from differential_transformer_replication_tpu.train.trainer import train
+
+    cfg = TrainConfig(
+        model=ModelConfig(
+            model="diff",
+            vocab_size=300,
+            n_embd=64,
+            n_head=2,
+            n_layer=2,
+            block_size=32,
+            dropout=0.0,
+            compute_dtype="float32",
+            attention_impl="xla",
+        ),
+        mesh=MeshConfig(data=4, fsdp=2),
+        micro_batch_size=8,
+        grad_acc_steps=1,
+        max_iters=4,
+        eval_interval=2,
+        eval_iters=2,
+        log_interval=1,
+        dataset="synthetic",
+        num_train_samples=200,
+        vocab_size=300,
+        seed=3,
+        metrics_path=os.path.join(workdir, "metrics_2proc.jsonl"),
+        checkpoint_path=os.path.join(workdir, "best.ckpt"),
+        last_checkpoint_path=os.path.join(workdir, "last.ckpt"),
+    )
+    train(cfg)
+
+    # the primary's rescue-checkpoint write must be on disk before EITHER
+    # process tries to resume from it
+    multihost_utils.sync_global_devices("ckpt_written")
+
+    # resume from the rescue checkpoint and continue to 6 iters: exercises
+    # load -> collective gather of the sharded target -> re-placement onto
+    # the 2-process mesh
+    cfg2 = cfg.replace(
+        max_iters=6,
+        resume_from=os.path.join(workdir, "last.ckpt"),
+        metrics_path=os.path.join(workdir, "metrics_2proc_resume.jsonl"),
+    )
+    train(cfg2)
+
+    with open(os.path.join(workdir, f"done_{rank}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main()

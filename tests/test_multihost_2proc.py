@@ -1,0 +1,166 @@
+"""REAL 2-process distributed training test (VERDICT r2 item 2c).
+
+Spawns two genuine OS processes, each a jax process with 4 virtual CPU
+devices, joined via ``jax.distributed.initialize`` + gloo collectives
+into one 8-device 2-process runtime. Both run the full trainer
+(data×fsdp mesh, batched eval, best/rescue checkpoint saves, resume),
+with state shards genuinely NON-addressable across the process boundary
+— the regime the faked-slice tests in test_multihost.py cannot reach.
+
+Parity oracle: the identical config trained in THIS process (8 local
+devices, single jax process, same mesh axes). Same seeds → identical
+data draws and init, so the per-iter losses, eval losses, and the
+post-resume continuation must agree to float32 collective-reduction
+noise. That simultaneously validates the per-process slice + global
+assembly of train AND eval batches, and the collective host-gather in
+save_checkpoint/resume.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+
+_PORT = 21000 + os.getpid() % 9000
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _losses(records, key="loss"):
+    return {r["iter"]: r[key] for r in records if key in r}
+
+
+def _run_workers(workdir):
+    env = dict(os.environ)
+    # 4 virtual CPU devices per process; REPLACE the parent's 8-device
+    # flag. JAX_PLATFORMS is pinned by sitecustomize, the worker
+    # overrides it through jax.config before backend init.
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(os.path.dirname(__file__), "mh2_worker.py")
+    # each worker's output goes to a FILE, not a pipe: with pipes, a
+    # worker that fills its 64KB buffer while the parent is draining the
+    # other one blocks, and its gloo peer then blocks inside a
+    # collective — a three-way deadlock that only resolves at timeout
+    logs = [os.path.join(workdir, f"worker_{rank}.log") for rank in (0, 1)]
+    handles = [open(p, "w") for p in logs]
+    try:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(rank), str(_PORT), workdir],
+                env=env,
+                stdout=handles[rank],
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for rank in (0, 1)
+        ]
+        for p in procs:
+            try:
+                p.wait(timeout=600)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+    finally:
+        for h in handles:
+            h.close()
+    for rank, p in enumerate(procs):
+        with open(logs[rank]) as f:
+            out = f.read()
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert os.path.exists(os.path.join(workdir, f"done_{rank}"))
+
+
+def _single_process_reference(workdir):
+    """The same two-phase run (train 4 iters, resume to 6) on this
+    process's 8 local devices — identical mesh axes and seeds."""
+    from differential_transformer_replication_tpu.train.trainer import train
+
+    cwd = os.getcwd()
+    rundir = os.path.join(workdir, "single")
+    os.makedirs(rundir, exist_ok=True)
+    os.chdir(rundir)
+    try:
+        cfg = TrainConfig(
+            model=ModelConfig(
+                model="diff",
+                vocab_size=300,
+                n_embd=64,
+                n_head=2,
+                n_layer=2,
+                block_size=32,
+                dropout=0.0,
+                compute_dtype="float32",
+                attention_impl="xla",
+            ),
+            mesh=MeshConfig(data=4, fsdp=2),
+            micro_batch_size=8,
+            grad_acc_steps=1,
+            max_iters=4,
+            eval_interval=2,
+            eval_iters=2,
+            log_interval=1,
+            dataset="synthetic",
+            num_train_samples=200,
+            vocab_size=300,
+            seed=3,
+            metrics_path=os.path.join(workdir, "metrics_single.jsonl"),
+            checkpoint_path=os.path.join(workdir, "best_single.ckpt"),
+            last_checkpoint_path=os.path.join(workdir, "last_single.ckpt"),
+        )
+        train(cfg)
+        cfg2 = cfg.replace(
+            max_iters=6,
+            resume_from=os.path.join(workdir, "last_single.ckpt"),
+            metrics_path=os.path.join(workdir, "metrics_single_resume.jsonl"),
+        )
+        train(cfg2)
+    finally:
+        os.chdir(cwd)
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="gloo CPU collectives")
+def test_two_process_training_matches_single_process(tmp_path):
+    workdir = str(tmp_path)
+    _run_workers(workdir)
+    _single_process_reference(workdir)
+
+    for phase, mh_name, single_name in (
+        ("fresh", "metrics_2proc.jsonl", "metrics_single.jsonl"),
+        ("resume", "metrics_2proc_resume.jsonl", "metrics_single_resume.jsonl"),
+    ):
+        mh = _read_jsonl(os.path.join(workdir, mh_name))
+        single = _read_jsonl(os.path.join(workdir, single_name))
+        for key in ("loss", "train_loss", "val_loss"):
+            lm, ls = _losses(mh, key), _losses(single, key)
+            assert set(lm) == set(ls), (phase, key, lm, ls)
+            assert lm, (phase, key)  # at least one record
+            for it in lm:
+                np.testing.assert_allclose(
+                    lm[it], ls[it], rtol=1e-5, atol=1e-6,
+                    err_msg=f"{phase} {key} iter {it}",
+                )
+
+    # the resume really continued (iters 5..6 present after a 4-iter run)
+    resume = _losses(_read_jsonl(os.path.join(workdir, "metrics_2proc_resume.jsonl")))
+    assert set(resume) == {5, 6}, resume
+
+    # the 2-process best checkpoint is readable and was written at an
+    # eval boundary (the resume run may legitimately re-save at iter 6)
+    with open(os.path.join(workdir, "best.ckpt", "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["iter_num"] in (2, 4, 6)
