@@ -385,6 +385,11 @@ def train(cfg: TrainConfig) -> dict:
     iter_num = int(jax.device_get(state["step"]))
     metrics = None  # last step's metrics; gates the rescue save below
     last_ckpt_path = cfg.resolved_last_checkpoint_path()
+    # set by the except below — NOT derived from sys.exc_info(), which
+    # would also be truthy when train() runs inside a caller's exception
+    # handler (e.g. a retry wrapper) and would wrongly suppress the
+    # multi-process rescue save on a clean run
+    crashed = False
     try:
         while iter_num < cfg.max_iters:
             if _agreed_stop(iter_num):
@@ -423,6 +428,9 @@ def train(cfg: TrainConfig) -> dict:
         if dt > 0:
             print(f"Training done: {tokens_seen} tokens in {dt:.1f}s "
                   f"({tokens_seen / dt:.0f} tokens/sec)")
+    except BaseException:
+        crashed = True
+        raise
     finally:
         # these closes must not derail the rescue logic below, and above
         # all must not derail it ASYMMETRICALLY across ranks (a flush
@@ -432,8 +440,6 @@ def train(cfg: TrainConfig) -> dict:
                 closer()
             except Exception as e:  # noqa: BLE001
                 print(f"shutdown cleanup failed (continuing): {e!r}")
-        import sys as _sys
-
         # On MULTI-process runs the rescue save embeds a collective
         # (gather_to_host); if this process is unwinding an exception the
         # other ranks may be anywhere (still in a train_step psum, or
@@ -450,7 +456,6 @@ def train(cfg: TrainConfig) -> dict:
         # (_agreed_stop), so their collective save is safe.
         # Single-process keeps the save on every exit path, crashes
         # included.
-        crashed = _sys.exc_info()[0] is not None
         skip_collective_rescue = crashed and process_count() > 1
         try:
             if last_ckpt_path and not skip_collective_rescue:
