@@ -5,6 +5,25 @@ new token (control.py:163-171, diff_transformer.py:177-185,
 Ndiff_transformer.py:232-241 — "no KV cache", SURVEY.md section 3.4).
 ``models/generate.py`` reproduces that behavior; this module is the
 idiomatic-TPU upgrade: per-layer K/V caches make each new token O(T).
+The cache is a RING over block_size slots, so the RoPE families
+(control/ndiff) keep the O(T)/token fast path arbitrarily far PAST
+block_size: each step attends over exactly the last block_size keys
+(RoPE scores depend only on relative positions, so absolute-position
+rotation needs no re-rotating as the window rolls). Past the boundary
+this is SLIDING-WINDOW ATTENTION — the standard KV-cached long-decode
+semantics — NOT a bit-reproduction of the reference's crop
+(control.py:163-171), and no O(T)/token scheme can be one for depth
+>= 2: the reference recomputes the whole cropped forward each step, so
+when the window slides, EVERY remaining position loses its oldest
+visible key and all its deep-layer activations change — Omega(M^2)
+recompute per token is inherent to crop semantics. The ring instead
+keeps each cached activation as computed with its own full window
+(receptive field grows with depth, strictly containing the crop's).
+The two are exactly equal for single-layer models and everywhere up to
+the block boundary (tests/test_decode.py pins both). The diff family's
+learned absolute position table cannot roll at all (each window slide
+would re-embed every cached position), so it keeps the hard in-window
+bound and the windowed ``generate`` beyond it.
 
 One chunked code path serves both phases — ``forward_chunk`` processes L
 tokens starting at position ``pos`` against the cache, so prefill is a
@@ -108,9 +127,11 @@ def _attn_chunk(
     cfg: ModelConfig,
     cos: jnp.ndarray,  # (L, d/2) tables pre-sliced at [pos, pos+L)
     sin: jnp.ndarray,
+    window: int = 0,  # visibility clip; 0/None = the cache size M
 ) -> Tuple[jnp.ndarray, dict]:
     B, L, E = x.shape
     M = cfg.block_size
+    W = int(window) if window else M
     wq, wk = _stacked_wq(p_attn)
     qs = jnp.einsum("ble,sehd->sblhd", x, wq.astype(x.dtype))
     ks = jnp.einsum("ble,sehd->sblhd", x, wk.astype(x.dtype))
@@ -119,20 +140,39 @@ def _attn_chunk(
         qs = apply_rope(qs, cos, sin)
         ks = apply_rope(ks, cos, sin)
 
+    # RING cache: slot = pos mod M, so positions past block_size roll over
+    # the oldest entries instead of clamping. Keys are rotated at their
+    # ABSOLUTE position; RoPE scores depend only on (q_pos - k_pos), so
+    # the rolled window needs no re-rotating (sliding-window attention —
+    # see the module docstring for how this relates to the reference's
+    # crop semantics).
+    slot = jax.lax.rem(jnp.asarray(pos, jnp.int32), M)
     k_cache = jax.lax.dynamic_update_slice(
-        layer_cache["k"], ks, (0, 0, pos, 0, 0)
+        layer_cache["k"], ks, (0, 0, slot, 0, 0)
     )
-    v_cache = jax.lax.dynamic_update_slice(layer_cache["v"], v, (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(layer_cache["v"], v, (0, slot, 0, 0))
 
     scale = 1.0 / (cfg.head_size ** 0.5)
     scores = (
         jnp.einsum("sblhd,sbmhd->sbhlm", qs, k_cache).astype(jnp.float32) * scale
     )
-    # causal over absolute positions: chunk row l sits at pos+l and may see
-    # cached columns m <= pos+l (later cache slots are zeros — masked off)
+    # Ring-aware causal mask over absolute positions. After this chunk's
+    # write the latest absolute position is ``last``; slot m then holds
+    # absolute position ``last - ((last - m) mod M)`` (the most recent
+    # write to that slot; negative = never written). Chunk row l sits at
+    # absolute pos+l and may see a slot iff its held position is in the
+    # sliding window [row - W + 1, row] — which also masks same-chunk
+    # future rows and unwritten (zero) slots. W < M (an explicit
+    # ``window``) clips visibility tighter than the cache — used by the
+    # append-oracle test to validate the ring arithmetic.
     rows = pos + jnp.arange(L)[:, None]
-    cols = jnp.arange(M)[None, :]
-    scores = jnp.where((cols <= rows)[None, None, None], scores, NEG_INF)
+    slots = jnp.arange(M)[None, :]
+    last = pos + L - 1
+    held = last - jax.lax.rem(
+        jnp.asarray(last, jnp.int32) - slots, jnp.asarray(M, jnp.int32)
+    )
+    visible = (held <= rows) & (held >= 0) & (held > rows - W)
+    scores = jnp.where(visible[None, None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)  # per-stream, fp32
 
     coeffs = _layer_coeffs(cfg, p_attn, layer_idx)  # (S, H)
@@ -152,22 +192,59 @@ def forward_chunk(
     pos,
     cache: list,
     cfg: ModelConfig,
+    rope_len: int = 0,
+    window: int = 0,
 ) -> Tuple[jnp.ndarray, list]:
     """Process a chunk against the cache. Returns ((B, L, V) logits,
     updated cache). Prefill = one big chunk at pos=0; decode = L=1.
 
-    ``pos + L`` must not exceed ``block_size`` — past it,
-    dynamic_update_slice would silently clamp the cache write and corrupt
-    the last slot, so concrete positions fail loudly here (the repo's
-    fail-loudly convention, models/diff.py forward). Traced positions
-    cannot be checked at trace time; jitted callers must guard like
-    generate_cached does."""
+    The cache is a RING over ``block_size`` slots, so RoPE families
+    (control/ndiff) may run ``pos`` past block_size indefinitely — the
+    oldest keys roll off at O(T) per token (sliding-window attention;
+    the module docstring relates this to the reference's crop,
+    control.py:163-171). ``rope_len`` sizes the rotation tables
+    (>= pos + L; defaults to block_size for the in-window case);
+    ``window`` optionally clips visibility tighter than the cache size
+    (test/oracle use). The DIFF family's learned absolute position
+    table (diff_transformer.py:158) makes cached reuse past block_size
+    architecturally impossible — every cached K/V would need
+    recomputing under the shifted position embeddings — so concrete
+    positions fail loudly there (the repo's fail-loud convention) and
+    models/generate.py remains its sliding-window path. Other
+    concrete-position chunks that cannot be represented also fail
+    loudly: RoPE positions past the table (pass a bigger ``rope_len``),
+    multi-token chunks at rolled positions (their in-chunk writes would
+    evict keys still visible to earlier rows), and writes wrapping the
+    ring slice boundary."""
     B, L = tokens.shape
-    if isinstance(pos, (int,)) and pos + L > cfg.block_size:
-        raise ValueError(
-            f"chunk [{pos}, {pos + L}) exceeds block_size {cfg.block_size}: "
-            "the cache write would clamp and corrupt the last slot"
-        )
+    M = cfg.block_size
+    if isinstance(pos, int):
+        if cfg.model == "diff" and pos + L > M:
+            raise ValueError(
+                f"chunk [{pos}, {pos + L}) exceeds block_size {M}: the diff "
+                "family's learned absolute position table cannot roll (each "
+                "slide would re-embed every cached position); use "
+                "models.generate for its sliding-window behavior"
+            )
+        if cfg.model != "diff" and pos + L > max(int(rope_len), M):
+            raise ValueError(
+                f"chunk [{pos}, {pos + L}) exceeds the RoPE table length "
+                f"{max(int(rope_len), M)}: pass rope_len >= the final "
+                "position or the cos/sin slice would silently clamp and "
+                "mis-rotate"
+            )
+        if pos >= M and L > 1:
+            raise ValueError(
+                f"multi-token chunk at rolled position {pos} >= block_size "
+                f"{M}: its in-chunk writes would evict keys still inside "
+                "earlier rows' sliding windows (silently shrinking their "
+                "attention); feed rolled positions one token at a time"
+            )
+        if (pos % M) + L > M:
+            raise ValueError(
+                f"chunk [{pos}, {pos + L}) wraps the ring boundary (slot "
+                f"{pos % M} + {L} > {M}): split it at the boundary"
+            )
     compute = jnp.dtype(cfg.compute_dtype)
     x = params["tok_emb"][tokens].astype(compute)
     if cfg.model == "diff":  # learned absolute positions (diff_transformer.py:158)
@@ -176,7 +253,9 @@ def forward_chunk(
         ).astype(compute)
         cos = sin = None
     else:
-        cos_full, sin_full = rope_cos_sin(cfg.head_size, cfg.block_size)
+        cos_full, sin_full = rope_cos_sin(
+            cfg.head_size, max(int(rope_len), M)
+        )
         cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, L, axis=0)
         sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, L, axis=0)
 
@@ -184,7 +263,7 @@ def forward_chunk(
     for li, blk in enumerate(params["blocks"], 1):  # 1-based (diff_transformer.py:161)
         a, layer_cache = _attn_chunk(
             common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
-            cache[li - 1], pos, li, cfg, cos, sin,
+            cache[li - 1], pos, li, cfg, cos, sin, window=window,
         )
         x = x + a
         x = x + common.apply_ffn(common.apply_layer_norm(x, blk["ln2"]), blk["ffn"])
@@ -210,18 +289,42 @@ def generate_cached(
     (temperature-1 categorical over the last position, prompt included in
     the return), O(T) per new token instead of O(T^2).
 
-    Requires ``T0 + max_new_tokens <= block_size`` (no sliding-window
-    support — use models/generate.py past the context limit, which
-    reproduces the reference's crop behavior)."""
+    RoPE families (control/ndiff) may generate PAST block_size: the ring
+    cache rolls the oldest keys off, so every step attends over exactly
+    the last block_size tokens at O(T)/token instead of the windowed
+    recompute's O(T^2) — sliding-window attention semantics, which
+    equals the reference's crop (control.py:163-171) exactly for
+    single-layer models and up to the block boundary for any depth; for
+    deeper models past the boundary the crop's per-step full recompute
+    is Omega(M^2)/token by construction and the cached fast path keeps
+    richer (own-window) activations instead — see the module docstring.
+    The diff family (learned absolute position table,
+    diff_transformer.py:158) cannot roll its cache — each window slide
+    re-embeds every cached position — so it keeps the
+    ``T0 + max_new_tokens <= block_size`` bound and models/generate.py
+    for longer runs."""
     B, T0 = idx.shape
-    if T0 + max_new_tokens > cfg.block_size:
+    M = cfg.block_size
+    if cfg.model == "diff" and T0 + max_new_tokens > M:
         raise ValueError(
             f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"block_size ({cfg.block_size}); use models.generate for the "
-            "sliding-window behavior"
+            f"block_size ({M}) and the diff family's learned absolute "
+            "position table cannot roll with a KV cache; use "
+            "models.generate for its sliding-window behavior"
         )
+    # the reference crops the prompt itself to the last block_size tokens
+    # (control.py:165); rebasing the crop to position 0 is invariant for
+    # RoPE (relative positions) and exact for diff (which fits by the
+    # guard above)
+    if T0 > M:
+        idx_cond = idx[:, -M:]
+        Tc = M
+    else:
+        idx_cond = idx
+        Tc = T0
+    total = Tc + max_new_tokens
     cache = init_cache(cfg, B)
-    logits, cache = forward_chunk(params, idx, 0, cache, cfg)
+    logits, cache = forward_chunk(params, idx_cond, 0, cache, cfg, rope_len=total)
     samples = jnp.zeros((B, max_new_tokens), idx.dtype)
 
     rng, key0 = jax.random.split(rng)
@@ -235,7 +338,7 @@ def generate_cached(
         rng, key = jax.random.split(rng)
         prev = samples[:, i - 1]
         logits, cache = forward_chunk(
-            params, prev[:, None], T0 + i - 1, cache, cfg
+            params, prev[:, None], Tc + i - 1, cache, cfg, rope_len=total
         )
         nxt = sample_token(
             key, logits[:, -1, :].astype(jnp.float32), temperature, top_k
