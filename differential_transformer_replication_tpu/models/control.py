@@ -87,6 +87,12 @@ def _attn(
             q, k, v, mask=mask, dropout_rate=dropout_rate, rng=r_att
         ),
         impl=impl, mesh=mesh, dropout_rate=dropout_rate, rng=r_att,
+        # kernel-native-layout fast path (RoPE applied in the bh layout)
+        flash_fn=common.flash_bh_fn(
+            x, p["wq"][None], p["wk"][None], p["wv"],
+            vanilla_coeffs(q.shape[2]),
+            dropout_rate=dropout_rate, rng=r_att, cos=cos, sin=sin,
+        ),
     )
     out = out.reshape(B, T, -1)  # concat heads (control.py:76)
     out = common.linear(out, p["out"])

@@ -96,31 +96,6 @@ def _attn(
         p["lambda_q"][1], p["lambda_k"][1],
         lambda_init_schedule(layer_idx),
     )  # (H,) fp32
-    H, d = p["wv"].shape[1], p["wq"].shape[-1]
-
-    def _flash_bh():
-        # single-device fused path: project straight into the kernel's
-        # (B*H, S, T, d) layout — "bhstd" einsum + free reshape — instead
-        # of transposing the stacked (S, B, T, H, d) arrays (the stacked
-        # projections above are dead code on this branch and DCE'd).
-        from differential_transformer_replication_tpu.ops.flash import (
-            multi_stream_flash_attention_bh,
-        )
-
-        q_r = jnp.einsum(
-            "bte,sehd->bhstd", x, p["wq"].astype(x.dtype)
-        ).reshape(B * H, 2, T, d)
-        k_r = jnp.einsum(
-            "bte,sehd->bhstd", x, p["wk"].astype(x.dtype)
-        ).reshape(B * H, 2, T, d)
-        v_r = jnp.einsum(
-            "bte,ehd->bhtd", x, p["wv"].astype(x.dtype)
-        ).reshape(B * H, T, 2 * d)
-        out = multi_stream_flash_attention_bh(
-            q_r, k_r, v_r, diff_coeffs(lam), B, H,
-            dropout_rate=dropout_rate, dropout_rng=r_att,
-        )
-        return out.reshape(B, H, T, 2 * d).transpose(0, 2, 1, 3)
 
     out = common.dispatch_attention(
         qs, ks, v, diff_coeffs(lam),
@@ -130,7 +105,12 @@ def _attn(
             mask=mask, dropout_rate=dropout_rate, rng=r_att,
         ),
         impl=impl, mesh=mesh, dropout_rate=dropout_rate, rng=r_att,
-        flash_fn=_flash_bh,
+        # kernel-native-layout fast path (the stacked projections above
+        # are dead code on that branch and DCE'd)
+        flash_fn=common.flash_bh_fn(
+            x, p["wq"], p["wk"], p["wv"], diff_coeffs(lam),
+            dropout_rate=dropout_rate, rng=r_att,
+        ),
     )
     out = out.reshape(B, T, -1)  # concat heads (diff_transformer.py:89)
     out = group_layer_norm(out, p["gn"]["w"], p["gn"]["b"])  # :90

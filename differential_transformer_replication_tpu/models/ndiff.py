@@ -107,6 +107,11 @@ def _attn(
             mask=mask, dropout_rate=dropout_rate, rng=r_att,
         ),
         impl=impl, mesh=mesh, dropout_rate=dropout_rate, rng=r_att,
+        # kernel-native-layout fast path (RoPE applied in the bh layout)
+        flash_fn=common.flash_bh_fn(
+            x, p["wq"], p["wk"], p["wv"], ndiff_coeffs(lams, ndiff_signs(n)),
+            dropout_rate=dropout_rate, rng=r_att, cos=cos, sin=sin,
+        ),
     )
     out = out.reshape(B, T, -1)  # concat heads (Ndiff_transformer.py:142)
     out = group_layer_norm(out, p["gn"]["w"], p["gn"]["b"])  # :143

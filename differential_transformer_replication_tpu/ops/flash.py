@@ -218,6 +218,24 @@ def _apply_keep(p, keep, rate: float):
     return jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
 
 
+def _scale_streams(x, c_ref, bh_id, S: int):
+    """(bq, bk) -> (S, bq, bk): scale one block by each stream's scalar
+    combine coefficient (SMEM (BH, S) table), statically unrolled —
+    Mosaic rejects the equivalent (S, 1, 1)-broadcast formulation
+    ("unsupported shape cast") for S >= 2. The FACTORED backward's
+    per-stream dP expansion; see _bwd_dq_kernel."""
+    return jnp.stack([x * c_ref[bh_id, s] for s in range(S)])
+
+
+def _combine_streams(p, c_ref, bh_id, S: int):
+    """(S, bq, bk) -> (bq, bk): sum of streams weighted by their scalar
+    combine coefficients (statically unrolled, see _scale_streams)."""
+    acc = p[0] * c_ref[bh_id, 0]
+    for s in range(1, S):
+        acc = acc + p[s] * c_ref[bh_id, s]
+    return acc
+
+
 def dropout_seed_from_rng(rng) -> jnp.ndarray:
     """(1, 2) float32 carrying two 24-bit seed words (48 bits total) drawn
     from a jax PRNG key — each exactly representable in float32, so SMEM
@@ -629,15 +647,18 @@ def _tiled_dq_kernel(
     q_ref,  # (1, S, block_q, d)
     k_ref,  # (1, S, block_k, d)  streamed
     v_ref,  # (1, block_k, dv)    streamed
-    do_ref,  # (1, S, block_q, dv)
+    do_ref,  # (1, block_q, dv) factored shared g | (1, S, block_q, dv)
+    #          legacy (see _bwd_dq_kernel)
     lse_ref,  # (1, S, block_q)
     delta_ref,  # (1, S, block_q)
     off_ref,  # (1, 1) SMEM
     seed_ref,  # (1, 2) SMEM dropout seed
+    c_ref,  # (BH, S) float32 SMEM combine coeffs (read only when factored)
     dq_ref,  # (1, S, block_q, d)
     dq_scr,  # (S, block_q, d) f32 scratch
     *,
     dropout_rate: float = 0.0,
+    factored: bool = False,
 ):
     S, block_q, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     block_k = k_ref.shape[2]
@@ -662,11 +683,21 @@ def _tiled_dq_kernel(
         delta = delta_ref[0]
         s, keep = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
         p = jnp.where(keep, jnp.exp(s - lse[:, :, None]), 0.0)
-        dp = jax.lax.dot_general(
-            do, v_j,
-            dimension_numbers=(((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if factored:
+            dp = _scale_streams(
+                jax.lax.dot_general(
+                    do, v_j,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ),
+                c_ref, bh_id, S,
+            )  # one matmul, per-stream scalar scale
+        else:
+            dp = jax.lax.dot_general(
+                do, v_j,
+                dimension_numbers=(((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
         if dropout_rate > 0.0:
             # dP arrives through the dropout: dP~ = mask/keep * (dO V^T)
             dkeep = _keep_mask_block(
@@ -690,17 +721,20 @@ def _tiled_dkv_kernel(
     q_ref,  # (1, S, block_q, d)  streamed (innermost grid dim)
     k_ref,  # (1, S, block_k, d)
     v_ref,  # (1, block_k, dv)
-    do_ref,  # (1, S, block_q, dv) streamed
+    do_ref,  # (1, block_q, dv) factored shared g | (1, S, block_q, dv)
+    #          legacy — streamed either way (see _bwd_dq_kernel)
     lse_ref,  # (1, S, block_q)    streamed
     delta_ref,  # (1, S, block_q)  streamed
     off_ref,  # (1, 1) SMEM
     seed_ref,  # (1, 2) SMEM dropout seed
+    c_ref,  # (BH, S) float32 SMEM combine coeffs (read only when factored)
     dk_ref,  # (1, S, block_k, d)
     dv_ref,  # (1, block_k, dv)
     dk_scr,  # (S, block_k, d) f32
     dv_scr,  # (block_k, dv) f32
     *,
     dropout_rate: float = 0.0,
+    factored: bool = False,
 ):
     S, block_k, d = k_ref.shape[1], k_ref.shape[2], k_ref.shape[3]
     block_q = q_ref.shape[2]
@@ -720,7 +754,6 @@ def _tiled_dkv_kernel(
     def _():
         q_i = q_ref[0]
         k = k_ref[0]
-        do_i = do_ref[0]
         lse_i = lse_ref[0]
         delta_i = delta_ref[0]
         s, keep = _masked_scores(q_i, k, i * block_q, k_start, off, scale)
@@ -733,21 +766,40 @@ def _tiled_dkv_kernel(
                 block_q, block_k, dropout_rate, off,
             )
             p_v = _apply_keep(p, dkeep, dropout_rate)  # dropped map P~
-        p_lo = p_v.astype(do_i.dtype)
-        dv_acc = dv_scr[:]
-        for s_idx in range(S):
-            # dV = sum_s P~_s^T dO_s (coeff already folded into dO_s)
-            dv_acc = dv_acc + jax.lax.dot_general(
-                p_lo[s_idx], do_i[s_idx],
+        if factored:
+            g_i = do_ref[0]  # (block_q, dv)
+            # dV = (sum_s c_s P~_s)^T g: VPU combine, one matmul
+            p_c = _combine_streams(p_v, c_ref, bh_id, S).astype(g_i.dtype)
+            dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+                p_c, g_i,
                 dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-        dv_scr[:] = dv_acc
-        dp = jax.lax.dot_general(
-            do_i, v_ref[0],
-            dimension_numbers=(((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            dp = _scale_streams(
+                jax.lax.dot_general(
+                    g_i, v_ref[0],
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ),
+                c_ref, bh_id, S,
+            )
+        else:
+            do_i = do_ref[0]
+            p_lo = p_v.astype(do_i.dtype)
+            dv_acc = dv_scr[:]
+            for s_idx in range(S):
+                # dV = sum_s P~_s^T dO_s (coeff already folded into dO_s)
+                dv_acc = dv_acc + jax.lax.dot_general(
+                    p_lo[s_idx], do_i[s_idx],
+                    dimension_numbers=(((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            dv_scr[:] = dv_acc
+            dp = jax.lax.dot_general(
+                do_i, v_ref[0],
+                dimension_numbers=(((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
         if dropout_rate > 0.0:
             dp = _apply_keep(dp, dkeep, dropout_rate)
         ds = p * (dp - delta_i[:, :, None])
@@ -765,11 +817,17 @@ def _tiled_dkv_kernel(
 
 def _tiled_bwd_call(
     q, k, v, do_s, lse, delta, offset, *, block_q, block_k, interpret,
-    dropout_seed=None, dropout_rate: float = 0.0,
+    dropout_seed=None, dropout_rate: float = 0.0, coeffs=None,
 ):
     BH, S, T, d = q.shape
     dv_width = v.shape[-1]
     nq, nk = T // block_q, T // block_k
+    factored = coeffs is not None
+    c_arr = (
+        coeffs.astype(jnp.float32)
+        if factored
+        else jnp.zeros((BH, S), jnp.float32)
+    )
     seed = (
         dropout_seed
         if dropout_seed is not None
@@ -779,9 +837,27 @@ def _tiled_bwd_call(
                             memory_space=pltpu.SMEM)
     seed_spec = pl.BlockSpec((1, 2), lambda b, x, y: (0, 0),
                              memory_space=pltpu.SMEM)
+    c_spec = pl.BlockSpec((BH, S), lambda b, x, y: (0, 0),
+                          memory_space=pltpu.SMEM)
+    if factored:
+        do_spec_q = pl.BlockSpec((1, block_q, dv_width),
+                                 lambda b, i, j: (b, i, 0),
+                                 memory_space=pltpu.VMEM)
+        do_spec_kv = pl.BlockSpec((1, block_q, dv_width),
+                                  lambda b, j, i: (b, i, 0),
+                                  memory_space=pltpu.VMEM)
+    else:
+        do_spec_q = pl.BlockSpec((1, S, block_q, dv_width),
+                                 lambda b, i, j: (b, 0, i, 0),
+                                 memory_space=pltpu.VMEM)
+        do_spec_kv = pl.BlockSpec((1, S, block_q, dv_width),
+                                  lambda b, j, i: (b, 0, i, 0),
+                                  memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
-        functools.partial(_tiled_dq_kernel, dropout_rate=dropout_rate),
+        functools.partial(
+            _tiled_dq_kernel, dropout_rate=dropout_rate, factored=factored
+        ),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, S, block_q, d), lambda b, i, j: (b, 0, i, 0),
@@ -790,14 +866,14 @@ def _tiled_bwd_call(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, dv_width), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, block_q, dv_width), lambda b, i, j: (b, 0, i, 0),
-                         memory_space=pltpu.VMEM),
+            do_spec_q,
             pl.BlockSpec((1, S, block_q), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, S, block_q), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
             off_spec,
             seed_spec,
+            c_spec,
         ],
         out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i, j: (b, 0, i, 0),
                                memory_space=pltpu.VMEM),
@@ -807,10 +883,12 @@ def _tiled_bwd_call(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta, offset, seed)
+    )(q, k, v, do_s, lse, delta, offset, seed, c_arr)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_tiled_dkv_kernel, dropout_rate=dropout_rate),
+        functools.partial(
+            _tiled_dkv_kernel, dropout_rate=dropout_rate, factored=factored
+        ),
         grid=(BH, nk, nq),
         in_specs=[
             pl.BlockSpec((1, S, block_q, d), lambda b, j, i: (b, 0, i, 0),
@@ -819,14 +897,14 @@ def _tiled_bwd_call(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, dv_width), lambda b, j, i: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, block_q, dv_width), lambda b, j, i: (b, 0, i, 0),
-                         memory_space=pltpu.VMEM),
+            do_spec_kv,
             pl.BlockSpec((1, S, block_q), lambda b, j, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, S, block_q), lambda b, j, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
             off_spec,
             seed_spec,
+            c_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, S, block_k, d), lambda b, j, i: (b, 0, j, 0),
@@ -846,7 +924,7 @@ def _tiled_bwd_call(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta, offset, seed)
+    )(q, k, v, do_s, lse, delta, offset, seed, c_arr)
     return dq, dk, dv
 
 
@@ -859,16 +937,23 @@ def _bwd_dq_kernel(
     q_ref,  # (1, S, block_q, d)
     k_ref,  # (1, S, T, d)
     v_ref,  # (1, T, dv)
-    do_ref,  # (1, S, block_q, dv)  per-stream upstream grad (coeff folded in)
+    do_ref,  # FACTORED: (1, block_q, dv) shared upstream grad g — the
+    #          per-stream grads differ only by the scalar combine
+    #          coefficient (dO_s = c_s * g), so dP needs ONE g V^T matmul
+    #          scaled per stream instead of S. LEGACY (ring path, where
+    #          each stream output has its own cotangent):
+    #          (1, S, block_q, dv), coeff folded in.
     lse_ref,  # (1, S, block_q)
     delta_ref,  # (1, S, block_q)     rowsum(dO_s * O_s)
     off_ref,  # (1, 1) float32 SMEM: causal row offset (0 = aligned causal;
     #           +-kTl for ring chunks whose K lives k shards away)
     seed_ref,  # (1, 2) float32 SMEM dropout seed
+    c_ref,  # (BH, S) float32 SMEM combine coeffs (read only when factored)
     dq_ref,  # (1, S, block_q, d)
     *,
     block_k: int,
     dropout_rate: float = 0.0,
+    factored: bool = False,
 ):
     S, block_q, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     T = k_ref.shape[2]
@@ -879,7 +964,7 @@ def _bwd_dq_kernel(
     off = off_ref[0, 0].astype(jnp.int32)
 
     q = q_ref[0]
-    do = do_ref[0]  # (S, block_q, dv)
+    do = do_ref[0]  # (block_q, dv) factored | (S, block_q, dv) legacy
     lse = lse_ref[0]  # (S, block_q) f32
     delta = delta_ref[0]  # (S, block_q) f32
     scale = 1.0 / math.sqrt(d)
@@ -890,11 +975,21 @@ def _bwd_dq_kernel(
             v_j = v_ref[0, pl.ds(j * block_k, block_k), :]
             s, keep = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
             p = jnp.where(keep, jnp.exp(s - lse[:, :, None]), 0.0)
-            dp = jax.lax.dot_general(
-                do, v_j,
-                dimension_numbers=(((2,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # (S, block_q, block_k)
+            if factored:
+                dp = _scale_streams(
+                    jax.lax.dot_general(
+                        do, v_j,
+                        dimension_numbers=(((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    ),
+                    c_ref, bh_id, S,
+                )  # one matmul, per-stream scalar scale
+            else:
+                dp = jax.lax.dot_general(
+                    do, v_j,
+                    dimension_numbers=(((2,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # (S, block_q, block_k)
             if dropout_rate > 0.0:
                 # dP arrives through the dropout: dP~ = mask/keep * (dO V^T)
                 dkeep = _keep_mask_block(
@@ -921,16 +1016,19 @@ def _bwd_dkv_kernel(
     q_ref,  # (1, S, T, d)
     k_ref,  # (1, S, block_k, d)
     v_ref,  # (1, block_k, dv)
-    do_ref,  # (1, S, T, dv)
+    do_ref,  # (1, T, dv) factored shared g | (1, S, T, dv) legacy
+    #          (see _bwd_dq_kernel)
     lse_ref,  # (1, S, T)
     delta_ref,  # (1, S, T)
     off_ref,  # (1, 1) float32 SMEM causal row offset (see _bwd_dq_kernel)
     seed_ref,  # (1, 2) float32 SMEM dropout seed
+    c_ref,  # (BH, S) float32 SMEM combine coeffs (read only when factored)
     dk_ref,  # (1, S, block_k, d)
     dv_ref,  # (1, block_k, dv)
     *,
     block_q: int,
     dropout_rate: float = 0.0,
+    factored: bool = False,
 ):
     S, block_k, d = k_ref.shape[1], k_ref.shape[2], k_ref.shape[3]
     T = q_ref.shape[2]
@@ -950,7 +1048,6 @@ def _bwd_dkv_kernel(
         def compute(carry):
             dk, dv = carry
             q_i = q_ref[0, :, pl.ds(i * block_q, block_q), :]
-            do_i = do_ref[0, :, pl.ds(i * block_q, block_q), :]
             lse_i = lse_ref[0, :, pl.ds(i * block_q, block_q)]
             delta_i = delta_ref[0, :, pl.ds(i * block_q, block_q)]
             s, keep = _masked_scores(q_i, k, i * block_q, k_start, off, scale)
@@ -963,22 +1060,42 @@ def _bwd_dkv_kernel(
                     block_q, block_k, dropout_rate, off,
                 )
                 p_v = _apply_keep(p, dkeep, dropout_rate)  # dropped map P~
-            p_lo = p_v.astype(do_i.dtype)
-            # dV = sum_s P~_s^T dO_s (coeff already folded into dO_s).
-            # Mosaic can't contract two dims at once, so loop streams
-            # statically — S is tiny (1, 2, or n_terms).
-            dv_new = dv
-            for s_idx in range(S):
-                dv_new = dv_new + jax.lax.dot_general(
-                    p_lo[s_idx], do_i[s_idx],
+            if factored:
+                g_i = do_ref[0, pl.ds(i * block_q, block_q), :]  # (bq, dv)
+                # dV = sum_s P~_s^T (c_s g) = (sum_s c_s P~_s)^T g — the
+                # stream combine is a cheap VPU sum, leaving ONE matmul
+                p_c = _combine_streams(p_v, c_ref, bh_id, S).astype(g_i.dtype)
+                dv_new = dv + jax.lax.dot_general(
+                    p_c, g_i,
                     dimension_numbers=(((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
-            dp = jax.lax.dot_general(
-                do_i, v_ref[0],
-                dimension_numbers=(((2,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
+                dp = _scale_streams(
+                    jax.lax.dot_general(
+                        g_i, v_ref[0],
+                        dimension_numbers=(((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    ),
+                    c_ref, bh_id, S,
+                )  # one matmul, per-stream scalar scale
+            else:
+                do_i = do_ref[0, :, pl.ds(i * block_q, block_q), :]
+                p_lo = p_v.astype(do_i.dtype)
+                # dV = sum_s P~_s^T dO_s (coeff already folded into dO_s).
+                # Mosaic can't contract two dims at once, so loop streams
+                # statically — S is tiny (1, 2, or n_terms).
+                dv_new = dv
+                for s_idx in range(S):
+                    dv_new = dv_new + jax.lax.dot_general(
+                        p_lo[s_idx], do_i[s_idx],
+                        dimension_numbers=(((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                dp = jax.lax.dot_general(
+                    do_i, v_ref[0],
+                    dimension_numbers=(((2,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
             if dropout_rate > 0.0:
                 dp = _apply_keep(dp, dkeep, dropout_rate)
             ds = p * (dp - delta_i[:, :, None])
@@ -1003,11 +1120,18 @@ def _bwd_dkv_kernel(
 def _bwd_call(
     q, k, v, do_s, lse, delta, offset=None, *,
     block_q: int, block_k: int, interpret: bool,
-    dropout_seed=None, dropout_rate: float = 0.0,
+    dropout_seed=None, dropout_rate: float = 0.0, coeffs=None,
 ):
+    """``coeffs`` (BH, S) switches the kernels to the FACTORED form:
+    ``do_s`` is then the SHARED upstream grad g of shape (BH, T, dv) and
+    the per-stream grads are recovered in-kernel as c_s * g — one dP/dV
+    matmul instead of S, and S-fold less dO streamed. ``coeffs=None`` is
+    the legacy per-stream form (the ring path's chunk cotangents cannot
+    factor)."""
     BH, S, T, d = q.shape
     dv_width = v.shape[-1]
     nq, nk = T // block_q, T // block_k
+    factored = coeffs is not None
     if offset is None:
         offset = jnp.zeros((1, 1), jnp.float32)
     seed = (
@@ -1019,14 +1143,33 @@ def _bwd_call(
         return _tiled_bwd_call(
             q, k, v, do_s, lse, delta, offset,
             block_q=block_q, block_k=block_k, interpret=interpret,
-            dropout_seed=seed, dropout_rate=dropout_rate,
+            dropout_seed=seed, dropout_rate=dropout_rate, coeffs=coeffs,
         )
+    c_arr = (
+        coeffs.astype(jnp.float32)
+        if factored
+        else jnp.zeros((BH, S), jnp.float32)
+    )
     off_spec = pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
     seed_spec = pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
+    c_spec = pl.BlockSpec((BH, S), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
+    if factored:
+        do_spec_q = pl.BlockSpec((1, block_q, dv_width),
+                                 lambda b, i: (b, i, 0),
+                                 memory_space=pltpu.VMEM)
+        do_spec_kv = pl.BlockSpec((1, T, dv_width), lambda b, j: (b, 0, 0),
+                                  memory_space=pltpu.VMEM)
+    else:
+        do_spec_q = pl.BlockSpec((1, S, block_q, dv_width),
+                                 lambda b, i: (b, 0, i, 0),
+                                 memory_space=pltpu.VMEM)
+        do_spec_kv = pl.BlockSpec((1, S, T, dv_width), lambda b, j: (b, 0, 0, 0),
+                                  memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, block_k=block_k, dropout_rate=dropout_rate
+            _bwd_dq_kernel, block_k=block_k, dropout_rate=dropout_rate,
+            factored=factored,
         ),
         grid=(BH, nq),
         in_specs=[
@@ -1036,14 +1179,14 @@ def _bwd_call(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, T, dv_width), lambda b, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, block_q, dv_width), lambda b, i: (b, 0, i, 0),
-                         memory_space=pltpu.VMEM),
+            do_spec_q,
             pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
                          memory_space=pltpu.VMEM),
             off_spec,
             seed_spec,
+            c_spec,
         ],
         out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
                                memory_space=pltpu.VMEM),
@@ -1052,11 +1195,12 @@ def _bwd_call(
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta, offset, seed)
+    )(q, k, v, do_s, lse, delta, offset, seed, c_arr)
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, block_q=block_q, dropout_rate=dropout_rate
+            _bwd_dkv_kernel, block_q=block_q, dropout_rate=dropout_rate,
+            factored=factored,
         ),
         grid=(BH, nk),
         in_specs=[
@@ -1066,14 +1210,14 @@ def _bwd_call(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, dv_width), lambda b, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, T, dv_width), lambda b, j: (b, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
+            do_spec_kv,
             pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             off_spec,
             seed_spec,
+            c_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, S, block_k, d), lambda b, j: (b, 0, j, 0),
@@ -1089,7 +1233,7 @@ def _bwd_call(
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta, offset, seed)
+    )(q, k, v, do_s, lse, delta, offset, seed, c_arr)
     return dq, dk, dv
 
 
@@ -1129,19 +1273,25 @@ def _flash_bwd(blocks, interpret, rate, res, g):
     q, k, v, coeffs, seed, o_all, lse = res
     g32 = g.astype(jnp.float32)
     o32 = o_all.astype(jnp.float32)
-    # d(coeff)[bh, s] = <g, O_s>
-    dcoeffs = jnp.einsum("btd,bstd->bs", g32, o32)
-    # per-stream upstream grad with the combine coefficient folded in
-    do_s = (coeffs[:, :, None, None] * g32[:, None, :, :]).astype(q.dtype)
-    # flash backward rowsum: delta_s = rowsum(dO_s * O_s). Valid with
-    # dropout too: rowsum(dP~ . P) = rowsum((mask/keep . dA) . P)
-    # = rowsum(dA . P~) = rowsum(dO . O) since elementwise products
-    # commute — so the same residuals serve both regimes.
-    delta = jnp.einsum("bstd,bstd->bst", do_s.astype(jnp.float32), o32)
+    c32 = coeffs.astype(jnp.float32)
+    # one contraction feeds both residual quantities:
+    #   base[bh, s, t] = <g_t, O_s,t> over the head dim
+    #   dcoeffs[bh, s] = <g, O_s>           = base.sum(t)
+    #   delta_s        = rowsum(dO_s * O_s) = c_s * base  (dO_s = c_s g)
+    # delta stays valid with dropout: rowsum(dP~ . P) = rowsum(dA . P~)
+    # = rowsum(dO . O) since elementwise products commute — the same
+    # residuals serve both regimes.
+    base = jnp.einsum("btd,bstd->bst", g32, o32)
+    dcoeffs = base.sum(-1)
+    delta = base * c32[:, :, None]
+    # FACTORED backward: the kernels take the shared g once and scale by
+    # c_s in-SMEM — S-fold less dO traffic and one dP/dV matmul each
+    # (the (BH, S, T, dv) do_s materialization this replaced was also
+    # pure HBM waste)
     dq, dk, dv = _bwd_call(
-        q, k, v, do_s, lse, delta,
+        q, k, v, g.astype(q.dtype), lse, delta,
         block_q=blocks[2], block_k=blocks[3], interpret=interpret,
-        dropout_seed=seed, dropout_rate=rate,
+        dropout_seed=seed, dropout_rate=rate, coeffs=c32,
     )
     return dq, dk, dv, dcoeffs.astype(coeffs.dtype), jnp.zeros_like(seed)
 
