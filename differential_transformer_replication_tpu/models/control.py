@@ -80,8 +80,9 @@ def _attn(
     v = jnp.einsum("bte,ehd->bthd", x, p["wv"].astype(x.dtype))
     q = apply_rope(q, cos, sin)  # control.py:47-48
     k = apply_rope(k, cos, sin)
+    coeffs = vanilla_coeffs(q.shape[2])
     out = common.dispatch_attention(
-        q[None], k[None], v, vanilla_coeffs(q.shape[2]),
+        q[None], k[None], v, coeffs,
         # the dense XLA reference op (control.py:52-62)
         lambda: vanilla_attention(
             q, k, v, mask=mask, dropout_rate=dropout_rate, rng=r_att
@@ -89,8 +90,7 @@ def _attn(
         impl=impl, mesh=mesh, dropout_rate=dropout_rate, rng=r_att,
         # kernel-native-layout fast path (RoPE applied in the bh layout)
         flash_fn=common.flash_bh_fn(
-            x, p["wq"][None], p["wk"][None], p["wv"],
-            vanilla_coeffs(q.shape[2]),
+            x, p["wq"][None], p["wk"][None], p["wv"], coeffs,
             dropout_rate=dropout_rate, rng=r_att, cos=cos, sin=sin,
         ),
     )

@@ -97,8 +97,9 @@ def _attn(
         lambda_init_schedule(layer_idx),
     )  # (H,) fp32
 
+    coeffs = diff_coeffs(lam)
     out = common.dispatch_attention(
-        qs, ks, v, diff_coeffs(lam),
+        qs, ks, v, coeffs,
         # the dense XLA reference op (att1 - lam*att2, diff_transformer.py:70)
         lambda: diff_attention(
             qs[0], ks[0], qs[1], ks[1], v, lam,
@@ -108,7 +109,7 @@ def _attn(
         # kernel-native-layout fast path (the stacked projections above
         # are dead code on that branch and DCE'd)
         flash_fn=common.flash_bh_fn(
-            x, p["wq"], p["wk"], p["wv"], diff_coeffs(lam),
+            x, p["wq"], p["wk"], p["wv"], coeffs,
             dropout_rate=dropout_rate, rng=r_att,
         ),
     )

@@ -99,8 +99,9 @@ def _attn(
     qs = apply_rope(qs, cos, sin)
     ks = apply_rope(ks, cos, sin)
     lams = ndiff_lambdas(p["lambda_q"], p["lambda_k"], lambda_init_schedule(layer_idx))
+    coeffs = ndiff_coeffs(lams, ndiff_signs(n))
     out = common.dispatch_attention(
-        qs, ks, v, ndiff_coeffs(lams, ndiff_signs(n)),
+        qs, ks, v, coeffs,
         # the dense XLA reference op (Ndiff_transformer.py:95-126)
         lambda: ndiff_attention(
             qs, ks, v, lams, ndiff_signs(n),
@@ -109,7 +110,7 @@ def _attn(
         impl=impl, mesh=mesh, dropout_rate=dropout_rate, rng=r_att,
         # kernel-native-layout fast path (RoPE applied in the bh layout)
         flash_fn=common.flash_bh_fn(
-            x, p["wq"], p["wk"], p["wv"], ndiff_coeffs(lams, ndiff_signs(n)),
+            x, p["wq"], p["wk"], p["wv"], coeffs,
             dropout_rate=dropout_rate, rng=r_att, cos=cos, sin=sin,
         ),
     )

@@ -83,10 +83,14 @@ def main() -> None:
     idx = jnp.asarray([ids] * args.n, jnp.int32)
 
     rng = jax.random.PRNGKey(args.seed)
-    if len(ids) + args.max_new_tokens <= model_cfg.block_size:
+    in_window = len(ids) + args.max_new_tokens <= model_cfg.block_size
+    if in_window or model_cfg.model != "diff":
+        # the ring cache keeps O(T)/token past block_size for the RoPE
+        # families (models/decode.py); only diff's learned absolute
+        # position table forces the O(T^2) windowed recompute out there
         out = generate_cached(params, idx, model_cfg, args.max_new_tokens, rng,
                               temperature=args.temperature, top_k=args.top_k)
-    else:  # sliding-window behavior past the context limit
+    else:
         out = generate(params, idx, model_cfg, args.max_new_tokens, rng,
                        temperature=args.temperature, top_k=args.top_k)
 

@@ -52,10 +52,10 @@ def _site_packages() -> str:
 SITE = _site_packages()
 
 def _discover_packages() -> tuple:
-    """Every importable top-level package directory in site-packages —
-    the docstring harvest covers the whole installed ecosystem, not a
-    hand-picked list (the big scientific libraries dominate by volume
-    either way)."""
+    """Every REGULAR top-level package directory in site-packages (has an
+    __init__.py) — namespace packages and single-file modules are
+    skipped, which is fine for a corpus: the big scientific libraries
+    that dominate by volume are all regular packages."""
     pkgs = []
     for name in sorted(os.listdir(SITE)):
         d = os.path.join(SITE, name)
@@ -203,7 +203,10 @@ def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", default="image_corpus.txt")
     p.add_argument("--max-mb", type=float, default=64.0,
-                   help="stop harvesting docstrings past this output size")
+                   help="cap the output size; applied AFTER the shuffle, so "
+                        "the cap drops a uniformly random subset of documents "
+                        "across all source classes (the per-class stats below "
+                        "are counted at harvest time, before any cap)")
     p.add_argument("--shuffle-seed", type=int, default=1337,
                    help="document shuffle seed (<0 disables). Harvest order "
                         "clusters by package, so an UNshuffled stream makes "
@@ -231,8 +234,12 @@ def main() -> None:
                 break
             keep.append(d)
             acc += len(d)
+        dropped = len(corpus.docs) - len(keep)
         corpus.docs = keep
         total = acc
+        print(f"[image_corpus] --max-mb cap dropped {dropped} randomly "
+              f"selected documents (per-class stats are pre-cap)",
+              file=sys.stderr)
 
     with open(args.out, "w", encoding="utf-8") as f:
         for doc in corpus.docs:
