@@ -150,12 +150,23 @@ def tail_and_loss(x, params: dict, cfg, targets):
     targets given, routes through the fused chunked loss (ops/losses.py)
     and returns ``(None, loss)`` — logits are never materialized by
     design. Otherwise the reference's dense shape: ``(logits, loss|None)``
-    (control.py:147-159)."""
+    (control.py:147-159); the dense loss runs through
+    ``dense_linear_cross_entropy`` (ops/losses.py), whose hand-written
+    head backward skips XLA's fp32 transposed grad materialization, and
+    the returned logits are an independent dense head application that
+    training steps drop (DCE removes it when only the loss is consumed)."""
     if targets is not None and cfg.loss_chunk:
         return None, fused_tail_loss(x, params, targets, cfg.loss_chunk)
-    logits = apply_tail(x, params)
-    loss = None if targets is None else cross_entropy_loss(logits, targets)
-    return logits, loss
+    if targets is not None:
+        from differential_transformer_replication_tpu.ops.losses import (
+            dense_linear_cross_entropy,
+        )
+
+        x_ln = apply_layer_norm(x, params["ln_f"])
+        p = params["lm_head"]
+        loss = dense_linear_cross_entropy(x_ln, p["w"], p.get("b"), targets)
+        return linear(x_ln, p), loss
+    return apply_tail(x, params), None
 
 
 def split_rng(rng: Optional[jax.Array], n: int):

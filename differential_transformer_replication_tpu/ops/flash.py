@@ -272,6 +272,40 @@ def dropout_keep_reference(seed: jnp.ndarray, BH: int, S: int, T: int,
 # ---------------------------------------------------------------------------
 
 
+_BIAS_MAX_T = 1024  # resident kernels switch to the additive-mask fast
+# path at T <= this: the (T, T) fp32 bias tile costs VMEM stripes of
+# (block, T) per program, fine at 1024 (2 MB) but a VMEM hazard toward
+# the 4096 resident limit
+
+
+def causal_bias(T: int, off) -> jnp.ndarray:
+    """(T, T) fp32 ADDITIVE causal mask: 0 where column c is visible to
+    row r (``c <= r + off``), NEG_INF elsewhere. Built ONCE per kernel
+    call outside the grid (XLA CSEs the identical subgraph across
+    layers) and added onto the scores inside — one VPU pass per tile
+    instead of the two iotas + compare + select the in-kernel mask
+    generation costs per PROGRAM (measured ~2-3 ms/step at the recipe
+    scale across the three resident kernels). Adding the finite
+    NEG_INF sentinel reproduces the select exactly: a finite score
+    plus -1e30 rounds to -1e30 in fp32."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    off_i = jnp.asarray(off, jnp.int32).reshape(())
+    return jnp.where(cols <= rows + off_i, 0.0, NEG_INF).astype(jnp.float32)
+
+
+def _scores_plus_bias(q_blk, k_blk, bias_blk, scale):
+    """Score block with the precomputed additive causal mask — the
+    bias-mode twin of :func:`_masked_scores` (same MXU contraction,
+    dtype rules, and masking semantics; see :func:`causal_bias`)."""
+    s = jax.lax.dot_general(
+        q_blk, k_blk,
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    return s + bias_blk[None]
+
+
 def _masked_scores(q_blk, k_blk, q_start, k_start, off, scale):
     """The score/mask block every kernel shares: ``(S, bq, bk)`` fp32
     scores ``Q K^T * scale`` with offset-causal masking (column c visible
@@ -313,10 +347,18 @@ def _fwd_kernel(
     save_residuals: bool,
     emit_combined: bool = True,
     dropout_rate: float = 0.0,
+    use_bias: bool = False,
 ):
     """One online-softmax body for all three forward modes: the combined
     primal (coeff-weighted sum of streams), the residual-saving VJP
-    forward, and the per-stream ring chunk (no combine; offset-causal)."""
+    forward, and the per-stream ring chunk (no combine; offset-causal).
+    ``use_bias`` swaps the in-kernel iota mask for the precomputed
+    additive bias stripe (:func:`causal_bias`), delivered as an extra
+    (block_q, T) input right before the outputs in ``refs``."""
+    if use_bias:
+        bias_ref, *refs = refs
+    else:
+        bias_ref = None
     if emit_combined:
         c_ref, *outs = refs
     else:
@@ -341,7 +383,12 @@ def _fwd_kernel(
             m, l, acc = carry
             k_j = k_ref[0, :, pl.ds(j * block_k, block_k), :]
             v_j = v_ref[0, pl.ds(j * block_k, block_k), :]
-            s, _ = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
+            if use_bias:
+                s = _scores_plus_bias(
+                    q, k_j, bias_ref[:, pl.ds(j * block_k, block_k)], scale
+                )
+            else:
+                s, _ = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (S, block_q)
             alpha = jnp.exp(m - m_new)
             p = jnp.exp(s - m_new[:, :, None])
@@ -427,9 +474,10 @@ def _fwd_call(
         if save_residuals:
             return results
         return results[0], None, None
+    use_bias = T <= _BIAS_MAX_T
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, save_residuals=save_residuals,
-        emit_combined=True, dropout_rate=dropout_rate,
+        emit_combined=True, dropout_rate=dropout_rate, use_bias=use_bias,
     )
     out_shapes = [jax.ShapeDtypeStruct((BH, T, dv), q.dtype)]
     out_specs = [
@@ -453,31 +501,42 @@ def _fwd_call(
                 (1, S, block_q), lambda b, i: (b, 0, i), memory_space=pltpu.VMEM
             ),
         ]
+    in_specs = [
+        pl.BlockSpec(
+            (1, S, block_q, d), lambda b, i: (b, 0, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (1, S, T, d), lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+    ]
+    inputs = [q, k, v, jnp.zeros((1, 1), jnp.float32), seed]
+    if use_bias:
+        in_specs.append(
+            pl.BlockSpec((block_q, T), lambda b, i: (i, 0),
+                         memory_space=pltpu.VMEM)
+        )
+        inputs.append(causal_bias(T, 0))
+    # the whole (BH, S) scalar coefficient table rides in SMEM; a
+    # per-bh block would violate Mosaic's (8, 128) tiling check
+    in_specs.append(
+        pl.BlockSpec((BH, S), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
+    )
+    inputs.append(coeffs)
     results = pl.pallas_call(
         kernel,
         grid=(BH, nq),
-        in_specs=[
-            pl.BlockSpec(
-                (1, S, block_q, d), lambda b, i: (b, 0, i, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, S, T, d), lambda b, i: (b, 0, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
-            # the whole (BH, S) scalar coefficient table rides in SMEM; a
-            # per-bh block would violate Mosaic's (8, 128) tiling check
-            pl.BlockSpec((BH, S), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shapes,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
-    )(q, k, v, jnp.zeros((1, 1), jnp.float32), seed, coeffs)
+    )(*inputs)
     if save_residuals:
         return results
     return results[0], None, None
@@ -949,12 +1008,16 @@ def _bwd_dq_kernel(
     #           +-kTl for ring chunks whose K lives k shards away)
     seed_ref,  # (1, 2) float32 SMEM dropout seed
     c_ref,  # (BH, S) float32 SMEM combine coeffs (read only when factored)
-    dq_ref,  # (1, S, block_q, d)
-    *,
+    *refs,  # [bias_ref (block_q, T) if use_bias] then dq_ref (1, S, block_q, d)
     block_k: int,
     dropout_rate: float = 0.0,
     factored: bool = False,
+    use_bias: bool = False,
 ):
+    if use_bias:
+        bias_ref, dq_ref = refs
+    else:
+        bias_ref, (dq_ref,) = None, refs
     S, block_q, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     T = k_ref.shape[2]
     nk = T // block_k
@@ -973,8 +1036,22 @@ def _bwd_dq_kernel(
         def compute(dq):
             k_j = k_ref[0, :, pl.ds(j * block_k, block_k), :]
             v_j = v_ref[0, pl.ds(j * block_k, block_k), :]
-            s, keep = _masked_scores(q, k_j, q_start, j * block_k, off, scale)
-            p = jnp.where(keep, jnp.exp(s - lse[:, :, None]), 0.0)
+            if use_bias:
+                # masked entries carry s = NEG_INF, so exp(s - lse) is 0
+                # without a select (lse is finite on every row that has
+                # any visible key; fully-masked ring rows get p = 1 with
+                # an lse that zeroes their chunk weight AND cotangents
+                # exactly, so ds/dv contributions stay 0 — same as the
+                # select path)
+                s = _scores_plus_bias(
+                    q, k_j, bias_ref[:, pl.ds(j * block_k, block_k)], scale
+                )
+                p = jnp.exp(s - lse[:, :, None])
+            else:
+                s, keep = _masked_scores(
+                    q, k_j, q_start, j * block_k, off, scale
+                )
+                p = jnp.where(keep, jnp.exp(s - lse[:, :, None]), 0.0)
             if factored:
                 dp = _scale_streams(
                     jax.lax.dot_general(
@@ -1023,13 +1100,17 @@ def _bwd_dkv_kernel(
     off_ref,  # (1, 1) float32 SMEM causal row offset (see _bwd_dq_kernel)
     seed_ref,  # (1, 2) float32 SMEM dropout seed
     c_ref,  # (BH, S) float32 SMEM combine coeffs (read only when factored)
-    dk_ref,  # (1, S, block_k, d)
-    dv_ref,  # (1, block_k, dv)
-    *,
+    *refs,  # [bias_ref (T, block_k) if use_bias] then outputs
+    #         dk_ref (1, S, block_k, d), dv_ref (1, block_k, dv)
     block_q: int,
     dropout_rate: float = 0.0,
     factored: bool = False,
+    use_bias: bool = False,
 ):
+    if use_bias:
+        bias_ref, dk_ref, dv_ref = refs
+    else:
+        bias_ref, (dk_ref, dv_ref) = None, refs
     S, block_k, d = k_ref.shape[1], k_ref.shape[2], k_ref.shape[3]
     T = q_ref.shape[2]
     dv_width = v_ref.shape[2]
@@ -1050,8 +1131,17 @@ def _bwd_dkv_kernel(
             q_i = q_ref[0, :, pl.ds(i * block_q, block_q), :]
             lse_i = lse_ref[0, :, pl.ds(i * block_q, block_q)]
             delta_i = delta_ref[0, :, pl.ds(i * block_q, block_q)]
-            s, keep = _masked_scores(q_i, k, i * block_q, k_start, off, scale)
-            p = jnp.where(keep, jnp.exp(s - lse_i[:, :, None]), 0.0)
+            if use_bias:
+                # no select: see the twin comment in _bwd_dq_kernel
+                s = _scores_plus_bias(
+                    q_i, k, bias_ref[pl.ds(i * block_q, block_q), :], scale
+                )
+                p = jnp.exp(s - lse_i[:, :, None])
+            else:
+                s, keep = _masked_scores(
+                    q_i, k, i * block_q, k_start, off, scale
+                )
+                p = jnp.where(keep, jnp.exp(s - lse_i[:, :, None]), 0.0)
             p_v = p
             dkeep = None
             if dropout_rate > 0.0:
@@ -1117,6 +1207,145 @@ def _bwd_dkv_kernel(
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+# Whole-T fused backward: the (S, T, T) fp32 score/prob/grad
+# intermediates must fit VMEM simultaneously (~8 MB at S=2, T=512) — the
+# budget scales with the STREAM COUNT, so ndiff's n_terms=4 only takes
+# this path at shorter T; past the budget the two-kernel form streams
+# blocks instead.
+_FUSED_BWD_BUDGET = 2 * 512 * 512  # max S * T * T
+
+
+def _use_fused_bwd(S: int, T: int) -> bool:
+    return S * T * T <= _FUSED_BWD_BUDGET
+
+
+def _bwd_fused_kernel(
+    q_ref,  # (1, S, T, d)
+    k_ref,  # (1, S, T, d)
+    v_ref,  # (1, T, dv)
+    g_ref,  # (1, T, dv) shared upstream grad (factored form only)
+    lse_ref,  # (1, S, T)
+    delta_ref,  # (1, S, T)
+    seed_ref,  # (1, 2) float32 SMEM dropout seed
+    c_ref,  # (BH, S) float32 SMEM combine coeffs
+    bias_ref,  # (T, T) additive causal mask (aligned: off = 0)
+    dq_ref,  # (1, S, T, d)
+    dk_ref,  # (1, S, T, d)
+    dv_ref,  # (1, T, dv)
+    *,
+    dropout_rate: float = 0.0,
+):
+    """dQ, dK, dV in ONE program per (b*H): within _FUSED_BWD_BUDGET the
+    full score matrix fits VMEM, so the softmax recompute (the QK^T
+    matmul, the exp — the kernels' VPU floor — and the dP matmul) runs
+    ONCE instead of once in each of the dq and dkv kernels, and q/k/v/g
+    are read once. Straight-line code, no grid loops: the whole
+    backward for one head is a single fused region."""
+    S, T, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    bh_id = pl.program_id(0)
+    q = q_ref[0]  # (S, T, d)
+    k = k_ref[0]
+    v = v_ref[0]  # (T, dv)
+    g = g_ref[0]  # (T, dv)
+    lse = lse_ref[0]  # (S, T) f32
+    delta = delta_ref[0]  # (S, T) f32
+    scale = 1.0 / math.sqrt(d)
+
+    s = _scores_plus_bias(q, k, bias_ref[:, :], scale)  # (S, T, T) f32
+    p = jnp.exp(s - lse[:, :, None])  # masked entries -> exp(-1e30) = 0
+    p_v = p
+    dkeep = None
+    if dropout_rate > 0.0:
+        dkeep = _keep_mask_block(
+            seed_ref, bh_id, S, 0, 0, T, T, dropout_rate, None
+        )
+        p_v = _apply_keep(p, dkeep, dropout_rate)  # dropped map P~
+    # dV = (sum_s c_s P~_s)^T g — one matmul after the VPU stream combine
+    p_c = _combine_streams(p_v, c_ref, bh_id, S).astype(g.dtype)
+    dv_ref[0] = jax.lax.dot_general(
+        p_c, g,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dv_ref.dtype)
+    # dP_s = c_s * (g V^T), computed once and scaled per stream
+    dp = _scale_streams(
+        jax.lax.dot_general(
+            g, v,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ),
+        c_ref, bh_id, S,
+    )
+    if dropout_rate > 0.0:
+        dp = _apply_keep(dp, dkeep, dropout_rate)
+    ds = (p * (dp - delta[:, :, None])).astype(q.dtype)
+    dq_ref[0] = (
+        jax.lax.dot_general(
+            ds, k,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+    ).astype(dq_ref.dtype)
+    dk_ref[0] = (
+        jax.lax.dot_general(
+            ds, q,
+            dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+    ).astype(dk_ref.dtype)
+
+
+def _fused_bwd_call(
+    q, k, v, g, lse, delta, *, interpret,
+    dropout_seed=None, dropout_rate: float = 0.0, coeffs=None,
+):
+    BH, S, T, d = q.shape
+    dv_width = v.shape[-1]
+    seed = (
+        dropout_seed
+        if dropout_seed is not None
+        else jnp.zeros((1, 2), jnp.float32)
+    )
+    def spec4(shape):
+        return pl.BlockSpec(shape, lambda b: (b, 0, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def spec3(shape):
+        return pl.BlockSpec(shape, lambda b: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    return pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, dropout_rate=dropout_rate),
+        grid=(BH,),
+        in_specs=[
+            spec4((1, S, T, d)),
+            spec4((1, S, T, d)),
+            spec3((1, T, dv_width)),
+            spec3((1, T, dv_width)),
+            spec3((1, S, T)),
+            spec3((1, S, T)),
+            pl.BlockSpec((1, 2), lambda b: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((BH, S), lambda b: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((T, T), lambda b: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            spec4((1, S, T, d)),
+            spec4((1, S, T, d)),
+            spec3((1, T, dv_width)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, dv_width), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        ),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta, seed, coeffs.astype(jnp.float32),
+      causal_bias(T, 0))
+
+
 def _bwd_call(
     q, k, v, do_s, lse, delta, offset=None, *,
     block_q: int, block_k: int, interpret: bool,
@@ -1132,6 +1361,7 @@ def _bwd_call(
     dv_width = v.shape[-1]
     nq, nk = T // block_q, T // block_k
     factored = coeffs is not None
+    aligned = offset is None  # the main (non-ring) path: causal off = 0
     if offset is None:
         offset = jnp.zeros((1, 1), jnp.float32)
     seed = (
@@ -1139,6 +1369,13 @@ def _bwd_call(
         if dropout_seed is not None
         else jnp.zeros((1, 2), jnp.float32)
     )
+    if aligned and factored and _use_fused_bwd(S, T):
+        # whole-T single-program backward: one softmax recompute serves
+        # dq, dk AND dv (see _bwd_fused_kernel)
+        return _fused_bwd_call(
+            q, k, v, do_s, lse, delta, interpret=interpret,
+            dropout_seed=seed, dropout_rate=dropout_rate, coeffs=coeffs,
+        )
     if T > _KV_TILE_THRESHOLD:
         return _tiled_bwd_call(
             q, k, v, do_s, lse, delta, offset,
@@ -1150,6 +1387,8 @@ def _bwd_call(
         if factored
         else jnp.zeros((BH, S), jnp.float32)
     )
+    use_bias = T <= _BIAS_MAX_T
+    bias = causal_bias(T, offset[0, 0].astype(jnp.int32)) if use_bias else None
     off_spec = pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
     seed_spec = pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
     c_spec = pl.BlockSpec((BH, S), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
@@ -1166,28 +1405,36 @@ def _bwd_call(
         do_spec_kv = pl.BlockSpec((1, S, T, dv_width), lambda b, j: (b, 0, 0, 0),
                                   memory_space=pltpu.VMEM)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, S, T, d), lambda b, i: (b, 0, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, T, dv_width), lambda b, i: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        do_spec_q,
+        pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+        off_spec,
+        seed_spec,
+        c_spec,
+    ]
+    dq_inputs = [q, k, v, do_s, lse, delta, offset, seed, c_arr]
+    if use_bias:
+        dq_in_specs.append(
+            pl.BlockSpec((block_q, T), lambda b, i: (i, 0),
+                         memory_space=pltpu.VMEM)
+        )
+        dq_inputs.append(bias)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_k=block_k, dropout_rate=dropout_rate,
-            factored=factored,
+            factored=factored, use_bias=use_bias,
         ),
         grid=(BH, nq),
-        in_specs=[
-            pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, T, d), lambda b, i: (b, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, dv_width), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            do_spec_q,
-            pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, block_q), lambda b, i: (b, 0, i),
-                         memory_space=pltpu.VMEM),
-            off_spec,
-            seed_spec,
-            c_spec,
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
@@ -1195,30 +1442,38 @@ def _bwd_call(
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta, offset, seed, c_arr)
+    )(*dq_inputs)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, S, T, d), lambda b, j: (b, 0, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, S, block_k, d), lambda b, j: (b, 0, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, dv_width), lambda b, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        do_spec_kv,
+        pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        off_spec,
+        seed_spec,
+        c_spec,
+    ]
+    dkv_inputs = [q, k, v, do_s, lse, delta, offset, seed, c_arr]
+    if use_bias:
+        dkv_in_specs.append(
+            pl.BlockSpec((T, block_k), lambda b, j: (0, j),
+                         memory_space=pltpu.VMEM)
+        )
+        dkv_inputs.append(bias)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, dropout_rate=dropout_rate,
-            factored=factored,
+            factored=factored, use_bias=use_bias,
         ),
         grid=(BH, nk),
-        in_specs=[
-            pl.BlockSpec((1, S, T, d), lambda b, j: (b, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, block_k, d), lambda b, j: (b, 0, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, dv_width), lambda b, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            do_spec_kv,
-            pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, T), lambda b, j: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            off_spec,
-            seed_spec,
-            c_spec,
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, S, block_k, d), lambda b, j: (b, 0, j, 0),
                          memory_space=pltpu.VMEM),
@@ -1233,7 +1488,7 @@ def _bwd_call(
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
-    )(q, k, v, do_s, lse, delta, offset, seed, c_arr)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
@@ -1327,22 +1582,34 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret,
             save_residuals=True, emit_combined=False, interpret=interpret,
             dropout_seed=seed, dropout_rate=dropout_rate,
         )
+    use_bias = T <= _BIAS_MAX_T
+    in_specs = [
+        pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, S, T, d), lambda b, i: (b, 0, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+    ]
+    inputs = [q, k, v, offset, seed]
+    if use_bias:
+        # the bias bakes the TRACED ring offset in — computed once per
+        # chunk call instead of per (b*H) program
+        in_specs.append(
+            pl.BlockSpec((block_q, T), lambda b, i: (i, 0),
+                         memory_space=pltpu.VMEM)
+        )
+        inputs.append(causal_bias(T, offset[0, 0].astype(jnp.int32)))
     return pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_k=block_k, save_residuals=True,
             emit_combined=False, dropout_rate=dropout_rate,
+            use_bias=use_bias,
         ),
         grid=(BH, nq),
-        in_specs=[
-            pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, T, d), lambda b, i: (b, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, dv), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, S, block_q, dv), lambda b, i: (b, 0, i, 0),
                          memory_space=pltpu.VMEM),
@@ -1357,7 +1624,7 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret,
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
-    )(q, k, v, offset, seed)
+    )(*inputs)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))

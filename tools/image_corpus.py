@@ -237,9 +237,15 @@ def main() -> None:
         dropped = len(corpus.docs) - len(keep)
         corpus.docs = keep
         total = acc
-        print(f"[image_corpus] --max-mb cap dropped {dropped} randomly "
-              f"selected documents (per-class stats are pre-cap)",
-              file=sys.stderr)
+        how = (
+            "randomly selected documents"
+            if args.shuffle_seed >= 0
+            else "documents from the TAIL of the package-clustered harvest "
+                 "order (shuffle disabled — the cap is then systematically "
+                 "biased against later packages)"
+        )
+        print(f"[image_corpus] --max-mb cap dropped {dropped} {how} "
+              f"(per-class stats are pre-cap)", file=sys.stderr)
 
     with open(args.out, "w", encoding="utf-8") as f:
         for doc in corpus.docs:
