@@ -52,7 +52,7 @@ def main() -> None:
         ModelConfig,
         TrainConfig,
     )
-    from differential_transformer_replication_tpu.train.step import (
+    from differential_transformer_replication_tpu.train import (
         create_train_state,
         make_multi_train_step,
     )

@@ -6,6 +6,7 @@ from differential_transformer_replication_tpu.train.step import (
     create_train_state,
     make_eval_many,
     make_eval_step,
+    make_multi_train_step,
     make_train_step,
 )
 from differential_transformer_replication_tpu.train.checkpoint import (
@@ -27,6 +28,7 @@ __all__ = [
     "create_train_state",
     "make_eval_many",
     "make_eval_step",
+    "make_multi_train_step",
     "make_train_step",
     "save_checkpoint",
     "load_checkpoint",

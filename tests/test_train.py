@@ -13,6 +13,7 @@ from differential_transformer_replication_tpu.train import (
     cosine_warmup_schedule,
     create_train_state,
     make_eval_step,
+    make_multi_train_step,
     make_train_step,
 )
 
@@ -99,6 +100,45 @@ class TestTrainStep:
         leaves2 = jax.tree_util.tree_leaves(s2["params"])
         for a, b in zip(leaves1, leaves2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+    def test_multi_step_scan_matches_sequential_steps(self):
+        """make_multi_train_step (K optimizer steps per launch) must be
+        numerically identical to K sequential make_train_step calls on
+        the same batch/rng sequence — it only changes the LAUNCH
+        structure, never the math."""
+        K = 4
+        cfg = tiny_train_cfg("diff")
+        key = jax.random.PRNGKey(0)
+        xs = jax.random.randint(
+            jax.random.PRNGKey(1),
+            (K, 1, 4, cfg.model.block_size), 0, cfg.vocab_size,
+        )
+        ys = jnp.roll(xs, -1, axis=-1)
+
+        s1 = create_train_state(key, cfg)
+        step = make_train_step(cfg)
+        losses = []
+        for k in range(K):
+            s1, m = step(s1, {"x": xs[k], "y": ys[k]}, None)
+            losses.append(float(m["loss"]))
+
+        s2 = create_train_state(key, cfg)
+        multi = make_multi_train_step(cfg, K)
+        s2, mm = multi(s2, {"x": xs, "y": ys}, None)
+        np.testing.assert_allclose(
+            np.asarray(mm["loss"]), np.asarray(losses), rtol=1e-6, atol=1e-7
+        )
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            ),
+            s1["params"], s2["params"],
+        )
+        # the contract is fail-loud on a K mismatch
+        with pytest.raises(AssertionError):
+            make_multi_train_step(cfg, K + 1)(
+                create_train_state(key, cfg), {"x": xs, "y": ys}, None
+            )
 
     def test_first_step_lr_zero_keeps_params(self):
         """Step 0 runs at lr=0 (torch scheduler quirk): params must be
