@@ -1771,7 +1771,10 @@ def multi_stream_flash_attention_bh(
         # the RESIDENT backward kernels hold full-T q/do plus the K/V
         # block: with the 1024-wide train K tile their fp32 p/dp/ds
         # blocks exceed v5e's 16M scoped VMEM from T=2048 (measured
-        # under the full model; the bare-op sweep happens to fit). The
+        # under the full model; the bare-op sweep happens to fit;
+        # re-verified round 3 AFTER the factored backward halved the dO
+        # traffic — the wide tile still fails to compile at T=2048, so
+        # the clamp is not stale). The
         # KV-tiled kernels past _KV_TILE_THRESHOLD hold only O(block)
         # state, so they keep the wide tile.
         bkt = min(bkt, 512)
