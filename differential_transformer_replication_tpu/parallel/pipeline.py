@@ -43,9 +43,20 @@ playbook):
 Composition: the ``data`` (and ``fsdp``, treated as a second data axis)
 mesh dims shard the microbatch batch dim — grads are averaged across
 them inside the loss (``pmean``), so one shard_mapped function delivers
-PP x DP. ``tensor``/``sequence`` must be 1 when pipeline > 1 (their
-sharding lives in the GSPMD path, parallel/dp_step.py; composing them
-with manual pipelining is out of scope and raises loudly).
+PP x DP. The ``tensor`` axis composes too, via shard_map's manual/auto
+split: the schedule is MANUAL over ``data``/``fsdp``/``pipeline`` only
+(``axis_names``), leaving ``tensor`` an AUTO axis that GSPMD partitions
+inside each stage with the Megatron specs from parallel/sharding.py
+(Q/K/V head-column, out-proj/down-proj row + psum, vocab-sharded
+embedding and lm-head loss). One caveat, documented not hidden: a
+``pallas_call`` cannot be GSPMD-partitioned, so under pipeline x tensor
+the fused attention kernel's operands are gathered per tensor shard and
+the kernel runs replicated over ``tensor`` — the MXU-heavy projections,
+FFN, and lm-head still shard. Use ``attention_impl='xla'`` when tensor
+sharding of the attention math itself matters under pipeline.
+``sequence`` must still be 1 when pipeline > 1 (ring attention holds its
+own manual shard_map over ``sequence``; nesting it inside the schedule
+is out of scope and raises loudly).
 
 Restrictions (checked): ``n_layer % P == 0`` and — at train-step
 construction — ``micro_batch_size`` divisible by data*fsdp. Dropout is
@@ -63,6 +74,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from differential_transformer_replication_tpu.config import ModelConfig, TrainConfig
 from differential_transformer_replication_tpu.models import common, model_module
 from differential_transformer_replication_tpu.ops import causal_mask, rope_cos_sin
+from differential_transformer_replication_tpu.parallel.sharding import spec_for
 from differential_transformer_replication_tpu.train.optim import make_optimizer
 from differential_transformer_replication_tpu.train.step import create_train_state
 
@@ -95,14 +107,33 @@ def _path_names(path) -> list:
     return [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
 
 
+class _Rank:
+    """Stand-in leaf for sharding.spec_for with the stacked leading
+    layer axis stripped off."""
+
+    def __init__(self, ndim: int):
+        self.ndim = ndim
+
+
+def _drop_fsdp(spec: P) -> tuple:
+    """Under pipeline the fsdp mesh dim is a second DATA axis (params
+    replicate over it, see the warning in _check_pipeline_cfg), so strip
+    it from the GSPMD base spec."""
+    return tuple(None if s == "fsdp" else s for s in spec)
+
+
 def _pipe_spec(path, leaf) -> P:
     """Stacked block leaves shard their leading (layer) axis over
-    ``pipeline``; everything else — embed/head params, optimizer scalars —
-    replicates. Optimizer moments mirror the param tree so their paths
-    also contain ``blocks`` and inherit the stage sharding."""
-    if "blocks" in _path_names(path) and getattr(leaf, "ndim", 0) >= 1:
-        return P(_PIPE_AXIS)
-    return P()
+    ``pipeline`` and their remaining dims with the Megatron ``tensor``
+    rules (parallel/sharding.py, minus fsdp — see _drop_fsdp); embed/head
+    params take the same tensor rules without the layer axis; optimizer
+    scalars replicate. Optimizer moments mirror the param tree so their
+    paths also contain ``blocks`` and inherit the combined sharding."""
+    rank = getattr(leaf, "ndim", 0)
+    if "blocks" in _path_names(path) and rank >= 1:
+        base = _drop_fsdp(spec_for(path, _Rank(rank - 1)))
+        return P(_PIPE_AXIS, *base)
+    return P(*_drop_fsdp(spec_for(path, leaf)))
 
 
 def pipeline_state_sharding(state, mesh: Mesh):
@@ -121,12 +152,23 @@ def _check_pipeline_cfg(model_cfg: ModelConfig, mesh: Mesh) -> int:
     n_stages = mesh.shape.get(_PIPE_AXIS, 1)
     if n_stages < 2:
         raise ValueError(f"pipeline axis must be > 1, got mesh {dict(mesh.shape)}")
-    for ax in ("tensor", "sequence"):
-        if mesh.shape.get(ax, 1) != 1:
-            raise NotImplementedError(
-                f"pipeline parallelism composes with data/fsdp only; mesh has "
-                f"{ax}={mesh.shape[ax]} (use the GSPMD path, parallel/dp_step.py)"
-            )
+    if mesh.shape.get("sequence", 1) != 1:
+        raise NotImplementedError(
+            f"pipeline parallelism composes with data/fsdp/tensor; mesh has "
+            f"sequence={mesh.shape['sequence']} (ring attention holds its own "
+            f"manual shard_map — use the GSPMD path, parallel/dp_step.py)"
+        )
+    if mesh.shape.get("tensor", 1) != 1 and model_cfg.attention_impl == "pallas":
+        import warnings
+
+        warnings.warn(
+            "pipeline x tensor with attention_impl='pallas': GSPMD cannot "
+            "partition the fused attention kernel, so its operands are "
+            "gathered and the kernel runs REPLICATED over the tensor axis "
+            "(projections/FFN/lm-head still shard). Use attention_impl="
+            "'xla' if tensor-sharded attention matters here",
+            stacklevel=3,
+        )
     if mesh.shape.get("fsdp", 1) != 1:
         import warnings
 
@@ -247,22 +289,34 @@ def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
         loss = jax.lax.psum(loss_loc, _PIPE_AXIS)  # broadcast to all stages
         return jax.lax.pmean(loss, _DATA_AXES)
 
+    # MANUAL over the schedule axes only: ``tensor`` stays an AUTO axis,
+    # so GSPMD partitions each stage's matmuls/loss with the Megatron
+    # shardings the params carry (pipeline_state_sharding) — in_specs
+    # describe the manual axes and the tensor sharding rides along on the
+    # arguments themselves.
+    manual_axes = frozenset({*_DATA_AXES, _PIPE_AXIS})
     data_specs = (P(_PIPE_AXIS), P(), P(None, _DATA_AXES, None),
                   P(None, _DATA_AXES, None))
-    smapped_plain = jax.shard_map(
+    # jit is required, not decorative: shard_map's EAGER impl path
+    # (_unmatch_spec, jax 0.9) rejects a manual-subset axis_names; under
+    # jit the auto axes partition correctly. Nested under the train-step
+    # jit this inlines.
+    smapped_plain = jax.jit(jax.shard_map(
         lambda b, r, x, y: spmd(b, r, x, y, None),
         mesh=mesh,
         in_specs=data_specs,
         out_specs=P(),
+        axis_names=manual_axes,
         check_vma=False,
-    )
-    smapped_dropout = jax.shard_map(
+    ))
+    smapped_dropout = jax.jit(jax.shard_map(
         spmd,
         mesh=mesh,
         in_specs=data_specs + (P(),),
         out_specs=P(),
+        axis_names=manual_axes,
         check_vma=False,
-    )
+    ))
 
     def loss_fn(
         params: dict, x: jnp.ndarray, y: jnp.ndarray, rng=None

@@ -34,7 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from differential_transformer_replication_tpu.config import ModelConfig
 
 
-def _spec_for(path: tuple, leaf: Any) -> P:
+def spec_for(path: tuple, leaf: Any) -> P:
     """PartitionSpec for one param leaf, keyed on its path in the model
     pytree. ``path`` elements are jax DictKey/SequenceKey entries."""
     names = [
@@ -76,7 +76,7 @@ def _spec_for(path: tuple, leaf: Any) -> P:
 
 def make_param_specs(params: dict) -> dict:
     """A PartitionSpec pytree mirroring ``params``."""
-    return jax.tree_util.tree_map_with_path(_spec_for, params)
+    return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
 def state_sharding(state: dict, mesh: Mesh) -> dict:
@@ -87,7 +87,7 @@ def state_sharding(state: dict, mesh: Mesh) -> dict:
     the same names (…/mu/blocks/0/attn/wq) and pick up the param's spec;
     scalars (count, step) fall through to replicated.
     """
-    specs = jax.tree_util.tree_map_with_path(_spec_for, state)
+    specs = jax.tree_util.tree_map_with_path(spec_for, state)
     return jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
     )

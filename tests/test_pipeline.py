@@ -145,12 +145,24 @@ class TestPipelineParity:
         )
 
 
+# mesh configurations test_step_matches_single_device_step runs under:
+# pp x dp, and pp x tp x dp (pipeline x tensor composition).
+# test_dropout_through_pipeline has its own list (it needs pipeline=2 so
+# 4 microbatches still cover the stages).
+_STEP_MESHES = [
+    pytest.param(MeshConfig(pipeline=4, data=2), id="pp4xdp2"),
+    pytest.param(MeshConfig(pipeline=2, tensor=2, data=2), id="pp2xtp2xdp2"),
+]
+
+
 class TestPipelineTrainStep:
-    def _cfg(self, pipeline=4, data=2, n_micro=6):
+    def _cfg(self, pipeline=4, data=2, n_micro=6, mesh=None):
         m = tiny_model("diff")
         return TrainConfig(
             model=m,
-            mesh=MeshConfig(pipeline=pipeline, data=data),
+            mesh=mesh if mesh is not None else MeshConfig(
+                pipeline=pipeline, data=data
+            ),
             vocab_size=m.vocab_size,
             micro_batch_size=4,
             grad_acc_steps=n_micro,
@@ -160,8 +172,9 @@ class TestPipelineTrainStep:
             max_iters=100,
         )
 
-    def test_step_matches_single_device_step(self):
-        cfg = self._cfg()
+    @pytest.mark.parametrize("mesh_cfg", _STEP_MESHES)
+    def test_step_matches_single_device_step(self, mesh_cfg):
+        cfg = self._cfg(mesh=mesh_cfg)
         mesh = create_mesh(cfg.mesh)
         x, y = microbatches(jax.random.PRNGKey(1), cfg.model)
         batch = {"x": x, "y": y}
@@ -281,12 +294,21 @@ class TestPipelineTrainStep:
         ):
             np.testing.assert_array_equal(np.asarray(r), np.asarray(p))
 
-    def test_dropout_through_pipeline(self):
+    @pytest.mark.parametrize(
+        "mesh_cfg",
+        [
+            pytest.param(MeshConfig(pipeline=2, data=2), id="pp2xdp2"),
+            pytest.param(
+                MeshConfig(pipeline=2, tensor=2, data=2), id="pp2xtp2xdp2"
+            ),
+        ],
+    )
+    def test_dropout_through_pipeline(self, mesh_cfg):
         """Dropout is live on the pipeline path: rng threads through the
         GPipe schedule per (shard, microbatch, layer). Deterministic per
         key, varying across keys, inert without one."""
         m = tiny_model("diff").replace(dropout=0.3)
-        mesh = create_mesh(MeshConfig(pipeline=2, data=2))
+        mesh = create_mesh(mesh_cfg)
         loss_f = make_pipeline_loss(m, mesh)
         params = stack_blocks(init_model(jax.random.PRNGKey(0), m))
         x = jax.random.randint(
@@ -315,10 +337,74 @@ class TestPipelineTrainStep:
         mesh = create_mesh(MeshConfig(pipeline=2, data=2))
         with pytest.raises(ValueError, match="not divisible"):
             make_pipeline_loss(m, mesh)
-        with pytest.raises(NotImplementedError, match="tensor"):
+        with pytest.raises(NotImplementedError, match="sequence"):
             make_pipeline_loss(
                 tiny_model("diff"),
-                create_mesh(MeshConfig(pipeline=2, tensor=2, data=2)),
+                create_mesh(MeshConfig(pipeline=2, sequence=2, data=2)),
             )
         with pytest.raises(ValueError, match="pipeline axis"):
             make_pipeline_loss(tiny_model("diff"), create_mesh(MeshConfig(data=2)))
+
+
+class TestPipelineTensorComposition:
+    """Pipeline x tensor parallelism (VERDICT r2 weak item 6): the GPipe
+    schedule is manual over data/fsdp/pipeline while ``tensor`` stays a
+    GSPMD auto axis, so each stage's matmuls/loss shard with the Megatron
+    specs (parallel/sharding.py). Parity against the single-device model
+    is the guarantee."""
+
+    def _mesh(self, **kw):
+        return create_mesh(MeshConfig(**kw))
+
+    @pytest.mark.parametrize("family", ["control", "diff", "ndiff"])
+    def test_loss_matches_single_device(self, family):
+        m = tiny_model(family)
+        mesh = self._mesh(pipeline=2, tensor=2, data=2)
+        params = init_model(jax.random.PRNGKey(0), m)
+        x, y = microbatches(jax.random.PRNGKey(1), m)
+        ref = reference_mean_loss(params, x, y, m)
+        got = make_pipeline_loss(m, mesh)(stack_blocks(params), x, y)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+    def test_grads_match_single_device(self):
+        # n_head == tensor axis: every tensor shard holds exactly one
+        # head, the evenly head-sharded production configuration
+        m = tiny_model("diff").replace(n_head=4)
+        mesh = self._mesh(pipeline=2, tensor=4)
+        params = init_model(jax.random.PRNGKey(0), m)
+        x, y = microbatches(jax.random.PRNGKey(1), m)
+        ref_grads = stack_blocks(
+            jax.grad(lambda p: reference_mean_loss(p, x, y, m))(params)
+        )
+        pipe_grads = jax.grad(make_pipeline_loss(m, mesh))(stack_blocks(params), x, y)
+        for r, p in zip(
+            jax.tree_util.tree_leaves(ref_grads),
+            jax.tree_util.tree_leaves(pipe_grads),
+        ):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r), atol=2e-5)
+
+    def test_state_is_stage_and_tensor_sharded(self):
+        m = tiny_model("diff")
+        cfg = TrainConfig(
+            model=m,
+            mesh=MeshConfig(pipeline=2, tensor=2, data=2),
+            vocab_size=m.vocab_size,
+            micro_batch_size=4,
+            grad_acc_steps=4,
+            control_head_multiplier=1,
+            max_iters=100,
+        )
+        mesh = create_mesh(cfg.mesh)
+        state = create_pipeline_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        wq = state["params"]["blocks"]["attn"]["wq"]
+        spec = tuple(wq.sharding.spec)
+        assert spec[0] == "pipeline", spec
+        assert "tensor" in spec, f"wq not tensor-sharded under pp x tp: {spec}"
+        # the head axis of the stacked (L, S, E, H, d) wq is split over tp
+        shard = wq.addressable_shards[0]
+        assert shard.data.shape[0] == m.n_layer // cfg.mesh.pipeline
+        assert shard.data.shape[-2] == m.n_head // cfg.mesh.tensor
+
+    # train-step and dropout parity under pp x tp run as the
+    # pp2xtp2xdp2 parametrization of TestPipelineTrainStep's
+    # test_step_matches_single_device_step / test_dropout_through_pipeline
