@@ -54,9 +54,13 @@ the fused attention kernel's operands are gathered per tensor shard and
 the kernel runs replicated over ``tensor`` — the MXU-heavy projections,
 FFN, and lm-head still shard. Use ``attention_impl='xla'`` when tensor
 sharding of the attention math itself matters under pipeline.
-``sequence`` must still be 1 when pipeline > 1 (ring attention holds its
-own manual shard_map over ``sequence``; nesting it inside the schedule
-is out of scope and raises loudly).
+
+``sequence`` composes the same way, as a second AUTO axis: activations
+and the token batch shard their T dim, so LN/FFN/projections/loss are
+sequence-parallel and GSPMD inserts the K/V all-gather inside dense
+attention (the Megatron-SP flavor of context parallelism — NOT the ring
+schedule, which owns its own manual shard_map over ``sequence`` on the
+GSPMD path, parallel/ring.py, and cannot nest inside this one).
 
 Restrictions (checked): ``n_layer % P == 0`` and — at train-step
 construction — ``micro_batch_size`` divisible by data*fsdp. Dropout is
@@ -152,21 +156,19 @@ def _check_pipeline_cfg(model_cfg: ModelConfig, mesh: Mesh) -> int:
     n_stages = mesh.shape.get(_PIPE_AXIS, 1)
     if n_stages < 2:
         raise ValueError(f"pipeline axis must be > 1, got mesh {dict(mesh.shape)}")
-    if mesh.shape.get("sequence", 1) != 1:
-        raise NotImplementedError(
-            f"pipeline parallelism composes with data/fsdp/tensor; mesh has "
-            f"sequence={mesh.shape['sequence']} (ring attention holds its own "
-            f"manual shard_map — use the GSPMD path, parallel/dp_step.py)"
-        )
-    if mesh.shape.get("tensor", 1) != 1 and model_cfg.attention_impl == "pallas":
+    auto_sharded = [
+        ax for ax in ("tensor", "sequence") if mesh.shape.get(ax, 1) != 1
+    ]
+    if auto_sharded and model_cfg.attention_impl == "pallas":
         import warnings
 
         warnings.warn(
-            "pipeline x tensor with attention_impl='pallas': GSPMD cannot "
-            "partition the fused attention kernel, so its operands are "
-            "gathered and the kernel runs REPLICATED over the tensor axis "
+            f"pipeline x {'/'.join(auto_sharded)} with attention_impl="
+            "'pallas': GSPMD cannot partition the fused attention kernel, "
+            "so its operands are gathered and the kernel runs REPLICATED "
+            f"over the {'/'.join(auto_sharded)} axis "
             "(projections/FFN/lm-head still shard). Use attention_impl="
-            "'xla' if tensor-sharded attention matters here",
+            "'xla' if attention-math sharding matters here",
             stacklevel=3,
         )
     if mesh.shape.get("fsdp", 1) != 1:
@@ -399,7 +401,9 @@ def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict)
         return new_state, metrics
 
     st_sh = pipeline_state_sharding(state_template, mesh)
-    b_sh = NamedSharding(mesh, P(None, _DATA_AXES, None))
+    # T shards over the AUTO sequence axis (GSPMD-SP); the manual in_specs
+    # only describe the data axes, the sequence sharding rides along
+    b_sh = NamedSharding(mesh, P(None, _DATA_AXES, "sequence"))
     jitted = jax.jit(
         raw_step,
         in_shardings=(st_sh, {"x": b_sh, "y": b_sh}, None),

@@ -152,6 +152,7 @@ class TestPipelineParity:
 _STEP_MESHES = [
     pytest.param(MeshConfig(pipeline=4, data=2), id="pp4xdp2"),
     pytest.param(MeshConfig(pipeline=2, tensor=2, data=2), id="pp2xtp2xdp2"),
+    pytest.param(MeshConfig(pipeline=2, sequence=2, data=2), id="pp2xsp2xdp2"),
 ]
 
 
@@ -337,34 +338,62 @@ class TestPipelineTrainStep:
         mesh = create_mesh(MeshConfig(pipeline=2, data=2))
         with pytest.raises(ValueError, match="not divisible"):
             make_pipeline_loss(m, mesh)
-        with pytest.raises(NotImplementedError, match="sequence"):
-            make_pipeline_loss(
-                tiny_model("diff"),
-                create_mesh(MeshConfig(pipeline=2, sequence=2, data=2)),
-            )
         with pytest.raises(ValueError, match="pipeline axis"):
             make_pipeline_loss(tiny_model("diff"), create_mesh(MeshConfig(data=2)))
 
 
 class TestPipelineTensorComposition:
-    """Pipeline x tensor parallelism (VERDICT r2 weak item 6): the GPipe
-    schedule is manual over data/fsdp/pipeline while ``tensor`` stays a
-    GSPMD auto axis, so each stage's matmuls/loss shard with the Megatron
-    specs (parallel/sharding.py). Parity against the single-device model
-    is the guarantee."""
+    """Pipeline x tensor / x sequence parallelism (VERDICT r2 weak item
+    6): the GPipe schedule is manual over data/fsdp/pipeline while
+    ``tensor`` and ``sequence`` stay GSPMD auto axes — matmuls/loss shard
+    with the Megatron specs (parallel/sharding.py), activations shard
+    their T dim. Parity against the single-device model is the
+    guarantee."""
 
     def _mesh(self, **kw):
         return create_mesh(MeshConfig(**kw))
 
+    # every family under each auto-axis composition: tp (Megatron
+    # matmul sharding), sp (GSPMD-SP T-sharding — control/ndiff exercise
+    # RoPE over a T-sharded activation), and tp x sp together (data=1:
+    # an 8-device ceiling, not a restriction; the data pmean composes
+    # with each auto axis in the dp2 meshes here and in _STEP_MESHES)
     @pytest.mark.parametrize("family", ["control", "diff", "ndiff"])
-    def test_loss_matches_single_device(self, family):
+    @pytest.mark.parametrize(
+        "mesh_kw",
+        [
+            pytest.param(dict(pipeline=2, tensor=2, data=2), id="pp2xtp2xdp2"),
+            pytest.param(
+                dict(pipeline=2, sequence=2, data=2), id="pp2xsp2xdp2"
+            ),
+            pytest.param(
+                dict(pipeline=2, tensor=2, sequence=2), id="pp2xtp2xsp2"
+            ),
+        ],
+    )
+    def test_loss_matches_single_device(self, family, mesh_kw):
         m = tiny_model(family)
-        mesh = self._mesh(pipeline=2, tensor=2, data=2)
+        mesh = self._mesh(**mesh_kw)
         params = init_model(jax.random.PRNGKey(0), m)
         x, y = microbatches(jax.random.PRNGKey(1), m)
         ref = reference_mean_loss(params, x, y, m)
         got = make_pipeline_loss(m, mesh)(stack_blocks(params), x, y)
         np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+    def test_sequence_grads_match_single_device(self):
+        m = tiny_model("diff")
+        mesh = self._mesh(pipeline=2, sequence=2, data=2)
+        params = init_model(jax.random.PRNGKey(0), m)
+        x, y = microbatches(jax.random.PRNGKey(1), m)
+        ref_grads = stack_blocks(
+            jax.grad(lambda p: reference_mean_loss(p, x, y, m))(params)
+        )
+        pipe_grads = jax.grad(make_pipeline_loss(m, mesh))(stack_blocks(params), x, y)
+        for r, p in zip(
+            jax.tree_util.tree_leaves(ref_grads),
+            jax.tree_util.tree_leaves(pipe_grads),
+        ):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r), atol=2e-5)
 
     def test_grads_match_single_device(self):
         # n_head == tensor axis: every tensor shard holds exactly one
