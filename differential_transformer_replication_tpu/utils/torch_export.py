@@ -1,0 +1,227 @@
+"""Export this framework's checkpoints to the reference (PyTorch) formats.
+
+The inverse of utils/torch_import.py: a params pytree trained here maps
+back onto the reference modules' ``state_dict`` layout, so weights flow
+BOTH ways — a reference user can bring their checkpoint over, train on
+TPU, and hand the result back to the original torch code. Both on-disk
+shapes the reference knows are produced:
+
+  - the ``save_pretrained`` blob ``{'model_args', 'model_state'}``
+    (Ndiff_transformer.py:251-265) — loadable by the reference's own
+    ``AlternatingDiffTransformer.from_pretrained``
+    (Ndiff_transformer.py:243-249) for the ndiff family, and by
+    ``load_state_dict`` for the other two,
+  - the ``best_model.pt`` training-blob key layout
+    (``{'model_state_dict': ...}``, train.py:309-316).
+
+Layout translation (exact inverse of the importer):
+  - our ``(in, out)`` weights transpose back to torch Linear's
+    ``(out, in)``,
+  - merged-head tensors (``wq: (streams, E, H, d)``) split into the
+    per-head ``ModuleList`` entries (``heads.{h}.query1.weight`` etc.,
+    diff_transformer.py:26-30),
+  - GroupLayerNorm affine params unflatten to the reference's
+    ``(1, 1, C)`` registration (diff_transformer.py:12-13),
+  - derived buffers the reference registers are SYNTHESIZED so
+    ``load_state_dict(strict=True)`` passes: ``tril``
+    (control.py:31), complex RoPE ``freqs_cis`` (control.py:4-9,
+    re-derived with torch.polar), per-head ``lambda_init`` at its
+    dynamic per-layer value ``0.8 - 0.6*exp(-0.3*(layer-1))`` — the
+    value any used reference model holds, since its forward writes the
+    buffer in place (diff_transformer.py:41-48) — and the multi-head
+    module's CONSTANT 0.8 (never updated, diff_transformer.py:86).
+
+torch is imported lazily, like the importer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from differential_transformer_replication_tpu.config import ModelConfig
+
+
+def _t(a):
+    import torch
+
+    return torch.tensor(np.asarray(a, dtype=np.float32))
+
+
+def _lin(out: dict, prefix: str, p: dict) -> None:
+    """{'w': (in, out)[, 'b']} -> torch Linear entries at ``prefix``."""
+    out[prefix + ".weight"] = _t(p["w"]).T.contiguous()
+    if "b" in p:
+        out[prefix + ".bias"] = _t(p["b"])
+
+
+def _norm(out: dict, prefix: str, p: dict, shape=None) -> None:
+    w, b = _t(p["w"]), _t(p["b"])
+    if shape is not None:
+        w, b = w.reshape(shape), b.reshape(shape)
+    out[prefix + ".weight"] = w
+    out[prefix + ".bias"] = b
+
+
+def _ffn(out: dict, prefix: str, p: dict) -> None:
+    """Our ffn dict -> the reference FFN Sequential (SwiGLU at index 0,
+    down-proj at index 1, control.py:100-104)."""
+    _lin(out, f"{prefix}.0.linear_gate", p["gate"])
+    _lin(out, f"{prefix}.0.linear_xform", p["xform"])
+    _lin(out, f"{prefix}.1", p["out"])
+
+
+def _tril(block_size: int):
+    import torch
+
+    return torch.tril(torch.ones(block_size, block_size))
+
+
+def _freqs_cis(dim: int, end: int, theta: float = 10000.0):
+    """The reference's complex RoPE table (control.py:4-9 semantics:
+    polar(1, outer(t, 1/theta^(2i/dim)))), rebuilt with torch ops."""
+    import torch
+
+    freqs = 1.0 / (
+        theta ** (torch.arange(0, dim, 2)[: dim // 2].float() / dim)
+    )
+    t = torch.arange(end).float()
+    return torch.polar(torch.ones(end, dim // 2), torch.outer(t, freqs))
+
+
+def _dynamic_lambda_init(layer_idx_1based: int):
+    """The per-layer value the reference's in-place buffer write leaves
+    behind after a forward (diff_transformer.py:41-48, 1-based layers)."""
+    return _t(0.8 - 0.6 * math.exp(-0.3 * (layer_idx_1based - 1)))
+
+
+def export_reference_state_dict(params: dict, cfg: ModelConfig) -> dict:
+    """This framework's params pytree -> the reference model's full
+    ``state_dict`` (params + synthesized buffers), float32, strict-load
+    compatible with the matching reference class."""
+    H, T = cfg.n_head, cfg.block_size
+    # derived buffers are identical across layers/heads: build each ONCE
+    # and share the tensor (torch.save dedups shared storage)
+    tril = _tril(T)
+    freqs_cache: dict = {}
+
+    def freqs(dim: int):
+        if dim not in freqs_cache:
+            freqs_cache[dim] = _freqs_cis(dim, T)
+        return freqs_cache[dim]
+
+    sd: dict = {}
+    sd["token_embedding_table.weight"] = _t(params["tok_emb"])
+    if cfg.model == "diff":
+        sd["position_embedding_table.weight"] = _t(params["pos_emb"])
+    _norm(sd, "ln_f", params["ln_f"])
+    _lin(sd, "lm_head", params["lm_head"])
+
+    for i, blk in enumerate(params["blocks"]):
+        b = f"blocks.{i}"
+        _norm(sd, f"{b}.ln1", blk["ln1"])
+        _norm(sd, f"{b}.ln2", blk["ln2"])
+        _ffn(sd, f"{b}.ffwd", blk["ffn"])
+        attn = blk["attn"]
+        if cfg.model == "control":
+            a = f"{b}.attn"
+            wq, wk, wv = (np.asarray(attn[k]) for k in ("wq", "wk", "wv"))
+            d = wq.shape[-1]
+            for h in range(H):
+                hp = f"{a}.heads.{h}"
+                sd[f"{hp}.query.weight"] = _t(wq[:, h, :]).T.contiguous()
+                sd[f"{hp}.key.weight"] = _t(wk[:, h, :]).T.contiguous()
+                sd[f"{hp}.value.weight"] = _t(wv[:, h, :]).T.contiguous()
+                sd[f"{hp}.tril"] = tril
+                sd[f"{hp}.freqs_cis"] = freqs(d)
+            _lin(sd, f"{a}.proj", attn["out"])
+        elif cfg.model == "diff":
+            a = f"{b}.diff_attn"
+            wq, wk, wv = (np.asarray(attn[k]) for k in ("wq", "wk", "wv"))
+            lq, lk = np.asarray(attn["lambda_q"]), np.asarray(attn["lambda_k"])
+            li = _dynamic_lambda_init(i + 1)
+            for h in range(H):
+                hp = f"{a}.heads.{h}"
+                for s in (1, 2):
+                    sd[f"{hp}.query{s}.weight"] = _t(
+                        wq[s - 1, :, h, :]
+                    ).T.contiguous()
+                    sd[f"{hp}.key{s}.weight"] = _t(
+                        wk[s - 1, :, h, :]
+                    ).T.contiguous()
+                    sd[f"{hp}.lambda_q{s}"] = _t(lq[s - 1, h])
+                    sd[f"{hp}.lambda_k{s}"] = _t(lk[s - 1, h])
+                sd[f"{hp}.value.weight"] = _t(wv[:, h, :]).T.contiguous()
+                sd[f"{hp}.tril"] = tril
+                sd[f"{hp}.lambda_init"] = li
+            _norm(sd, f"{a}.group_norm", attn["gn"], shape=(1, 1, -1))
+            sd[f"{a}.lambda_init"] = _t(0.8)  # constant, never updated
+            _lin(sd, f"{a}.proj", attn["out"])
+        else:  # ndiff
+            a = f"{b}.diff_attn"
+            wq, wk, wv = (np.asarray(attn[k]) for k in ("wq", "wk", "wv"))
+            lq, lk = np.asarray(attn["lambda_q"]), np.asarray(attn["lambda_k"])
+            n, d = wq.shape[0], wq.shape[-1]
+            li = _dynamic_lambda_init(i + 1)
+            for h in range(H):
+                hp = f"{a}.heads.{h}"
+                for t_i in range(n):
+                    sd[f"{hp}.queries.{t_i}.weight"] = _t(
+                        wq[t_i, :, h, :]
+                    ).T.contiguous()
+                    sd[f"{hp}.keys.{t_i}.weight"] = _t(
+                        wk[t_i, :, h, :]
+                    ).T.contiguous()
+                    sd[f"{hp}.lambda_qs.{t_i}"] = _t(lq[t_i, h])
+                    sd[f"{hp}.lambda_ks.{t_i}"] = _t(lk[t_i, h])
+                sd[f"{hp}.value.weight"] = _t(wv[:, h, :]).T.contiguous()
+                sd[f"{hp}.tril"] = tril
+                sd[f"{hp}.freqs_cis"] = freqs(d)
+                sd[f"{hp}.lambda_init"] = li
+            _norm(sd, f"{a}.group_norm", attn["gn"], shape=(1, 1, -1))
+            sd[f"{a}.lambda_init"] = _t(0.8)
+            _lin(sd, f"{a}.proj", attn["out"])
+    return sd
+
+
+def save_reference_checkpoint(
+    path: str,
+    params: dict,
+    cfg: ModelConfig,
+    fmt: str = "pretrained",
+    extra: Optional[dict] = None,
+) -> None:
+    """Write a torch checkpoint the reference code can consume.
+
+    ``fmt='pretrained'``: the ``save_pretrained`` blob
+    ``{'model_args', 'model_state'}`` with the reference's introspected
+    arg set (Ndiff_transformer.py:253-260; n_terms included only for
+    ndiff, mirroring the constructor signatures). For ndiff this loads
+    directly via ``AlternatingDiffTransformer.from_pretrained``.
+
+    ``fmt='train'``: the ``best_model.pt`` key layout
+    (``{'model_state_dict': ...}``, train.py:309-316); ``extra`` entries
+    (e.g. iter_num, best_val_loss) merge into the blob.
+    """
+    import torch
+
+    sd = export_reference_state_dict(params, cfg)
+    if fmt == "pretrained":
+        model_args = {
+            "vocab_size": cfg.vocab_size,
+            "n_embd": cfg.n_embd,
+            "n_head": cfg.n_head,
+            "n_layer": cfg.n_layer,
+            "block_size": cfg.block_size,
+            "dropout": cfg.dropout,
+        }
+        if cfg.model == "ndiff":
+            model_args["n_terms"] = cfg.n_terms
+        blob = {"model_args": model_args, "model_state": sd}
+    elif fmt == "train":
+        blob = {"model_state_dict": sd, **(extra or {})}
+    else:
+        raise ValueError(f"unknown export format {fmt!r}")
+    torch.save(blob, path)

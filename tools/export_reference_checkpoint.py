@@ -1,0 +1,59 @@
+"""Convert one of this framework's checkpoints to the reference
+(PyTorch) formats — the inverse of tools/import_reference_checkpoint.py.
+
+Reads a ``save_pretrained`` directory (train/checkpoint.py, the
+self-describing params+config layout all three families share) and
+writes a torch blob the reference code consumes directly
+(utils/torch_export.py):
+
+    # the save_pretrained blob ({'model_args', 'model_state'},
+    # Ndiff_transformer.py:251-265) — for ndiff this loads via the
+    # reference's own AlternatingDiffTransformer.from_pretrained
+    python tools/export_reference_checkpoint.py trained/ out.pt
+
+    # the best_model.pt training-blob key layout (train.py:309-316)
+    python tools/export_reference_checkpoint.py trained/ out.pt --fmt train
+
+Cross-implementation parity of the mapping (the reference's own forward
+on exported weights matches ours) is pinned by tests/test_torch_export.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint", help="save_pretrained directory to export")
+    p.add_argument("out", help="output .pt path")
+    p.add_argument(
+        "--fmt", choices=["pretrained", "train"], default="pretrained",
+        help="torch blob layout: save_pretrained ({'model_args', "
+        "'model_state'}) or the best_model.pt training shape "
+        "({'model_state_dict'})",
+    )
+    args = p.parse_args()
+
+    from differential_transformer_replication_tpu.train.checkpoint import (
+        from_pretrained,
+    )
+    from differential_transformer_replication_tpu.utils.torch_export import (
+        save_reference_checkpoint,
+    )
+
+    params, model_cfg = from_pretrained(args.checkpoint)
+    save_reference_checkpoint(args.out, params, model_cfg, fmt=args.fmt)
+    print(
+        f"exported {model_cfg.model} ({model_cfg.n_layer}L/"
+        f"{model_cfg.n_embd}d/{model_cfg.n_head}h) -> {args.out} "
+        f"[{args.fmt}]"
+    )
+
+
+if __name__ == "__main__":
+    main()
