@@ -44,6 +44,11 @@ class ModelConfig:
     # Attention backend: "xla" (merged-head einsum under jit) or "pallas"
     # (fused differential flash attention kernel).
     attention_impl: str = "xla"
+    # Sequence-parallel strategy when the mesh's sequence axis is > 1:
+    # "ring" (K/V rotation with O(Tl) chunk memory, parallel/ring.py) or
+    # "ulysses" (all-to-all head/sequence re-sharding so the unmodified
+    # full-T flash kernel runs per head slice, parallel/ulysses.py).
+    sequence_impl: str = "ring"
     # Rematerialize each transformer block on the backward pass
     # (jax.checkpoint): trades ~1/3 more FLOPs for O(n_layer) less
     # activation memory — the standard TPU lever for bigger micro-batches
@@ -65,6 +70,11 @@ class ModelConfig:
             raise ValueError(
                 "attention_impl must be 'xla' or 'pallas', got "
                 f"{self.attention_impl!r}"
+            )
+        if self.sequence_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                "sequence_impl must be 'ring' or 'ulysses', got "
+                f"{self.sequence_impl!r}"
             )
         if self.loss_chunk is not None and self.loss_chunk < 1:
             raise ValueError(f"loss_chunk must be positive, got {self.loss_chunk}")

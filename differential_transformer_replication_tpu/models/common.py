@@ -243,12 +243,15 @@ def dispatch_attention(
     dropout_rate: float,
     rng: Optional[jax.Array],
     flash_fn=None,
+    seq_impl: str = "ring",
 ) -> jnp.ndarray:
     """The attention-backend dispatch shared by all three families.
 
     Every family's attention is the same multi-stream form
     (ops/streams.py), so backend selection is family-independent:
-      1. >1 ``sequence`` mesh axis  -> ring attention (parallel/ring.py),
+      1. >1 ``sequence`` mesh axis  -> sequence parallelism: ring
+         attention (parallel/ring.py) or, with seq_impl == "ulysses",
+         all-to-all re-sharding (parallel/ulysses.py),
       2. impl == "pallas", >1-device mesh -> shard_map'd flash
          (parallel/shard_flash.py),
       3. impl == "pallas"           -> fused flash kernel (ops/flash.py),
@@ -279,6 +282,15 @@ def dispatch_attention(
     )
 
     if use_ring(mesh):
+        if seq_impl == "ulysses":
+            from differential_transformer_replication_tpu.parallel.ulysses import (
+                ulysses_multi_stream_attention,
+            )
+
+            return ulysses_multi_stream_attention(
+                qs, ks, v, coeffs, mesh, impl,
+                dropout_rate=dropout_rate, dropout_rng=rng,
+            )
         return ring_multi_stream_attention(
             qs, ks, v, coeffs, mesh, impl,
             dropout_rate=dropout_rate, dropout_rng=rng,

@@ -72,6 +72,7 @@ def _attn(
     rng: Optional[jax.Array],
     impl: str = "xla",
     mesh=None,
+    seq_impl: str = "ring",
 ) -> jnp.ndarray:
     B, T, E = x.shape
     r_att, r_out = common.split_rng(rng, 2)
@@ -88,6 +89,7 @@ def _attn(
             q, k, v, mask=mask, dropout_rate=dropout_rate, rng=r_att
         ),
         impl=impl, mesh=mesh, dropout_rate=dropout_rate, rng=r_att,
+        seq_impl=seq_impl,
         # kernel-native-layout fast path (RoPE applied in the bh layout)
         flash_fn=common.flash_bh_fn(
             x, p["wq"][None], p["wk"][None], p["wv"], coeffs,
@@ -124,6 +126,7 @@ def block_forward(
     x = x + _attn(
         common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
         cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl, mesh,
+        cfg.sequence_impl,
     )
     return x + common.apply_ffn(
         common.apply_layer_norm(x, blk["ln2"]), blk["ffn"],

@@ -85,6 +85,7 @@ def _attn(
     rng: Optional[jax.Array],
     impl: str = "xla",
     mesh=None,
+    seq_impl: str = "ring",
 ) -> jnp.ndarray:
     B, T, E = x.shape
     r_att, r_out = common.split_rng(rng, 2)
@@ -106,6 +107,7 @@ def _attn(
             mask=mask, dropout_rate=dropout_rate, rng=r_att,
         ),
         impl=impl, mesh=mesh, dropout_rate=dropout_rate, rng=r_att,
+        seq_impl=seq_impl,
         # kernel-native-layout fast path (the stacked projections above
         # are dead code on that branch and DCE'd)
         flash_fn=common.flash_bh_fn(
@@ -154,6 +156,7 @@ def block_forward(
     x = x + _attn(
         common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
         layer_idx, mask, cfg.dropout, r_attn, cfg.attention_impl, mesh,
+        cfg.sequence_impl,
     )
     return x + common.apply_ffn(
         common.apply_layer_norm(x, blk["ln2"]), blk["ffn"],

@@ -552,6 +552,16 @@ def _fwd_call(
 # training at T=5120 (flagship shapes); stream K/V above this
 _KV_TILE_THRESHOLD = 4096
 
+# The BACKWARD can switch to the KV-tiled kernels earlier than the
+# forward: the resident bwd kernels are the reason the train K tile is
+# clamped to 512 at 1024 < T <= _KV_TILE_THRESHOLD (see the clamp in
+# multi_stream_flash_attention_bh), while the tiled bwd holds only
+# O(block) state and keeps the 1024-wide tile that measured +24-29% in
+# bare-op sweeps. Kept equal to _KV_TILE_THRESHOLD by default; lowering
+# it (experiment knob) routes 1024 < T <= value backwards through the
+# tiled kernels instead.
+_BWD_KV_TILE_THRESHOLD = _KV_TILE_THRESHOLD
+
 
 def _tiled_fwd_kernel(
     q_ref,  # (1, S, block_q, d)    constant over the k grid dim
@@ -1376,7 +1386,7 @@ def _bwd_call(
             q, k, v, do_s, lse, delta, interpret=interpret,
             dropout_seed=seed, dropout_rate=dropout_rate, coeffs=coeffs,
         )
-    if T > _KV_TILE_THRESHOLD:
+    if T > _BWD_KV_TILE_THRESHOLD:
         return _tiled_bwd_call(
             q, k, v, do_s, lse, delta, offset,
             block_q=block_q, block_k=block_k, interpret=interpret,
@@ -1715,8 +1725,8 @@ def multi_stream_flash_attention(
     train tile became compilable once the kernels switched to bf16 MXU
     operands (half the VMEM per tile) and measured 5-29% faster than
     512-square in bare-op sweeps (tools/flash_sweep.py). BUT in the
-    RESIDENT backward region (1024 < T <= _KV_TILE_THRESHOLD, where the
-    bwd kernels hold full-T q/do) the wide tile exhausts v5e's scoped
+    RESIDENT backward region (1024 < T <= _BWD_KV_TILE_THRESHOLD, where
+    the bwd kernels hold full-T q/do) the wide tile exhausts v5e's scoped
     VMEM under the full model, so the default train K tile is capped to
     512 there; the KV-tiled kernels past the threshold hold O(block)
     state and keep the wide tile. Unknown TPU kinds fall back to
@@ -1767,7 +1777,7 @@ def multi_stream_flash_attention_bh(
     dq, dk, dqt, dkt = default_blocks()
     BH, S, T, d = q_r.shape
     bkt = block_k_train if block_k_train is not None else dkt
-    if 1024 < T <= _KV_TILE_THRESHOLD and block_k_train is None:
+    if 1024 < T <= _BWD_KV_TILE_THRESHOLD and block_k_train is None:
         # the RESIDENT backward kernels hold full-T q/do plus the K/V
         # block: with the 1024-wide train K tile their fp32 p/dp/ds
         # blocks exceed v5e's 16M scoped VMEM from T=2048 (measured
@@ -1775,8 +1785,9 @@ def multi_stream_flash_attention_bh(
         # re-verified round 3 AFTER the factored backward halved the dO
         # traffic — the wide tile still fails to compile at T=2048, so
         # the clamp is not stale). The
-        # KV-tiled kernels past _KV_TILE_THRESHOLD hold only O(block)
-        # state, so they keep the wide tile.
+        # KV-tiled kernels past _BWD_KV_TILE_THRESHOLD hold only O(block)
+        # state, so they keep the wide tile; lowering that knob moves
+        # this clamp region with it.
         bkt = min(bkt, 512)
     blocks = (
         _pick_block(block_q if block_q is not None else dq, T),

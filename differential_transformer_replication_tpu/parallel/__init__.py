@@ -20,6 +20,9 @@ from differential_transformer_replication_tpu.parallel.shard_flash import (
     shard_flash_ndiff_attention,
     shard_flash_vanilla_attention,
 )
+from differential_transformer_replication_tpu.parallel.ulysses import (
+    ulysses_multi_stream_attention,
+)
 
 __all__ = [
     "create_mesh",
@@ -36,4 +39,5 @@ __all__ = [
     "shard_flash_vanilla_attention",
     "shard_flash_diff_attention",
     "shard_flash_ndiff_attention",
+    "ulysses_multi_stream_attention",
 ]

@@ -1,0 +1,161 @@
+"""All-to-all (Ulysses-style) sequence parallelism — the second context-
+parallel strategy beside the ring (parallel/ring.py).
+
+The reference has no sequence parallelism of any kind (SURVEY.md
+section 5.7: a hard block_size=512 with dense per-head maps). This
+module implements the all-to-all recipe on XLA collectives: activations
+arrive sharded on the SEQUENCE dim; one ``jax.lax.all_to_all`` over the
+``sequence`` mesh axis re-shards attention's inputs from
+(T/P, H-local) to (T-full, H-local/P) — every device then runs ordinary
+FULL-sequence causal attention over its head slice, and a second
+all-to-all restores the sequence sharding. Outside attention (LN, FFN,
+projections, loss) everything stays sequence-sharded.
+
+Trade-off vs the ring, honestly stated: the ring keeps per-device
+attention memory at O(Tl) and overlaps K/V rotation with compute, but
+its chunk schedule runs P sequential steps; all-to-all pays two
+collectives and holds full-T K/V per device — in exchange the inner
+attention is ONE call on contiguous data, so the fused Pallas kernel
+(ops/flash.py) runs unmodified at full efficiency (the ring reaches the
+kernel only in its offset-causal chunk form). Pick per workload with
+``ModelConfig.sequence_impl`` ("ring" default | "ulysses").
+
+Constraint (checked): local heads H/tensor must divide by the sequence
+axis — each sequence shard takes an equal head group.
+
+With dropout, the replicated key is folded with the device's full mesh
+position (the shard_flash.py pattern): after the all-to-all each device
+keys masks on LOCAL (b*h) indices, which repeat across shards, so the
+fold is what keeps every batch/head shard's masks independent.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from differential_transformer_replication_tpu.ops.streams import NEG_INF
+
+_BATCH_AXES = ("data", "fsdp")
+_SEQ_AXIS = "sequence"
+_HEAD_AXIS = "tensor"
+
+
+def _check_heads(n_head_local: int, p: int) -> int:
+    if n_head_local % p:
+        raise ValueError(
+            f"ulysses sequence parallelism needs local heads divisible by "
+            f"the sequence axis: {n_head_local} heads per tensor shard vs "
+            f"sequence={p} (use the ring, sequence_impl='ring', for uneven "
+            f"head counts)"
+        )
+    return n_head_local // p
+
+
+def _dense_full_attention(qs, ks, v, coeffs, dropout_rate, rng):
+    """Full-sequence multi-stream causal attention on local heads —
+    the XLA body after the first all-to-all. qs/ks: (S, B, T, h, d),
+    v: (B, T, h, dv), coeffs: (S, h). Softmax-then-dropout per map with
+    inverted scaling (diff_transformer.py:58-67 semantics)."""
+    S, B, T, h, d = qs.shape
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum(
+        "sbthd,sbuhd->sbhtu", qs.astype(jnp.float32), ks.astype(jnp.float32)
+    ) * scale
+    rows = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    s = jnp.where((cols <= rows)[None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    out_s = jnp.einsum("sbhtu,buhd->sbthd", p, v.astype(jnp.float32))
+    out = jnp.einsum("sbthd,sh->bthd", out_s, coeffs.astype(jnp.float32))
+    return out.astype(v.dtype)
+
+
+def ulysses_multi_stream_attention(
+    qs: jnp.ndarray,  # (S, B, T, H, d) global, T sharded over sequence
+    ks: jnp.ndarray,
+    v: jnp.ndarray,  # (B, T, H, dv)
+    coeffs: jnp.ndarray,  # (S, H) float32
+    mesh: Mesh,
+    impl: str = "xla",
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
+) -> jnp.ndarray:
+    """Causal multi-stream attention, sequence-sharded via all-to-all.
+    Global shapes in, global out — callable from inside an outer jit;
+    composes with data/fsdp batch sharding and tensor head sharding.
+
+    ``impl``: "pallas" runs the fused flash kernel on the re-sharded
+    full-T head slice (the aligned-causal kernel, unmodified); "xla"
+    computes the dense masked softmax."""
+    p_seq = mesh.shape[_SEQ_AXIS]
+    qk_spec = P(None, _BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
+    v_spec = P(_BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
+    c_spec = P(None, _HEAD_AXIS)
+    use_drop = dropout_rate > 0.0 and dropout_rng is not None
+
+    def body(qs_l, ks_l, v_l, c_l, rng):
+        # local shapes: (S, B, Tl, Hl, d) / (B, Tl, Hl, dv) / (S, Hl)
+        hh = _check_heads(qs_l.shape[3], p_seq)
+        if rng is not None:
+            pos = jax.lax.axis_index(_BATCH_AXES[0])
+            for ax in (_BATCH_AXES[1], _HEAD_AXIS, _SEQ_AXIS):
+                pos = pos * mesh.shape[ax] + jax.lax.axis_index(ax)
+            rng = jax.random.fold_in(rng, pos)
+        # all-to-all #1: gather the sequence, split the heads — shard i
+        # of the sequence axis takes head group i of this tensor shard
+        q_g = jax.lax.all_to_all(
+            qs_l, _SEQ_AXIS, split_axis=3, concat_axis=2, tiled=True
+        )  # (S, B, T, Hl/P, d)
+        k_g = jax.lax.all_to_all(
+            ks_l, _SEQ_AXIS, split_axis=3, concat_axis=2, tiled=True
+        )
+        v_g = jax.lax.all_to_all(
+            v_l, _SEQ_AXIS, split_axis=2, concat_axis=1, tiled=True
+        )  # (B, T, Hl/P, dv)
+        my = jax.lax.axis_index(_SEQ_AXIS)
+        c_g = jax.lax.dynamic_slice_in_dim(c_l, my * hh, hh, axis=1)
+
+        if impl == "pallas":
+            from differential_transformer_replication_tpu.ops.flash import (
+                multi_stream_flash_attention,
+            )
+
+            out_g = multi_stream_flash_attention(
+                q_g, k_g, v_g, c_g,
+                dropout_rate=dropout_rate, dropout_rng=rng,
+            )
+        else:
+            out_g = _dense_full_attention(
+                q_g, k_g, v_g, c_g, dropout_rate, rng
+            )
+        # all-to-all #2: back to sequence sharding, heads re-gathered
+        return jax.lax.all_to_all(
+            out_g, _SEQ_AXIS, split_axis=1, concat_axis=2, tiled=True
+        )  # (B, Tl, Hl, dv)
+
+    if use_drop:
+        inner = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(qk_spec, qk_spec, v_spec, c_spec, P()),
+            out_specs=v_spec,
+            check_vma=False,
+        )
+        return inner(qs, ks, v, coeffs, dropout_rng)
+
+    inner = jax.shard_map(
+        lambda a, b, c, d: body(a, b, c, d, None),
+        mesh=mesh,
+        in_specs=(qk_spec, qk_spec, v_spec, c_spec),
+        out_specs=v_spec,
+        check_vma=False,
+    )
+    return inner(qs, ks, v, coeffs)

@@ -54,6 +54,8 @@ _SLOW_PATTERNS = (
     "test_flash.py::test_diff_grad_parity",
     "test_flash.py::test_vjp",
     "test_torch_import.py",
+    "test_torch_export.py",
+    "test_ulysses.py",
     "test_flash_dropout.py::test_grad_matches_dense_with_same_masks",
     "test_flash_dropout.py::test_tiled_kernels_match_dense_with_same_masks",
     "test_flash_dropout.py::test_model_forward_with_fused_dropout",

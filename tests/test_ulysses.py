@@ -1,0 +1,285 @@
+"""All-to-all (Ulysses) sequence-parallelism tests on the virtual CPU
+mesh (parallel/ulysses.py) — the second context-parallel strategy beside
+the ring. The all-to-all path must match the dense single-device ops up
+to fp32 accumulation order, including gradients through both collectives
+and full-model forwards/train steps with ``sequence_impl='ulysses'``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from differential_transformer_replication_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from differential_transformer_replication_tpu.models import init_model, model_forward
+from differential_transformer_replication_tpu.ops import (
+    causal_mask,
+    diff_attention,
+    ndiff_attention,
+    ndiff_signs,
+    vanilla_attention,
+)
+from differential_transformer_replication_tpu.ops.streams import (
+    diff_coeffs,
+    ndiff_coeffs,
+    vanilla_coeffs,
+)
+from differential_transformer_replication_tpu.parallel import create_mesh
+from differential_transformer_replication_tpu.parallel.ulysses import (
+    ulysses_multi_stream_attention,
+)
+
+B, T, H, D = 2, 64, 4, 16
+
+
+def _seq_mesh(n_seq: int, tensor: int = 1) -> Mesh:
+    return create_mesh(MeshConfig(data=1, fsdp=1, tensor=tensor, sequence=n_seq))
+
+
+def _rand(key, *shape):
+    return jax.random.normal(key, shape, jnp.float32)
+
+
+@pytest.mark.parametrize("n_seq", [2, 4])
+def test_vanilla_ulysses_parity(n_seq):
+    mesh = _seq_mesh(n_seq)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (_rand(kk, B, T, H, D) for kk in ks)
+    ref = vanilla_attention(q, k, v, mask=causal_mask(T))
+    got = jax.jit(
+        lambda q, k, v: ulysses_multi_stream_attention(
+            q[None], k[None], v, vanilla_coeffs(H), mesh
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_diff_ulysses_parity():
+    mesh = _seq_mesh(4)
+    ks = jax.random.split(jax.random.PRNGKey(1), 5)
+    q1, k1, q2, k2 = (_rand(kk, B, T, H, D) for kk in ks[:4])
+    v = _rand(ks[4], B, T, H, 2 * D)
+    lam = jnp.full((H,), 0.37, jnp.float32)
+    ref = diff_attention(q1, k1, q2, k2, v, lam, mask=causal_mask(T))
+    got = jax.jit(
+        lambda *a: ulysses_multi_stream_attention(
+            jnp.stack([a[0], a[2]]), jnp.stack([a[1], a[3]]), a[4],
+            diff_coeffs(lam), mesh,
+        )
+    )(q1, k1, q2, k2, v)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_ndiff_ulysses_parity():
+    mesh = _seq_mesh(2)
+    n = 3
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    qs = _rand(ks[0], n, B, T, H, D)
+    kss = _rand(ks[1], n, B, T, H, D)
+    v = _rand(ks[2], B, T, H, 2 * D)
+    lams = jnp.abs(_rand(jax.random.PRNGKey(3), n, H)) * 0.3 + 0.1
+    signs = ndiff_signs(n)
+    ref = ndiff_attention(qs, kss, v, lams, signs, mask=causal_mask(T))
+    got = jax.jit(
+        lambda qs, kss, v: ulysses_multi_stream_attention(
+            qs, kss, v, ndiff_coeffs(lams, signs), mesh
+        )
+    )(qs, kss, v)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_grad_parity():
+    """Gradients flow through BOTH all-to-alls (their transpose is the
+    reverse all-to-all) and match dense autodiff."""
+    mesh = _seq_mesh(4)
+    ks = jax.random.split(jax.random.PRNGKey(4), 5)
+    q1, k1, q2, k2 = (_rand(kk, B, T, H, D) for kk in ks[:4])
+    v = _rand(ks[4], B, T, H, 2 * D)
+    lam = jnp.full((H,), 0.2, jnp.float32)
+
+    def loss_ref(q1, k1, q2, k2, v):
+        out = diff_attention(q1, k1, q2, k2, v, lam, mask=causal_mask(T))
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_uly(q1, k1, q2, k2, v):
+        out = ulysses_multi_stream_attention(
+            jnp.stack([q1, q2]), jnp.stack([k1, k2]), v,
+            diff_coeffs(lam), mesh,
+        )
+        return jnp.sum(out * jnp.cos(out))
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(q1, k1, q2, k2, v)
+    g_got = jax.jit(jax.grad(loss_uly, argnums=(0, 1, 2, 3, 4)))(
+        q1, k1, q2, k2, v
+    )
+    for r, g in zip(g_ref, g_got):
+        np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_composes_with_tensor_axis():
+    """tensor=2 x sequence=2: heads shard over tensor first, then the
+    all-to-all splits each tensor shard's heads across sequence."""
+    mesh = _seq_mesh(2, tensor=2)
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (_rand(kk, B, T, H, D) for kk in ks)
+    ref = vanilla_attention(q, k, v, mask=causal_mask(T))
+    got = jax.jit(
+        lambda q, k, v: ulysses_multi_stream_attention(
+            q[None], k[None], v, vanilla_coeffs(H), mesh
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_uneven_heads_fail_loudly():
+    mesh = _seq_mesh(8)  # 4 heads over 8 sequence shards
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q, k, v = (_rand(kk, B, T, H, D) for kk in ks)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(
+            lambda q, k, v: ulysses_multi_stream_attention(
+                q[None], k[None], v, vanilla_coeffs(H), mesh
+            )
+        )(q, k, v)
+
+
+@pytest.mark.parametrize("kind", ["control", "diff", "ndiff"])
+def test_model_forward_ulysses(kind):
+    """Full model forward with sequence_impl='ulysses' matches the dense
+    forward — the dispatch routes through the all-to-all path."""
+    mesh = _seq_mesh(4)
+    cfg = ModelConfig(
+        model=kind, vocab_size=97, n_embd=64, n_head=4, n_layer=2,
+        block_size=32, dropout=0.0, n_terms=2, compute_dtype="float32",
+        sequence_impl="ulysses",
+    )
+    params = init_model(jax.random.PRNGKey(0), cfg)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+    ref, _ = model_forward(params, idx, cfg)
+    got, _ = jax.jit(lambda p, i: model_forward(p, i, cfg, mesh=mesh))(params, idx)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_pallas_parity():
+    """impl='pallas' inside the all-to-all body: the unmodified aligned-
+    causal flash kernel runs on the full-T head slice (interpret mode on
+    CPU)."""
+    mesh = _seq_mesh(2)
+    ks = jax.random.split(jax.random.PRNGKey(7), 5)
+    q1, k1, q2, k2 = (_rand(kk, B, T, H, D) for kk in ks[:4])
+    v = _rand(ks[4], B, T, H, 2 * D)
+    lam = jnp.full((H,), 0.4, jnp.float32)
+    ref = diff_attention(q1, k1, q2, k2, v, lam, mask=causal_mask(T))
+    got = jax.jit(
+        lambda *a: ulysses_multi_stream_attention(
+            jnp.stack([a[0], a[2]]), jnp.stack([a[1], a[3]]), a[4],
+            diff_coeffs(lam), mesh, "pallas",
+        )
+    )(q1, k1, q2, k2, v)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_dropout():
+    """Dropout on the all-to-all path: deterministic per key, distinct
+    across keys, inert without one, grads finite."""
+    mesh = _seq_mesh(2)
+    ks = jax.random.split(jax.random.PRNGKey(8), 3)
+    q, k, v = (_rand(kk, B, T, H, D) for kk in ks)
+
+    def run(rng):
+        return jax.jit(
+            lambda q, k, v: ulysses_multi_stream_attention(
+                q[None], k[None], v, vanilla_coeffs(H), mesh,
+                dropout_rate=0.3, dropout_rng=rng,
+            )
+        )(q, k, v)
+
+    a = run(jax.random.PRNGKey(2))
+    b = run(jax.random.PRNGKey(2))
+    c = run(jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    def loss(q, k, v):
+        out = ulysses_multi_stream_attention(
+            q[None], k[None], v, vanilla_coeffs(H), mesh,
+            dropout_rate=0.3, dropout_rng=jax.random.PRNGKey(2),
+        )
+        return jnp.sum(out ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for arr in g:
+        assert bool(jnp.all(jnp.isfinite(arr)))
+
+
+def test_ulysses_pallas_dropout():
+    """Kernel dropout under the all-to-all re-sharding: the per-shard rng
+    fold must keep masks independent across sequence shards even though
+    the kernel keys them on LOCAL (b*h) indices that repeat per shard.
+    The sharp check: with IDENTICAL content in every head, the two
+    sequence shards (each holding a head group) see byte-identical
+    kernel inputs and identical local indices — so equal outputs across
+    head groups would mean the masks repeated, i.e. the fold was lost."""
+    mesh = _seq_mesh(2)
+    k = jax.random.PRNGKey(9)
+    one_head = _rand(k, B, T, 1, D)
+    q = jnp.broadcast_to(one_head, (B, T, H, D))  # all H heads identical
+
+    def run(rng):
+        return jax.jit(
+            lambda q: ulysses_multi_stream_attention(
+                q[None], q[None], q, vanilla_coeffs(H), mesh, "pallas",
+                dropout_rate=0.4, dropout_rng=rng,
+            )
+        )(q)
+
+    a = run(jax.random.PRNGKey(2))
+    b = run(jax.random.PRNGKey(2))
+    c = run(jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+    out = np.asarray(a)
+    # shard 0 holds heads 0..1, shard 1 holds heads 2..3 (identical
+    # inputs); without the fold their masks — hence outputs — coincide
+    assert not np.allclose(out[:, :, : H // 2], out[:, :, H // 2 :]), (
+        "sequence shards produced identical dropped outputs on identical "
+        "inputs — per-shard rng fold lost"
+    )
+    # within one shard, mask independence across its two heads comes from
+    # the kernel's own (b*h) keying: also must differ
+    assert not np.allclose(out[:, :, 0], out[:, :, 1])
+
+
+def test_ulysses_train_step():
+    """End-to-end sharded train step with sequence_impl='ulysses' on a
+    data=2 x sequence=2 x tensor=2 mesh."""
+    from differential_transformer_replication_tpu.parallel import (
+        make_sharded_train_step,
+    )
+    from differential_transformer_replication_tpu.parallel.dp_step import (
+        create_sharded_train_state,
+    )
+
+    mesh_cfg = MeshConfig(data=2, fsdp=1, tensor=2, sequence=2)
+    model = ModelConfig(
+        model="diff", vocab_size=64, n_embd=64, n_head=4, n_layer=2,
+        block_size=32, dropout=0.0, compute_dtype="float32",
+        sequence_impl="ulysses",
+    )
+    cfg = TrainConfig(
+        model=model, mesh=mesh_cfg, vocab_size=64, micro_batch_size=4,
+        grad_acc_steps=2, control_head_multiplier=1,
+    )
+    mesh = create_mesh(mesh_cfg)
+    state = create_sharded_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_sharded_train_step(cfg, mesh, state)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 4, 32), 0, 64)
+    batch = {"x": x, "y": jnp.roll(x, -1, axis=-1)}
+    state2, metrics = step(state, batch)
+    assert jnp.isfinite(float(metrics["loss"]))
+    assert int(state2["step"]) == 1

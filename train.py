@@ -36,6 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-terms", type=int, default=m.n_terms)
     p.add_argument("--compute-dtype", default=m.compute_dtype)
     p.add_argument("--attention-impl", choices=("xla", "pallas"), default=m.attention_impl)
+    p.add_argument("--sequence-impl", choices=("ring", "ulysses"),
+                   default=m.sequence_impl,
+                   help="sequence-parallel strategy when --sequence-parallel "
+                        "> 1: K/V ring rotation or all-to-all re-sharding")
     p.add_argument("--loss-chunk", type=int, default=None,
                    help="fused chunked lm-head loss: positions per chunk "
                         "(never materializes full logits; for long context)")
@@ -95,6 +99,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         n_terms=args.n_terms,
         compute_dtype=args.compute_dtype,
         attention_impl=args.attention_impl,
+        sequence_impl=args.sequence_impl,
         remat=args.remat,
         loss_chunk=args.loss_chunk,
     )
