@@ -557,9 +557,11 @@ _KV_TILE_THRESHOLD = 4096
 # clamped to 512 at 1024 < T <= _KV_TILE_THRESHOLD (see the clamp in
 # multi_stream_flash_attention_bh), while the tiled bwd holds only
 # O(block) state and keeps the 1024-wide tile that measured +24-29% in
-# bare-op sweeps. Kept equal to _KV_TILE_THRESHOLD by default; lowering
-# it (experiment knob) routes 1024 < T <= value backwards through the
-# tiled kernels instead.
+# bare-op sweeps. Kept equal to _KV_TILE_THRESHOLD by default. Lowering
+# this knob to a value V routes the region V < T <= _KV_TILE_THRESHOLD
+# backward through the tiled kernels (the dispatch is `T > threshold`,
+# so e.g. V=1024 moves T=2048/4096 off the resident backward; T <= V
+# stays resident and clamped).
 _BWD_KV_TILE_THRESHOLD = _KV_TILE_THRESHOLD
 
 

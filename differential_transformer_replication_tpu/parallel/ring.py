@@ -185,6 +185,45 @@ def _ring_shard_body(
     return out.transpose(0, 2, 1, 3).astype(v.dtype)  # (Bl, Tl, Hl, dv)
 
 
+def sequence_shard_map(body, mesh: Mesh, qs, ks, v, coeffs, *, dropout_rng=None):
+    """The shard_map scaffolding SHARED by both sequence-parallel
+    strategies (ring here, all-to-all in parallel/ulysses.py): batch over
+    data/fsdp, T over ``sequence``, heads over ``tensor``; ``body`` is
+    ``(qs_l, ks_l, v_l, coeffs_l, rng) -> out_l``. With a key, the
+    replicated rng is folded with the device's FULL mesh position before
+    reaching body — the fold that keeps every shard's dropout masks
+    independent; keeping it in one place keeps the two strategies'
+    dropout semantics from drifting."""
+    qk_spec = P(None, _BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
+    v_spec = P(_BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
+    c_spec = P(None, _HEAD_AXIS)
+
+    if dropout_rng is not None:
+        def folded(qs_l, ks_l, v_l, c_l, rng):
+            pos = jax.lax.axis_index(_BATCH_AXES[0])
+            for ax in (_BATCH_AXES[1], _HEAD_AXIS, _SEQ_AXIS):
+                pos = pos * mesh.shape[ax] + jax.lax.axis_index(ax)
+            return body(qs_l, ks_l, v_l, c_l, jax.random.fold_in(rng, pos))
+
+        inner = jax.shard_map(
+            folded,
+            mesh=mesh,
+            in_specs=(qk_spec, qk_spec, v_spec, c_spec, P()),
+            out_specs=v_spec,
+            check_vma=False,
+        )
+        return inner(qs, ks, v, coeffs, dropout_rng)
+
+    inner = jax.shard_map(
+        lambda a, b, c, d: body(a, b, c, d, None),
+        mesh=mesh,
+        in_specs=(qk_spec, qk_spec, v_spec, c_spec),
+        out_specs=v_spec,
+        check_vma=False,
+    )
+    return inner(qs, ks, v, coeffs)
+
+
 def ring_multi_stream_attention(
     qs: jnp.ndarray,  # (S, B, T, H, d) global
     ks: jnp.ndarray,
@@ -210,39 +249,13 @@ def ring_multi_stream_attention(
     on both impls (each map dropped after normalization, inverted
     scaling); the replicated key is folded with the device's full mesh
     position inside the body so every shard draws independent masks."""
-    qk_spec = P(None, _BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
-    v_spec = P(_BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
-    c_spec = P(None, _HEAD_AXIS)
     body_fn = _ring_flash_body if impl == "pallas" else _ring_shard_body
     use_drop = dropout_rate > 0.0 and dropout_rng is not None
-
-    if use_drop:
-        def body(qs_l, ks_l, v_l, c_l, rng):
-            pos = jax.lax.axis_index(_BATCH_AXES[0])
-            for ax in (_BATCH_AXES[1], _HEAD_AXIS, _SEQ_AXIS):
-                pos = pos * mesh.shape[ax] + jax.lax.axis_index(ax)
-            return body_fn(
-                qs_l, ks_l, v_l, c_l, dropout_rate,
-                jax.random.fold_in(rng, pos),
-            )
-
-        inner = jax.shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(qk_spec, qk_spec, v_spec, c_spec, P()),
-            out_specs=v_spec,
-            check_vma=False,
-        )
-        return inner(qs, ks, v, coeffs, dropout_rng)
-
-    inner = jax.shard_map(
-        lambda a, b, c, d: body_fn(a, b, c, d),
-        mesh=mesh,
-        in_specs=(qk_spec, qk_spec, v_spec, c_spec),
-        out_specs=v_spec,
-        check_vma=False,
+    return sequence_shard_map(
+        lambda a, b, c, d, rng: body_fn(a, b, c, d, dropout_rate, rng),
+        mesh, qs, ks, v, coeffs,
+        dropout_rng=dropout_rng if use_drop else None,
     )
-    return inner(qs, ks, v, coeffs)
 
 
 def ring_vanilla_attention(q, k, v, mesh: Mesh, impl: str = "xla", **kw):

@@ -35,13 +35,14 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from differential_transformer_replication_tpu.ops.streams import NEG_INF
+from differential_transformer_replication_tpu.parallel.ring import (
+    sequence_shard_map,
+)
 
-_BATCH_AXES = ("data", "fsdp")
 _SEQ_AXIS = "sequence"
-_HEAD_AXIS = "tensor"
 
 
 def _check_heads(n_head_local: int, p: int) -> int:
@@ -96,19 +97,13 @@ def ulysses_multi_stream_attention(
     full-T head slice (the aligned-causal kernel, unmodified); "xla"
     computes the dense masked softmax."""
     p_seq = mesh.shape[_SEQ_AXIS]
-    qk_spec = P(None, _BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
-    v_spec = P(_BATCH_AXES, _SEQ_AXIS, _HEAD_AXIS, None)
-    c_spec = P(None, _HEAD_AXIS)
     use_drop = dropout_rate > 0.0 and dropout_rng is not None
 
     def body(qs_l, ks_l, v_l, c_l, rng):
-        # local shapes: (S, B, Tl, Hl, d) / (B, Tl, Hl, dv) / (S, Hl)
+        # local shapes: (S, B, Tl, Hl, d) / (B, Tl, Hl, dv) / (S, Hl);
+        # rng arrives already folded per mesh position
+        # (ring.sequence_shard_map)
         hh = _check_heads(qs_l.shape[3], p_seq)
-        if rng is not None:
-            pos = jax.lax.axis_index(_BATCH_AXES[0])
-            for ax in (_BATCH_AXES[1], _HEAD_AXIS, _SEQ_AXIS):
-                pos = pos * mesh.shape[ax] + jax.lax.axis_index(ax)
-            rng = jax.random.fold_in(rng, pos)
         # all-to-all #1: gather the sequence, split the heads — shard i
         # of the sequence axis takes head group i of this tensor shard
         q_g = jax.lax.all_to_all(
@@ -141,21 +136,7 @@ def ulysses_multi_stream_attention(
             out_g, _SEQ_AXIS, split_axis=1, concat_axis=2, tiled=True
         )  # (B, Tl, Hl, dv)
 
-    if use_drop:
-        inner = jax.shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(qk_spec, qk_spec, v_spec, c_spec, P()),
-            out_specs=v_spec,
-            check_vma=False,
-        )
-        return inner(qs, ks, v, coeffs, dropout_rng)
-
-    inner = jax.shard_map(
-        lambda a, b, c, d: body(a, b, c, d, None),
-        mesh=mesh,
-        in_specs=(qk_spec, qk_spec, v_spec, c_spec),
-        out_specs=v_spec,
-        check_vma=False,
+    return sequence_shard_map(
+        body, mesh, qs, ks, v, coeffs,
+        dropout_rng=dropout_rng if use_drop else None,
     )
-    return inner(qs, ks, v, coeffs)
