@@ -171,6 +171,19 @@ def _check_pipeline_cfg(model_cfg: ModelConfig, mesh: Mesh) -> int:
             "'xla' if attention-math sharding matters here",
             stacklevel=3,
         )
+    if mesh.shape.get("sequence", 1) != 1:
+        import warnings
+
+        warnings.warn(
+            "under pipeline parallelism the sequence axis is GSPMD-SP only: "
+            f"sequence_impl={model_cfg.sequence_impl!r} (the ring / ulysses "
+            "schedules own their own shard_map and cannot nest inside the "
+            "pipeline's) is IGNORED here — activations shard their T dim and "
+            "GSPMD inserts the K/V all-gather inside dense attention instead. "
+            "Drop --pipeline-parallel if the ring/ulysses schedule itself "
+            "matters",
+            stacklevel=3,
+        )
     if mesh.shape.get("fsdp", 1) != 1:
         import warnings
 

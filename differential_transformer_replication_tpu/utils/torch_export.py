@@ -47,7 +47,21 @@ from differential_transformer_replication_tpu.config import ModelConfig
 def _t(a):
     import torch
 
-    return torch.tensor(np.asarray(a, dtype=np.float32))
+    if not hasattr(a, "dtype"):
+        # Python scalar constants built here (lambda_init values, the 0.8
+        # buffer) — not param leaves; np.asarray would type them float64
+        return torch.tensor(np.float32(a))
+    a = np.asarray(a)
+    # Exporting is a parity surface: the reference's state_dicts are fp32,
+    # and so are this framework's params (config.py:param_dtype). A non-fp32
+    # leaf here means the params came from somewhere unexpected (e.g. a
+    # future bf16-saved checkpoint) — fail loud rather than silently upcast.
+    if a.dtype != np.float32:
+        raise TypeError(
+            f"expected float32 params (param_dtype), got {a.dtype}; cast "
+            "explicitly before export if the rewrite is intended"
+        )
+    return torch.tensor(a)
 
 
 def _lin(out: dict, prefix: str, p: dict) -> None:
