@@ -204,12 +204,40 @@ def flash_bh_fn(
     def _fn():
         from differential_transformer_replication_tpu.ops.flash import (
             multi_stream_flash_attention_bh,
+            multi_stream_flash_attention_tm,
+            use_tm,
         )
         from differential_transformer_replication_tpu.ops.rope import apply_rope
 
         B, T, E = x.shape
         S, _, H, d = wq.shape
         dv = wv.shape[-1]
+        rate_live = dropout_rate if rng is not None else 0.0
+        if use_tm(S, T, rate_live):
+            # TOKEN-MAJOR fast path (ops/flash.py tm kernels): each
+            # projection's matmul output feeds the kernel after a pure
+            # reshape — no (B,T,H,d)->(B,H,T,d) transposes fwd or bwd, and
+            # the (B,T,H,dv) output keeps the GroupLayerNorm reduce and
+            # the out-projection contiguous (round-4 profile: ~660 MB/step
+            # of HBM transpose copies + a 4.5 ms strided stat reduce on
+            # the head-major path at recipe scale)
+            wq_c = wq.astype(x.dtype)
+            wk_c = wk.astype(x.dtype)
+            qs = tuple(
+                (x @ wq_c[s].reshape(E, H * d)).reshape(B, T, H, d)
+                for s in range(S)
+            )
+            ks = tuple(
+                (x @ wk_c[s].reshape(E, H * d)).reshape(B, T, H, d)
+                for s in range(S)
+            )
+            v_tm = (x @ wv.astype(x.dtype).reshape(E, H * dv)).reshape(
+                B, T, H, dv
+            )
+            if cos is not None:
+                qs = tuple(apply_rope(q, cos, sin, headed=True) for q in qs)
+                ks = tuple(apply_rope(k, cos, sin, headed=True) for k in ks)
+            return multi_stream_flash_attention_tm(qs, ks, v_tm, coeffs, B, H)
         q_r = jnp.einsum("bte,sehd->bhstd", x, wq.astype(x.dtype)).reshape(
             B * H, S, T, d
         )

@@ -1809,6 +1809,430 @@ def multi_stream_flash_attention_bh(
     return _flash(q_r, k_r, v_r, c_r, seed, blocks, interpret, rate)
 
 
+# ---------------------------------------------------------------------------
+# Token-major (tm) kernels: per-stream (B, T, H, d) operands in and
+# (B, T, H, dv) out — the PROJECTION-NATIVE layout.
+#
+# The head-major entry above needs its operands as (BH, S, T, d), but a
+# projection matmul physically produces token-major data: x @ W is
+# (B, T, H*d), and the transpose to head-major is a materialized XLA copy
+# (~660 MB/step HBM->HBM at recipe scale, per-op profile round 4). Worse,
+# the head-major ATTENTION OUTPUT makes the downstream GroupLayerNorm
+# reduce over a strided concat dim (measured 4.5 ms/step of stat reduces
+# alone) and the out-projection re-transpose. These kernels instead read
+# per-stream token-major arrays directly via squeezed BlockSpec dims
+# (block (None, bq, None, d) on a (B, T, H, d) array -> a clean (bq, d)
+# VMEM tile DMA'd with an H*d row stride) and write the output token-major,
+# so the whole attention block — projections, kernel, GLN, out-proj, and
+# every gradient — runs transpose-free.
+#
+# Scope (use_tm): the recipe-hot region only — dropout 0.0, T small enough
+# for the additive-bias resident forward AND the fused whole-T backward
+# (S*T*T <= _FUSED_BWD_BUDGET). Everything else (long context, dropout,
+# ring chunks) stays on the head-major path; callers dispatch via use_tm.
+# ---------------------------------------------------------------------------
+
+
+def use_tm(S: int, T: int, rate: float) -> bool:
+    """True when the token-major kernels cover this config: no attention
+    dropout (the tm kernels drop the counter-based mask machinery), the
+    resident additive-bias forward applies, and the whole-T fused backward
+    fits its score-matrix budget."""
+    return rate == 0.0 and T <= _BIAS_MAX_T and _use_fused_bwd(S, T)
+
+
+def _tm_bias(T: int) -> jnp.ndarray:
+    """bf16 additive causal mask for the tm kernels — half the VMEM of the
+    fp32 :func:`causal_bias` (the kernels upcast when adding to the fp32
+    scores; bf16 rounds NEG_INF to ~-1.0e30, still an exact zero after
+    exp)."""
+    return causal_bias(T, 0).astype(jnp.bfloat16)
+
+
+def _tm_fwd_kernel(
+    *refs,
+    S: int,
+    H: int,
+    save_residuals: bool,
+):
+    """Single-pass (full-T) forward over token-major refs, one program
+    per (batch row, q block), all H heads in-program.
+
+    refs: q_0..q_{S-1} (bq, H*d) | k_0..k_{S-1} (T, H*d) | v (T, H*dv) |
+    bias (bq, T) bf16 | c (BH, S) SMEM | out (bq, H*dv)
+    [| oall (H, S, bq, dv), lse (bq, H*S) when save_residuals].
+
+    The head dim rides FLATTENED into the lane dim (one lane slice per
+    head) because Mosaic rejects sublane-strided stores of converted
+    (f32 -> bf16) values — the (bq, H, d) mid-dim form fails with
+    "infer-vector-layout: unsupported shape cast" at the output store,
+    while lane slicing + a single concatenated store compiles (probed on
+    v5e, round 4). The (head, stream) loops are statically unrolled —
+    each iteration is a plain (bq, d) x (T, d) attention. K is full-T
+    resident and T <= _BIAS_MAX_T, so the softmax needs no online block
+    loop: one (bq, T) fp32 score pass per (head, stream). lse packs
+    (head, stream) into ITS lane dim too ((bq, H*S), column h*S + s) —
+    the (H, bq, S) form pads S=2 lanes to 128 and wastes ~1 MB of VMEM
+    per buffer."""
+    q_refs, refs = refs[:S], refs[S:]
+    k_refs, refs = refs[:S], refs[S:]
+    v_ref, bias_ref, c_ref, *outs = refs
+    d = q_refs[0].shape[-1] // H
+    dv = v_ref.shape[-1] // H
+    b = pl.program_id(0)
+    scale = 1.0 / math.sqrt(d)
+    bias = bias_ref[...].astype(jnp.float32)  # (bq, T)
+
+    out_ref = outs[0]
+    out_cols = []
+    lse_cols = []
+    for h in range(H):
+        v_h = v_ref[:, h * dv : (h + 1) * dv]  # (T, dv)
+        combined = None
+        for s_i in range(S):
+            q_h = q_refs[s_i][:, h * d : (h + 1) * d]  # (bq, d)
+            k_h = k_refs[s_i][:, h * d : (h + 1) * d]  # (T, d)
+            sm = jax.lax.dot_general(
+                q_h, k_h,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale + bias  # (bq, T) f32
+            m = jnp.max(sm, axis=-1, keepdims=True)  # (bq, 1)
+            p = jnp.exp(sm - m)
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            l_safe = jnp.maximum(l, 1e-30)
+            pv = jax.lax.dot_general(
+                p.astype(v_h.dtype), v_h,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (bq, dv)
+            o_sh = pv / l_safe
+            c_sh = c_ref[b * H + h, s_i]
+            combined = (
+                o_sh * c_sh if combined is None else combined + o_sh * c_sh
+            )
+            if save_residuals:
+                oall_ref = outs[1]
+                oall_ref[h, s_i] = o_sh.astype(oall_ref.dtype)
+                lse_cols.append(m + jnp.log(l_safe))  # (bq, 1)
+        out_cols.append(combined.astype(out_ref.dtype))
+    out_ref[...] = jnp.concatenate(out_cols, axis=1)  # (bq, H*dv)
+    if save_residuals:
+        lse_ref = outs[2]
+        lse_ref[...] = jnp.concatenate(lse_cols, axis=1)  # (bq, H*S) f32
+
+
+def _tm_fwd_call(
+    qs, ks, v, coeffs, *, H: int, block_q: int, save_residuals: bool,
+    interpret: bool
+):
+    """qs/ks: tuples of S (B, T, H*d) arrays (raw projection outputs);
+    v (B, T, H*dv); coeffs (B*H, S) fp32; ``H`` static. Returns
+    (out (B, T, H*dv) [, oall (B, H, S, T, dv), lse (B, T, H*S)])."""
+    S = len(qs)
+    B, T, Hd = qs[0].shape
+    d = Hd // H
+    dv = v.shape[-1] // H
+    BH = B * H
+    block_q = _pick_block(block_q, T)
+    nq = T // block_q
+
+    qspec = pl.BlockSpec(
+        (None, block_q, H * d), lambda b, i: (b, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kspec = pl.BlockSpec(
+        (None, T, H * d), lambda b, i: (b, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [qspec] * S + [kspec] * S + [
+        pl.BlockSpec(
+            (None, T, H * dv), lambda b, i: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec((block_q, T), lambda b, i: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((BH, S), lambda b, i: (0, 0),
+                     memory_space=pltpu.SMEM),
+    ]
+    out_shapes = [jax.ShapeDtypeStruct((B, T, H * dv), qs[0].dtype)]
+    out_specs = [
+        pl.BlockSpec(
+            (None, block_q, H * dv), lambda b, i: (b, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+    ]
+    if save_residuals:
+        out_shapes += [
+            jax.ShapeDtypeStruct((B, H, S, T, dv), qs[0].dtype),
+            jax.ShapeDtypeStruct((B, T, H * S), jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec(
+                (None, H, S, block_q, dv),
+                lambda b, i: (b, 0, 0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (None, block_q, H * S), lambda b, i: (b, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+    results = pl.pallas_call(
+        functools.partial(
+            _tm_fwd_kernel, S=S, H=H, save_residuals=save_residuals
+        ),
+        grid=(B, nq),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+            vmem_limit_bytes=28 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(*qs, *ks, v, _tm_bias(T), coeffs.astype(jnp.float32))
+    if save_residuals:
+        return results
+    return results[0], None, None
+
+
+def _tm_bwd_kernel(*refs, S: int, H: int, s_list: tuple):
+    """Whole-T backward for the streams in ``s_list`` over token-major
+    refs, one program per batch row — the factored math of
+    :func:`_bwd_fused_kernel` (dP and dV from the SHARED upstream grad g
+    scaled by the SMEM coefficients), statically unrolled over heads and
+    the listed streams. With all streams in one call the g V^T matmul
+    runs once per head (the fully-fused form; needs the raised
+    vmem_limit_bytes in _tm_bwd_call); per-stream calls are the
+    small-VMEM fallback — each stream's softmax recompute (the exp
+    floor) happens exactly once either way.
+
+    refs: q_s (T, H*d) per listed stream | k_s likewise | v (T, H*dv) |
+    g (T, H*dv) | lse (T, H*S) | delta (T, H*S) | c (BH, S) SMEM |
+    bias (T, T) bf16 | dq_s per stream | dk_s per stream | dv (T, H*dv).
+    Heads are lane slices; each output is stored once as a lane concat
+    (see _tm_fwd_kernel on why the mid-dim form cannot store)."""
+    ns = len(s_list)
+    q_refs, refs = refs[:ns], refs[ns:]
+    k_refs, refs = refs[:ns], refs[ns:]
+    (v_ref, g_ref, lse_ref, delta_ref, c_ref, bias_ref, *outs) = refs
+    dq_refs, dk_refs, dv_ref = outs[:ns], outs[ns : 2 * ns], outs[2 * ns]
+    d = q_refs[0].shape[-1] // H
+    dv = v_ref.shape[-1] // H
+    b = pl.program_id(0)
+    scale = 1.0 / math.sqrt(d)
+    bias = bias_ref[...].astype(jnp.float32)  # (T, T)
+
+    dq_cols = [[] for _ in s_list]
+    dk_cols = [[] for _ in s_list]
+    dv_cols = []
+    for h in range(H):
+        v_h = v_ref[:, h * dv : (h + 1) * dv]  # (T, dv)
+        g_h = g_ref[:, h * dv : (h + 1) * dv]  # (T, dv)
+        gv = jax.lax.dot_general(
+            g_h, v_h,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (T, T) f32 — once per head, shared by every listed stream
+        dv_h = None
+        for j, s_idx in enumerate(s_list):
+            col = h * S + s_idx
+            lse_h = lse_ref[:, col : col + 1]  # (T, 1) f32
+            delta_h = delta_ref[:, col : col + 1]  # (T, 1) f32
+            q_h = q_refs[j][:, h * d : (h + 1) * d]  # (T, d)
+            k_h = k_refs[j][:, h * d : (h + 1) * d]
+            sm = jax.lax.dot_general(
+                q_h, k_h,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale + bias
+            p = jnp.exp(sm - lse_h)  # (T, T)
+            c_sh = c_ref[b * H + h, s_idx]
+            ds = (p * (gv * c_sh - delta_h)).astype(q_h.dtype)
+            dq_cols[j].append(
+                (
+                    jax.lax.dot_general(
+                        ds, k_h,
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    ) * scale
+                ).astype(dq_refs[j].dtype)
+            )
+            dk_cols[j].append(
+                (
+                    jax.lax.dot_general(
+                        ds, q_h,
+                        dimension_numbers=(((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    ) * scale
+                ).astype(dk_refs[j].dtype)
+            )
+            pc = p * c_sh
+            dv_h = pc if dv_h is None else dv_h + pc
+        dv_cols.append(
+            jax.lax.dot_general(
+                dv_h.astype(g_h.dtype), g_h,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(dv_ref.dtype)
+        )
+    for j in range(ns):
+        dq_refs[j][...] = jnp.concatenate(dq_cols[j], axis=1)
+        dk_refs[j][...] = jnp.concatenate(dk_cols[j], axis=1)
+    dv_ref[...] = jnp.concatenate(dv_cols, axis=1)
+
+
+def _tm_bwd_call(qs, ks, v, g, lse, delta, coeffs, *, H: int, interpret: bool):
+    """qs/ks/v/g: flat (B, T, H*width); lse/delta: (B, T, H*S) fp32.
+    All streams in ONE pallas call (the g V^T matmul then runs once per
+    head): the call raises the kernel's scoped-VMEM budget via
+    vmem_limit_bytes — the recipe-shape footprint is ~17-18 MB against
+    the 16 MB default (measured round 4), comfortably inside v5e's
+    physical VMEM. Returns per-stream flat token-major (dqs, dks, dv)."""
+    S = len(qs)
+    B, T, Hd = qs[0].shape
+    Hdv = v.shape[-1]
+    BH = B * H
+
+    qspec = pl.BlockSpec(
+        (None, T, Hd), lambda b: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    vspec = pl.BlockSpec(
+        (None, T, Hdv), lambda b: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    stspec = pl.BlockSpec(
+        (None, T, H * S), lambda b: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    results = pl.pallas_call(
+        functools.partial(
+            _tm_bwd_kernel, S=S, H=H, s_list=tuple(range(S))
+        ),
+        grid=(B,),
+        in_specs=[qspec] * S + [qspec] * S + [
+            vspec, vspec, stspec, stspec,
+            pl.BlockSpec((BH, S), lambda b: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((T, T), lambda b: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[qspec] * S + [qspec] * S + [vspec],
+        out_shape=(
+            [jax.ShapeDtypeStruct((B, T, Hd), qs[0].dtype)] * S
+            + [jax.ShapeDtypeStruct((B, T, Hd), qs[0].dtype)] * S
+            + [jax.ShapeDtypeStruct((B, T, Hdv), v.dtype)]
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+            vmem_limit_bytes=28 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(*qs, *ks, v, g, lse, delta, coeffs.astype(jnp.float32), _tm_bias(T))
+    dqs = tuple(results[:S])
+    dks = tuple(results[S : 2 * S])
+    return dqs, dks, results[2 * S]
+
+
+# Training-forward q-block rows. The residual-saving forward carries
+# oall + lse blocks on top of the compute blocks; at the recipe shape the
+# 512-row block needs ~18 MB of scoped VMEM (measured round 4), which
+# only fits because BOTH tm pallas_calls raise vmem_limit_bytes to 28 MB
+# (~1/4 of v5e's 128 MB physical VMEM — the 16 MB default is
+# conservative). If that limit is ever lowered back, this must drop to
+# 256 or the recipe-shape compile fails with a Mosaic VMEM overflow.
+# 512 measured ~0.5% faster end-to-end than 256 (fewer programs, one
+# bias stripe).
+_TM_TRAIN_BLOCK_Q = 512
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_tm(qs, ks, v, coeffs, blocks, interpret):
+    H = coeffs.shape[0] // qs[0].shape[0]
+    out, _, _ = _tm_fwd_call(
+        qs, ks, v, coeffs,
+        H=H, block_q=blocks[0], save_residuals=False, interpret=interpret,
+    )
+    return out
+
+
+def _flash_tm_fwd(qs, ks, v, coeffs, blocks, interpret):
+    H = coeffs.shape[0] // qs[0].shape[0]
+    out, o_all, lse = _tm_fwd_call(
+        qs, ks, v, coeffs,
+        H=H, block_q=blocks[2], save_residuals=True, interpret=interpret,
+    )
+    return out, (qs, ks, v, coeffs, o_all, lse)
+
+
+def _flash_tm_bwd(blocks, interpret, res, g):
+    qs, ks, v, coeffs, o_all, lse = res
+    B, H, S, T, dv = o_all.shape
+    g32 = g.astype(jnp.float32).reshape(B, T, H, dv)
+    # base[b,t,h,s] = <g_t, O_s,t>; delta_s = c_s * base; dcoeffs = sum_t
+    # (see _flash_bwd — identical residual algebra, token-major g and a
+    # flat (B, T, H*S) stat layout matching lse, so the kernel reads
+    # per-(head, stream) columns without a transpose)
+    base = jnp.einsum("bthd,bhstd->bths", g32, o_all.astype(jnp.float32))
+    dcoeffs = base.sum(1).reshape(B * H, S)
+    delta = (
+        base * coeffs.astype(jnp.float32).reshape(B, 1, H, S)
+    ).reshape(B, T, H * S)
+    dqs, dks, dv_grad = _tm_bwd_call(
+        qs, ks, v, g.astype(qs[0].dtype), lse, delta, coeffs,
+        H=H, interpret=interpret,
+    )
+    return dqs, dks, dv_grad, dcoeffs.astype(coeffs.dtype)
+
+
+_flash_tm.defvjp(_flash_tm_fwd, _flash_tm_bwd)
+
+
+def multi_stream_flash_attention_tm(
+    qs, ks, v: jnp.ndarray, coeffs: jnp.ndarray, B: int, H: int,
+    *,
+    block_q: Optional[int] = None,
+    block_q_train: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Token-major entry: ``qs``/``ks`` are tuples of S ``(B, T, H, d)``
+    arrays (each the RESHAPED output of its own projection matmul — no
+    transpose anywhere), ``v`` is ``(B, T, H, dv)``; returns
+    ``(B, T, H, dv)``. The kernels run on the flat ``(B, T, H*width)``
+    forms (all reshapes here are free row-major bitcasts). Callers must
+    check :func:`use_tm` first; ineligible configs belong on
+    :func:`multi_stream_flash_attention_bh`."""
+    if interpret is None:
+        interpret = _auto_interpret()
+    S = len(qs)
+    _, T, _, d = qs[0].shape
+    dv = v.shape[-1]
+    assert use_tm(S, T, 0.0), (
+        f"tm kernels do not cover S={S}, T={T}; dispatch via use_tm"
+    )
+    dq, _, dqt, _ = default_blocks()
+    blocks = (
+        _pick_block(block_q if block_q is not None else dq, T),
+        0,
+        _pick_block(
+            block_q_train
+            if block_q_train is not None
+            else min(dqt, _TM_TRAIN_BLOCK_Q),
+            T,
+        ),
+        0,
+    )
+    c_r = jnp.broadcast_to(
+        coeffs.astype(jnp.float32).T[None], (B, H, S)
+    ).reshape(B * H, S)
+    out = _flash_tm(
+        tuple(q.reshape(B, T, H * d) for q in qs),
+        tuple(k.reshape(B, T, H * d) for k in ks),
+        v.reshape(B, T, H * dv),
+        c_r, blocks, interpret,
+    )
+    return out.reshape(B, T, H, dv)
+
+
 def flash_vanilla_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, **kw
 ) -> jnp.ndarray:

@@ -167,7 +167,14 @@ def _dense_primal(h, w, b, targets):
         logits = logits + b.astype(h.dtype)
     l32 = logits.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(l32, axis=-1)
-    tgt = jnp.take_along_axis(l32, targets[..., None], axis=-1)[..., 0]
+    # gather from the stored-dtype logits and upcast AFTER (identical
+    # values — l32 is itself a convert of ``logits``): leaves the reduces
+    # as l32's only consumers, so the convert fuses into them instead of
+    # materializing a full fp32 (B, T, V) copy (786 MB at recipe scale;
+    # profiled as a ~1.8 ms fusion output on v5e, round 4)
+    tgt = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1
+    )[..., 0].astype(jnp.float32)
     return jnp.mean(lse - tgt), logits, lse
 
 
@@ -179,6 +186,7 @@ def _dense_fwd(h, w, b, targets):
 def _dense_bwd(res, g):
     h, w, b, logits, lse, targets = res
     n = logits.size // logits.shape[-1]
+    V = logits.shape[-1]
     p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
     iota = jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
     d32 = (p - (iota == targets[..., None]).astype(jnp.float32)) * (g / n)
@@ -195,7 +203,17 @@ def _dense_bwd(res, g):
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=h.dtype,
     ).reshape(h.shape)
-    db = None if b is None else jnp.sum(d32, axis=tuple(range(d32.ndim - 1))).astype(b.dtype)
+    if b is None:
+        db = None
+    else:
+        # sum_n d32[n, v] decomposed as (column-sums of p) - (target
+        # counts): identical math (sum of p - onehot), but the reduce
+        # fuses into the pass that produces ``d`` instead of forcing a
+        # separate fp32 (N, V) materialization of d32 — profiled ~1.8
+        # ms/step of pure HBM traffic at the recipe scale on v5e (r4)
+        counts = jnp.zeros((V,), jnp.float32).at[targets.reshape(-1)].add(1.0)
+        colsum = jnp.sum(p, axis=tuple(range(p.ndim - 1)))
+        db = ((colsum - counts) * (g / n)).astype(b.dtype)
     d_targets = jnp.zeros(targets.shape, jax.dtypes.float0)
     return dh, dw, db, d_targets
 

@@ -286,3 +286,142 @@ def test_bwd_tiled_below_fwd_threshold(monkeypatch):
     )
     for r, g in zip(g_ref, g_got):
         np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-4)
+
+
+class TestTokenMajor:
+    """The token-major (tm) kernels (ops/flash.py): per-stream (B, T, H, d)
+    in, (B, T, H, dv) out — the projection-native layout the recipe-scale
+    train step runs on (round 4). Parity vs the dense XLA ops."""
+
+    def _diff_inputs(self, seed=7):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 6)
+        q1, k1, q2, k2 = (_rand(kk, B, T, H, D) for kk in ks[:4])
+        v = _rand(ks[4], B, T, H, 2 * D)
+        lam = jnp.array([0.2, 0.47], jnp.float32)
+        return q1, k1, q2, k2, v, lam
+
+    def test_use_tm_envelope(self):
+        from differential_transformer_replication_tpu.ops import flash
+
+        assert flash.use_tm(2, 512, 0.0)  # the flagship recipe point
+        assert flash.use_tm(1, 512, 0.0)  # control
+        assert not flash.use_tm(4, 512, 0.0)  # ndiff: over the fused budget
+        assert not flash.use_tm(2, 512, 0.1)  # dropout stays head-major
+        assert not flash.use_tm(1, 2048, 0.0)  # past the bias-resident max
+
+    def test_diff_parity_tm(self):
+        from differential_transformer_replication_tpu.ops.flash import (
+            multi_stream_flash_attention_tm,
+        )
+        from differential_transformer_replication_tpu.ops.streams import (
+            diff_coeffs,
+        )
+
+        q1, k1, q2, k2, v, lam = self._diff_inputs()
+        ref = diff_attention(q1, k1, q2, k2, v, lam, mask=causal_mask(T))
+        got = multi_stream_flash_attention_tm(
+            (q1, q2), (k1, k2), v, diff_coeffs(lam), B, H
+        )
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_vanilla_parity_tm(self):
+        from differential_transformer_replication_tpu.ops.flash import (
+            multi_stream_flash_attention_tm,
+        )
+        from differential_transformer_replication_tpu.ops.streams import (
+            vanilla_coeffs,
+        )
+
+        ks = jax.random.split(jax.random.PRNGKey(11), 3)
+        q, k, v = (_rand(kk, B, T, H, D) for kk in ks)
+        ref = vanilla_attention(q, k, v, mask=causal_mask(T))
+        got = multi_stream_flash_attention_tm(
+            (q,), (k,), v, vanilla_coeffs(H), B, H
+        )
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_diff_grad_parity_tm(self):
+        from differential_transformer_replication_tpu.ops.flash import (
+            multi_stream_flash_attention_tm,
+        )
+        from differential_transformer_replication_tpu.ops.streams import (
+            diff_coeffs,
+        )
+
+        q1, k1, q2, k2, v, lam = self._diff_inputs(seed=13)
+
+        def loss_ref(q1, k1, q2, k2, v, lam):
+            out = diff_attention(q1, k1, q2, k2, v, lam, mask=causal_mask(T))
+            return jnp.sum(out * jnp.cos(out))
+
+        def loss_tm(q1, k1, q2, k2, v, lam):
+            out = multi_stream_flash_attention_tm(
+                (q1, q2), (k1, k2), v, diff_coeffs(lam), B, H
+            )
+            return jnp.sum(out * jnp.cos(out))
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4, 5))(
+            q1, k1, q2, k2, v, lam
+        )
+        g_got = jax.grad(loss_tm, argnums=(0, 1, 2, 3, 4, 5))(
+            q1, k1, q2, k2, v, lam
+        )
+        for r, g in zip(g_ref, g_got):
+            np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-4)
+
+    def test_bh_fn_routes_tm_and_matches_dense(self):
+        """flash_bh_fn's tm branch (models/common.py) end to end: same
+        closure the model families install, eligible shape, vs the dense
+        path on identical projections."""
+        from differential_transformer_replication_tpu.models import common
+        from differential_transformer_replication_tpu.ops.streams import (
+            diff_coeffs,
+        )
+
+        E, d = 32, D
+        ks = jax.random.split(jax.random.PRNGKey(17), 4)
+        x = _rand(ks[0], B, T, E)
+        wq = _rand(ks[1], 2, E, H, d) * 0.2
+        wk = _rand(ks[2], 2, E, H, d) * 0.2
+        wv = _rand(ks[3], E, H, 2 * d) * 0.2
+        lam = jnp.array([0.3, 0.5], jnp.float32)
+        coeffs = diff_coeffs(lam)
+        got = common.flash_bh_fn(
+            x, wq, wk, wv, coeffs, dropout_rate=0.0, rng=None
+        )()
+        q1, q2 = (jnp.einsum("bte,ehd->bthd", x, wq[s]) for s in range(2))
+        k1, k2 = (jnp.einsum("bte,ehd->bthd", x, wk[s]) for s in range(2))
+        v = jnp.einsum("bte,ehd->bthd", x, wv)
+        ref = diff_attention(q1, k1, q2, k2, v, lam, mask=causal_mask(T))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_bh_fn_tm_with_rope_matches_dense(self):
+        """The tm branch with LIVE RoPE tables — the path every
+        recipe-scale control run takes (control.py:94 passes cos/sin,
+        S=1, T<=512): rotation in the (B, T, H, d) headed layout must
+        match rotating the dense path's projections."""
+        from differential_transformer_replication_tpu.models import common
+        from differential_transformer_replication_tpu.ops.rope import (
+            apply_rope,
+            rope_cos_sin,
+        )
+        from differential_transformer_replication_tpu.ops.streams import (
+            vanilla_coeffs,
+        )
+
+        E, d = 32, D
+        ks = jax.random.split(jax.random.PRNGKey(19), 4)
+        x = _rand(ks[0], B, T, E)
+        wq = _rand(ks[1], 1, E, H, d) * 0.2
+        wk = _rand(ks[2], 1, E, H, d) * 0.2
+        wv = _rand(ks[3], E, H, d) * 0.2
+        cos, sin = rope_cos_sin(d, T)
+        got = common.flash_bh_fn(
+            x, wq, wk, wv, vanilla_coeffs(H),
+            dropout_rate=0.0, rng=None, cos=cos, sin=sin,
+        )()
+        q = apply_rope(jnp.einsum("bte,ehd->bthd", x, wq[0]), cos, sin)
+        k = apply_rope(jnp.einsum("bte,ehd->bthd", x, wk[0]), cos, sin)
+        v = jnp.einsum("bte,ehd->bthd", x, wv)
+        ref = vanilla_attention(q, k, v, mask=causal_mask(T))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
