@@ -51,15 +51,27 @@ def _site_packages() -> str:
 
 SITE = _site_packages()
 
-def _discover_packages() -> tuple:
-    """Every REGULAR top-level package directory in site-packages (has an
-    __init__.py) — namespace packages and single-file modules are
-    skipped, which is fine for a corpus: the big scientific libraries
-    that dominate by volume are all regular packages."""
+def _discover_packages(base=None) -> tuple:
+    """Every REGULAR top-level package directory under ``base`` (default
+    site-packages; has an __init__.py) — namespace packages and
+    single-file modules are skipped, which is fine for a corpus: the big
+    scientific libraries that dominate by volume are all regular
+    packages. For stdlib roots (no __init__.py convention differences)
+    any directory with .py files qualifies."""
+    loose_ok = base is not None and base != SITE  # stdlib roots only
+    base = base or SITE
     pkgs = []
-    for name in sorted(os.listdir(SITE)):
-        d = os.path.join(SITE, name)
-        if os.path.isdir(d) and os.path.exists(os.path.join(d, "__init__.py")):
+    for name in sorted(os.listdir(base)):
+        if loose_ok and name in ("site-packages", "dist-packages"):
+            # a stdlib root (…/lib/python3.X) CONTAINS site-packages;
+            # harvesting it again here would double-read gigabytes and
+            # mislabel its provenance as stdlib
+            continue
+        d = os.path.join(base, name)
+        if os.path.isdir(d) and (
+            os.path.exists(os.path.join(d, "__init__.py"))
+            or (loose_ok and glob.glob(os.path.join(d, "*.py")))
+        ):
             pkgs.append(name)
     return tuple(pkgs)
 
@@ -173,36 +185,167 @@ def harvest_docs(corpus: Corpus) -> None:
                 continue
 
 
-def harvest_docstrings(corpus: Corpus, packages=None) -> None:
-    for pkg in packages if packages is not None else _discover_packages():
-        root = os.path.join(SITE, pkg)
+def harvest_docstrings(corpus: Corpus, packages=None, root_dir=None, tag="") -> None:
+    """Docstrings AND source-comment prose from every .py under each
+    package of ``root_dir`` (default: site-packages). Comments (runs of
+    full-line ``#`` lines, markers stripped) are genuine technical
+    English at ~1-3% of source volume — across the ~5.7 GB of installed
+    Python they roughly double the harvest (round-4 corpus extension,
+    VERDICT r3 item 4)."""
+    base = root_dir or SITE
+    targets = list(
+        packages if packages is not None else _discover_packages(base)
+    )
+    if root_dir is not None and packages is None:
+        # stdlib roots keep most of their docstring prose in SINGLE-FILE
+        # top-level modules (argparse.py, typing.py, ...), not packages —
+        # harvest them as one pseudo-package
+        targets.append(".")
+    for pkg in targets:
+        root = os.path.join(base, pkg)
         if not os.path.isdir(root):
             continue
-        for path in sorted(
-            glob.glob(os.path.join(root, "**", "*.py"), recursive=True)
-        ):
+        pattern = (
+            os.path.join(root, "*.py")
+            if pkg == "."
+            else os.path.join(root, "**", "*.py")
+        )
+        for path in sorted(glob.glob(pattern, recursive=True)):
             try:
                 with open(path, encoding="utf-8", errors="ignore") as f:
-                    tree = ast.parse(f.read())
-            except (OSError, SyntaxError, ValueError):
+                    src = f.read()
+            except OSError:
                 continue
+            try:
+                tree = ast.parse(src)
+            except (SyntaxError, ValueError):
+                tree = None
             chunks = []
-            for node in ast.walk(tree):
-                if isinstance(
-                    node,
-                    (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
-                ):
-                    ds = ast.get_docstring(node, clean=True)
-                    if ds:
-                        chunks.append(ds)
+            if tree is not None:
+                for node in ast.walk(tree):
+                    if isinstance(
+                        node,
+                        (ast.Module, ast.ClassDef, ast.FunctionDef,
+                         ast.AsyncFunctionDef),
+                    ):
+                        ds = ast.get_docstring(node, clean=True)
+                        if ds:
+                            chunks.append(ds)
             if chunks:
-                corpus.add_document("\n\n".join(chunks), f"docstrings:{pkg}")
+                corpus.add_document(
+                    "\n\n".join(chunks), f"docstrings{tag}:{pkg}"
+                )
+            comments = _comment_blocks_py(src)
+            if comments:
+                corpus.add_document(comments, f"py_comments{tag}:{pkg}")
+
+
+_PY_COMMENT = re.compile(r"^\s*#\s?(.*)$")
+
+
+def _comment_blocks_py(src: str) -> str:
+    """Runs of full-line ``#`` comments as blank-line-separated blocks,
+    markers stripped (shebangs, coding cookies, and linter pragmas fall
+    out in _prose_line's code-shape filter downstream)."""
+    blocks, cur = [], []
+    for raw in src.splitlines():
+        m = _PY_COMMENT.match(raw)
+        if m:
+            cur.append(m.group(1))
+        else:
+            if cur:
+                blocks.append("\n".join(cur))
+                cur = []
+    if cur:
+        blocks.append("\n".join(cur))
+    return "\n\n".join(blocks)
+
+
+_C_BLOCK = re.compile(r"/\*(.*?)\*/", re.S)
+_C_LINE = re.compile(r"^\s*//[/!]?\s?(.*)$")
+_C_STAR = re.compile(r"^\s*\*+\s?")
+_C_EXTS = (".h", ".hpp", ".hh", ".cc", ".cpp", ".cu", ".cuh", ".proto")
+
+
+def harvest_c_comments(corpus: Corpus, root_dir=None) -> None:
+    """Comment prose from the C/C++/CUDA/proto sources the image's
+    Python packages bundle (torch/include, tensorflow/include, ... —
+    ~500 MB of headers whose /* doc blocks */ and // line runs are real
+    API documentation English)."""
+    base = root_dir or SITE
+    for pkg in _discover_packages(base):
+        root = os.path.join(base, pkg)
+        paths = [
+            p
+            for ext in _C_EXTS
+            for p in glob.glob(
+                os.path.join(root, "**", f"*{ext}"), recursive=True
+            )
+        ]
+        if not paths:
+            continue
+        for path in sorted(paths):
+            try:
+                with open(path, encoding="utf-8", errors="ignore") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            blocks = []
+            for m in _C_BLOCK.finditer(src):
+                body = "\n".join(
+                    _C_STAR.sub("", line) for line in m.group(1).splitlines()
+                )
+                blocks.append(body)
+            cur = []
+            for raw in src.splitlines():
+                lm = _C_LINE.match(raw)
+                if lm:
+                    cur.append(lm.group(1))
+                else:
+                    if cur:
+                        blocks.append("\n".join(cur))
+                        cur = []
+            if cur:
+                blocks.append("\n".join(cur))
+            if blocks:
+                corpus.add_document(
+                    "\n\n".join(blocks), f"c_comments:{pkg}"
+                )
+
+
+def harvest_share_doc(corpus: Corpus, root="/usr/share/doc") -> None:
+    """Debian package docs: README/changelog/NEWS prose (gzipped or
+    plain). License boilerplate repeats across packages and dies in the
+    paragraph dedup."""
+    import gzip
+
+    for path in sorted(
+        glob.glob(os.path.join(root, "**", "*"), recursive=True)
+    ):
+        name = os.path.basename(path).lower()
+        if not os.path.isfile(path):
+            continue
+        if not any(
+            name.startswith(p)
+            for p in ("readme", "changelog", "news", "copyright")
+        ):
+            continue
+        try:
+            if name.endswith(".gz"):
+                with gzip.open(path, "rt", encoding="utf-8", errors="ignore") as f:
+                    raw = f.read(4 * 1024 * 1024)
+            else:
+                with open(path, encoding="utf-8", errors="ignore") as f:
+                    raw = f.read(4 * 1024 * 1024)
+        except OSError:
+            continue
+        corpus.add_document(raw, "share_doc")
 
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", default="image_corpus.txt")
-    p.add_argument("--max-mb", type=float, default=64.0,
+    p.add_argument("--max-mb", type=float, default=192.0,
                    help="cap the output size; applied AFTER the shuffle, so "
                         "the cap drops a uniformly random subset of documents "
                         "across all source classes (the per-class stats below "
@@ -220,6 +363,17 @@ def main() -> None:
     harvest_metadata(corpus)
     harvest_docs(corpus)
     harvest_docstrings(corpus)
+    # round-4 extensions (VERDICT r3 item 4): source comments across the
+    # installed Python, the stdlib trees, the bundled C/C++/CUDA headers,
+    # and the Debian doc tree
+    for std_root in sorted(glob.glob("/usr/lib/python3.*")) + sorted(
+        glob.glob(os.path.expanduser("~/.pyenv/versions/*/lib/python3.*"))
+    ):
+        if os.path.isdir(std_root):
+            tag = ":stdlib" + std_root.rsplit("python", 1)[-1]
+            harvest_docstrings(corpus, root_dir=std_root, tag=tag)
+    harvest_c_comments(corpus)
+    harvest_share_doc(corpus)
 
     if args.shuffle_seed >= 0:
         import random
