@@ -164,3 +164,17 @@ def test_two_process_training_matches_single_process(tmp_path):
     with open(os.path.join(workdir, "best.ckpt", "meta.json")) as f:
         meta = json.load(f)
     assert meta["iter_num"] in (2, 4, 6)
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="gloo CPU collectives")
+@pytest.mark.parametrize("leg", ["tensor", "pipeline"])
+def test_two_proc_axis_crossing_legs(leg):
+    """The round-4 dryrun legs where the 2-process boundary cuts the
+    ``tensor`` (Megatron activation all-gather over DCN) or ``pipeline``
+    (GPipe ppermute handoff) mesh axis — structurally different
+    cross-process collectives from the data leg (VERDICT r3 item 5).
+    ``_dryrun_2proc`` spawns both ranks as real OS processes and raises
+    unless both exit 0 with a finite loss."""
+    import __graft_entry__ as g
+
+    g._dryrun_2proc(2, leg)

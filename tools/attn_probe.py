@@ -1,0 +1,254 @@
+"""Mechanistic attention-allocation probe: signal vs noise at a query.
+
+The Differential Transformer paper's §3.3 probe (arXiv:2410.05258): embed
+one NEEDLE sentence carrying an answer span inside a context of distractor
+prose, append a query that asks for the answer, and measure how much
+attention the final query position allocates to the answer span versus the
+distractor context. The paper's claim — the motivation for the whole
+architecture (diff_transformer.py:70: ``att1 - lam*att2``) — is that
+differential attention cancels attention noise: more mass on the answer,
+less on distractors, than a parameter-matched vanilla control. This probe
+measures that claim DIRECTLY on trained checkpoints, independent of
+val-loss regimes (VERDICT r3 item 3: the val-loss signal drowns under
+memorization on the image corpus; attention allocation does not).
+
+Method. For each trial: draw distractor documents from a corpus file,
+splice the needle's token sequence at a controlled depth, end the window
+with the query prefix (the needle sentence minus its answer), and run the
+checkpointed model capturing each layer's attention row at the final
+position. The residual stream itself is advanced by the MODEL'S OWN
+``block_forward`` (models/{control,diff}.py) — the probe only recomputes
+the per-layer attention maps (projection + softmax math mirrored from
+``_attn``; diff maps are the signed ``a1 - lam*a2`` rows). Reported per
+model: the fraction of (absolute) attention row mass on the answer span,
+on the needle sentence, and on the distractor context ("noise"), plus the
+paper's signal-to-noise ratio, averaged over heads and layers and broken
+out by needle depth.
+
+    python tools/attn_probe.py --checkpoint sp_s1337/ppl_gap_diff.ckpt \
+        --tokenizer sp_s1337/tokenizer --corpus image_corpus.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _load_model(ckpt: str):
+    import jax
+
+    from differential_transformer_replication_tpu.config import (
+        ModelConfig,
+        TrainConfig,
+    )
+    from differential_transformer_replication_tpu.train.checkpoint import (
+        load_checkpoint,
+    )
+    from differential_transformer_replication_tpu.train.step import (
+        create_train_state,
+    )
+
+    with open(os.path.join(ckpt, "meta.json")) as f:
+        meta = json.load(f)
+    cd = dict(meta["config"])
+    model_cfg = ModelConfig(**cd.pop("model"))
+    cd.pop("mesh", None)
+    cfg = TrainConfig(model=model_cfg, **cd)
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    state, _ = load_checkpoint(ckpt, cfg, state)
+    return state["params"], cfg.resolved_model()
+
+
+def _attention_rows(params, cfg, idx):
+    """Per-layer signed attention rows of the FINAL position:
+    list of (H, T) float32 arrays, one per layer. The stream advances via
+    the model's own block_forward; only the maps are recomputed here
+    (mirroring models/control.py:_attn and models/diff.py:_attn)."""
+    import jax.numpy as jnp
+
+    from differential_transformer_replication_tpu.models import model_module
+    from differential_transformer_replication_tpu.ops import (
+        apply_rope,
+        causal_mask,
+        rope_cos_sin,
+    )
+    from differential_transformer_replication_tpu.ops.attention import (
+        masked_softmax,
+    )
+    from differential_transformer_replication_tpu.ops.lambdas import (
+        diff_lambda,
+        lambda_init_schedule,
+    )
+    from differential_transformer_replication_tpu.models import common
+
+    mod = model_module(cfg)
+    B, T = idx.shape
+    x = mod.embed(params, idx, cfg)
+    cos, sin = (
+        rope_cos_sin(cfg.head_size, T) if cfg.model != "diff" else (None, None)
+    )
+    mask = causal_mask(T)
+    rows = []
+    for li, blk in enumerate(params["blocks"], 1):
+        xn = common.apply_layer_norm(x, blk["ln1"])
+        p = blk["attn"]
+        scale = 1.0 / math.sqrt(cfg.head_size)
+        if cfg.model == "control":
+            q = jnp.einsum("bte,ehd->bthd", xn, p["wq"].astype(xn.dtype))
+            k = jnp.einsum("bte,ehd->bthd", xn, p["wk"].astype(xn.dtype))
+            q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+            s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+            att = masked_softmax(s, mask)  # (B, H, T, T) f32
+            rows.append(att[:, :, -1, :])
+        elif cfg.model == "diff":
+            qs = jnp.einsum("bte,sehd->sbthd", xn, p["wq"].astype(xn.dtype))
+            ks = jnp.einsum("bte,sehd->sbthd", xn, p["wk"].astype(xn.dtype))
+            lam = diff_lambda(
+                p["lambda_q"][0], p["lambda_k"][0],
+                p["lambda_q"][1], p["lambda_k"][1],
+                lambda_init_schedule(li),
+            )
+            a1 = masked_softmax(
+                jnp.einsum("bthd,bshd->bhts", qs[0], ks[0]) * scale, mask
+            )
+            a2 = masked_softmax(
+                jnp.einsum("bthd,bshd->bhts", qs[1], ks[1]) * scale, mask
+            )
+            att = a1 - lam[None, :, None, None] * a2  # signed map, :70
+            rows.append(att[:, :, -1, :])
+        else:
+            raise SystemExit("probe supports control and diff checkpoints")
+        x = mod.block_forward(x, blk, li, cfg, cos, sin, mask)
+    return rows  # n_layer x (B, H, T)
+
+
+def _build_windows(tok, corpus_lines, block_size, depth, trials, rng):
+    """(tokens (trials, T), spans): each window = distractor prose with the
+    needle spliced at ``depth`` fraction and the query prefix at the end.
+    span = (answer_start, answer_end, needle_start, needle_end, query_start)
+    token indices."""
+    import numpy as np
+
+    answers = ["porcupine", "copper", "lantern", "violet", "harbor",
+               "walnut", "meteor", "saddle", "pepper", "granite"]
+    windows, spans = [], []
+    for t in range(trials):
+        word = answers[t % len(answers)]
+        needle = (
+            f" The secret access code hidden in this report is {word}."
+        )
+        query = " The secret access code hidden in this report is"
+        nd = tok.encode(needle).ids
+        qy = tok.encode(query).ids
+        ans = tok.encode(f" {word}.").ids
+        # answer span = the needle's tail tokens matching the answer word
+        a_len = len(ans)
+        body_budget = block_size - len(nd) - len(qy)
+        pre_n = int(body_budget * depth)
+        pre, post = [], []
+        while len(pre) < pre_n:
+            pre.extend(tok.encode(rng.choice(corpus_lines)).ids)
+        pre = pre[:pre_n]
+        while len(post) < body_budget - pre_n:
+            post.extend(tok.encode(rng.choice(corpus_lines)).ids)
+        post = post[: body_budget - pre_n]
+        toks = pre + nd + post + qy
+        n_start = len(pre)
+        windows.append(np.asarray(toks, np.int32))
+        spans.append(
+            (
+                n_start + len(nd) - a_len,  # answer start
+                n_start + len(nd),  # answer end
+                n_start,
+                n_start + len(nd),
+                len(toks) - len(qy),
+            )
+        )
+    return np.stack(windows), spans
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint", required=True, action="append",
+                   help="checkpoint dir (repeatable: probe several models "
+                        "on identical windows)")
+    p.add_argument("--tokenizer", required=True)
+    p.add_argument("--corpus", required=True,
+                   help="text file, one document per line (distractors)")
+    p.add_argument("--depths", type=float, nargs="+",
+                   default=[0.2, 0.5, 0.8])
+    p.add_argument("--trials", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    import numpy as np
+
+    from differential_transformer_replication_tpu.data.tokenizer import (
+        load_tokenizer,
+    )
+
+    tok = load_tokenizer(args.tokenizer)
+    with open(args.corpus, encoding="utf-8") as f:
+        corpus_lines = [l for l in f.read().splitlines() if len(l) > 200]
+
+    results = {}
+    for ckpt in args.checkpoint:
+        params, cfg = _load_model(ckpt)
+        per_depth = {}
+        for depth in args.depths:
+            rng = random.Random(args.seed)  # identical windows per model
+            windows, spans = _build_windows(
+                tok, corpus_lines, cfg.block_size, depth, args.trials, rng
+            )
+            rows = _attention_rows(params, cfg, windows)
+            frac_ans, frac_needle, frac_noise, snr = [], [], [], []
+            for b, (a0, a1, n0, n1, q0) in enumerate(spans):
+                # average |row| allocation over layers and heads
+                for layer_rows in rows:
+                    r = np.abs(np.asarray(layer_rows[b], np.float32))
+                    total = r.sum(-1) + 1e-9  # (H,)
+                    ans = r[:, a0:a1].sum(-1) / total
+                    ndl = r[:, n0:n1].sum(-1) / total
+                    ctx = (r[:, :n0].sum(-1) + r[:, n1:q0].sum(-1)) / total
+                    frac_ans.append(ans.mean())
+                    frac_needle.append(ndl.mean())
+                    frac_noise.append(ctx.mean())
+                    # per-token signal-to-noise: answer tokens vs mean
+                    # distractor token (span sizes differ)
+                    per_ans = r[:, a0:a1].mean(-1)
+                    n_ctx = max(n0 + (q0 - n1), 1)
+                    per_ctx = (r[:, :n0].sum(-1) + r[:, n1:q0].sum(-1)) / n_ctx
+                    snr.append((per_ans / (per_ctx + 1e-9)).mean())
+            per_depth[depth] = {
+                "frac_answer": float(np.mean(frac_ans)),
+                "frac_needle": float(np.mean(frac_needle)),
+                "frac_distractors": float(np.mean(frac_noise)),
+                "snr_per_token": float(np.mean(snr)),
+            }
+        results[ckpt] = {"model": cfg.model, "depths": per_depth}
+        print(f"{ckpt} ({cfg.model}):")
+        for d, m in per_depth.items():
+            print(
+                f"  depth {d}: answer {m['frac_answer']:.4f} | needle "
+                f"{m['frac_needle']:.4f} | distractors "
+                f"{m['frac_distractors']:.4f} | SNR {m['snr_per_token']:.2f}"
+            )
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(
+                {"config": vars(args), "results": results}, f, indent=1
+            )
+
+
+if __name__ == "__main__":
+    main()
