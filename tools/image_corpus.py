@@ -243,13 +243,12 @@ def harvest_docstrings(corpus: Corpus, packages=None, root_dir=None, tag="") -> 
 _PY_COMMENT = re.compile(r"^\s*#\s?(.*)$")
 
 
-def _comment_blocks_py(src: str) -> str:
-    """Runs of full-line ``#`` comments as blank-line-separated blocks,
-    markers stripped (shebangs, coding cookies, and linter pragmas fall
-    out in _prose_line's code-shape filter downstream)."""
+def _comment_runs(src: str, line_re) -> list:
+    """Runs of consecutive lines matching ``line_re`` (marker stripped by
+    its group 1), one block string per run."""
     blocks, cur = [], []
     for raw in src.splitlines():
-        m = _PY_COMMENT.match(raw)
+        m = line_re.match(raw)
         if m:
             cur.append(m.group(1))
         else:
@@ -258,7 +257,14 @@ def _comment_blocks_py(src: str) -> str:
                 cur = []
     if cur:
         blocks.append("\n".join(cur))
-    return "\n\n".join(blocks)
+    return blocks
+
+
+def _comment_blocks_py(src: str) -> str:
+    """Runs of full-line ``#`` comments as blank-line-separated blocks,
+    markers stripped (shebangs, coding cookies, and linter pragmas fall
+    out in _prose_line's code-shape filter downstream)."""
+    return "\n\n".join(_comment_runs(src, _PY_COMMENT))
 
 
 _C_BLOCK = re.compile(r"/\*(.*?)\*/", re.S)
@@ -276,14 +282,11 @@ def harvest_c_comments(corpus: Corpus, root_dir=None) -> None:
     for pkg in _discover_packages(base):
         root = os.path.join(base, pkg)
         paths = [
-            p
-            for ext in _C_EXTS
-            for p in glob.glob(
-                os.path.join(root, "**", f"*{ext}"), recursive=True
-            )
-        ]
-        if not paths:
-            continue
+            os.path.join(dirpath, name)
+            for dirpath, _, names in os.walk(root)
+            for name in names
+            if name.endswith(_C_EXTS)
+        ]  # one tree walk, not one recursive glob per extension
         for path in sorted(paths):
             try:
                 with open(path, encoding="utf-8", errors="ignore") as f:
@@ -296,17 +299,7 @@ def harvest_c_comments(corpus: Corpus, root_dir=None) -> None:
                     _C_STAR.sub("", line) for line in m.group(1).splitlines()
                 )
                 blocks.append(body)
-            cur = []
-            for raw in src.splitlines():
-                lm = _C_LINE.match(raw)
-                if lm:
-                    cur.append(lm.group(1))
-                else:
-                    if cur:
-                        blocks.append("\n".join(cur))
-                        cur = []
-            if cur:
-                blocks.append("\n".join(cur))
+            blocks.extend(_comment_runs(src, _C_LINE))
             if blocks:
                 corpus.add_document(
                     "\n\n".join(blocks), f"c_comments:{pkg}"
@@ -337,7 +330,7 @@ def harvest_share_doc(corpus: Corpus, root="/usr/share/doc") -> None:
             else:
                 with open(path, encoding="utf-8", errors="ignore") as f:
                     raw = f.read(4 * 1024 * 1024)
-        except OSError:
+        except (OSError, EOFError):  # truncated .gz raises EOFError
             continue
         corpus.add_document(raw, "share_doc")
 
