@@ -153,3 +153,51 @@ class TestCheckpoint:
         l1, _ = model_forward(params, idx, mc)
         l2, _ = model_forward(params2, idx, mc2)
         np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+class TestCheckpointThrottle:
+    def test_throttled_best_save_flushes_at_exit(self, tmp_path, capsys):
+        """checkpoint_min_interval_s larger than the run: the FIRST
+        improvement writes immediately (the throttle clock is seeded one
+        interval in the past), every later improvement only snapshots
+        on-device, and the pending snapshot is flushed at exit AFTER the
+        rescue save — so best.ckpt always ends identical to the
+        write-every-improvement behavior (round-4 finding: a recipe-scale
+        best write costs ~3 min on a tunneled chip and early training
+        improves on every eval)."""
+        import numpy as np
+
+        from differential_transformer_replication_tpu.train import (
+            load_checkpoint,
+        )
+        from differential_transformer_replication_tpu.train.step import (
+            create_train_state,
+        )
+
+        cfg = tiny_cfg(tmp_path, checkpoint_min_interval_s=1e9)
+        state = train(cfg)
+        out = capsys.readouterr().out
+        improvements = out.count("Saving best model")
+        assert improvements >= 1
+        if improvements >= 2:
+            # the 2nd+ improvements were deferred; their snapshot must
+            # have been flushed at exit
+            assert "writing pending best checkpoint" in out
+        else:  # pragma: no cover - seed-dependent fallback
+            assert "writing pending best checkpoint" not in out
+        assert os.path.isdir(cfg.checkpoint_path)
+        target = create_train_state(jax.random.PRNGKey(0), cfg)
+        restored, best_val = load_checkpoint(cfg.checkpoint_path, cfg, target)
+        assert np.isfinite(best_val)
+        # the snapshot is from a best-eval iteration, not necessarily the
+        # final step — but it must be a real trained state
+        assert int(restored["step"]) > 0
+
+    def test_zero_interval_keeps_reference_behavior(self, tmp_path, capsys):
+        """interval 0 (default): every improvement writes immediately and
+        no pending flush remains at exit (train.py:307-317 parity)."""
+        cfg = tiny_cfg(tmp_path)  # default interval 0
+        train(cfg)
+        out = capsys.readouterr().out
+        assert "Saving best model" in out
+        assert "writing pending best checkpoint" not in out

@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resumable last-state checkpoint written on any "
                         "exit (SIGTERM/Ctrl-C/crash/completion); '' disables")
     p.add_argument("--resume-from", default=None)
+    p.add_argument("--checkpoint-min-interval-s", type=float,
+                   default=t.checkpoint_min_interval_s,
+                   help="throttle best-checkpoint disk writes to at most "
+                        "one per this many seconds (0 = the reference's "
+                        "write-every-improvement; the best state is still "
+                        "snapshotted on-device each improvement and "
+                        "flushed at exit)")
     p.add_argument("--metrics-path", default=t.metrics_path)
     p.add_argument("--wandb", action="store_true", help="enable the wandb sink")
     p.add_argument(
@@ -125,6 +132,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         checkpoint_path=args.checkpoint_path,
         last_checkpoint_path=args.last_checkpoint_path or None,
         resume_from=args.resume_from,
+        checkpoint_min_interval_s=args.checkpoint_min_interval_s,
         metrics_path=args.metrics_path,
         use_wandb=args.wandb,
         profile_dir=args.profile_dir,
