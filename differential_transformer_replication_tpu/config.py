@@ -205,11 +205,14 @@ class TrainConfig:
     # Minimum seconds between best-checkpoint DISK writes. 0 = the
     # reference's write-on-every-improvement (train.py:307-317). With a
     # positive throttle the best state is still snapshotted ON DEVICE at
-    # every improvement and any pending snapshot is flushed at exit, so
-    # the final best checkpoint is identical — only mid-run write
-    # frequency changes. Useful where device->host transfer is slow
-    # (measured 5-7 MB/s on this image's tunneled chip: a recipe-scale
-    # state write costs ~3 min).
+    # every improvement and any pending snapshot is flushed at exit
+    # (after the rescue save), so the final best checkpoint is identical
+    # on every exit path EXCEPT a multi-process crash: there the flush
+    # (a collective) must be skipped like the rescue save, and a
+    # deferred improvement is lost — best.ckpt then holds the last
+    # WRITTEN best, not the last observed one. Useful where
+    # device->host transfer is slow (measured 5-7 MB/s on this image's
+    # tunneled chip: a recipe-scale state write costs ~3 min).
     checkpoint_min_interval_s: float = 0.0
 
     def resolved_last_checkpoint_path(self) -> Optional[str]:

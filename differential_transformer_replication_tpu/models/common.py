@@ -213,6 +213,25 @@ def flash_bh_fn(
         S, _, H, d = wq.shape
         dv = wv.shape[-1]
         rate_live = dropout_rate if rng is not None else 0.0
+        if use_tm(S, T, rate_live) and cos is None:
+            # PACKED token-major fast path (no-RoPE families): ONE fused
+            # projection matmul x @ [Wq..|Wk..|Wv]; the kernel reads
+            # column windows of its output and the backward emits one
+            # packed dproj — zero copies on either side
+            from differential_transformer_replication_tpu.ops.flash import (
+                multi_stream_flash_attention_tm_packed,
+            )
+
+            wcat = jnp.concatenate(
+                [wq[s].reshape(E, H * d) for s in range(S)]
+                + [wk[s].reshape(E, H * d) for s in range(S)]
+                + [wv.reshape(E, H * dv)],
+                axis=1,
+            ).astype(x.dtype)
+            proj = x @ wcat  # (B, T, 2*S*H*d + H*dv)
+            return multi_stream_flash_attention_tm_packed(
+                proj, coeffs, B, H, S, d, dv
+            )
         if use_tm(S, T, rate_live):
             # TOKEN-MAJOR fast path (ops/flash.py tm kernels): each
             # projection's matmul output feeds the kernel after a pure

@@ -1997,32 +1997,21 @@ def _tm_fwd_call(
     return results[0], None, None
 
 
-def _tm_bwd_kernel(*refs, S: int, H: int, s_list: tuple):
-    """Whole-T backward for the streams in ``s_list`` over token-major
-    refs, one program per batch row — the factored math of
-    :func:`_bwd_fused_kernel` (dP and dV from the SHARED upstream grad g
-    scaled by the SMEM coefficients), statically unrolled over heads and
-    the listed streams. With all streams in one call the g V^T matmul
-    runs once per head (the fully-fused form; needs the raised
-    vmem_limit_bytes in _tm_bwd_call); per-stream calls are the
-    small-VMEM fallback — each stream's softmax recompute (the exp
-    floor) happens exactly once either way.
-
-    refs: q_s (T, H*d) per listed stream | k_s likewise | v (T, H*dv) |
-    g (T, H*dv) | lse (T, H*S) | delta (T, H*S) | c (BH, S) SMEM |
-    bias (T, T) bf16 | dq_s per stream | dk_s per stream | dv (T, H*dv).
-    Heads are lane slices; each output is stored once as a lane concat
-    (see _tm_fwd_kernel on why the mid-dim form cannot store)."""
-    ns = len(s_list)
-    q_refs, refs = refs[:ns], refs[ns:]
-    k_refs, refs = refs[:ns], refs[ns:]
-    (v_ref, g_ref, lse_ref, delta_ref, c_ref, bias_ref, *outs) = refs
-    dq_refs, dk_refs, dv_ref = outs[:ns], outs[ns : 2 * ns], outs[2 * ns]
+def _tm_bwd_columns(
+    q_refs, k_refs, v_ref, g_ref, lse_ref, delta_ref, c_ref, bias,
+    *, S: int, H: int, s_list: tuple, out_dtype,
+):
+    """The factored whole-T backward math shared by the per-array and
+    packed tm kernels: per (head, listed stream) gradient column groups.
+    Returns (dq_cols, dk_cols, dv_cols) — dq_cols[j]/dk_cols[j] are
+    h-ordered lists of (T, d) columns for stream s_list[j]; dv_cols is
+    the h-ordered list of (T, dv) columns (dV summed over the listed
+    streams). g V^T runs once per head and is scaled per stream; each
+    stream's softmax recompute (the exp floor) happens exactly once."""
     d = q_refs[0].shape[-1] // H
     dv = v_ref.shape[-1] // H
     b = pl.program_id(0)
     scale = 1.0 / math.sqrt(d)
-    bias = bias_ref[...].astype(jnp.float32)  # (T, T)
 
     dq_cols = [[] for _ in s_list]
     dk_cols = [[] for _ in s_list]
@@ -2057,7 +2046,7 @@ def _tm_bwd_kernel(*refs, S: int, H: int, s_list: tuple):
                         dimension_numbers=(((1,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32,
                     ) * scale
-                ).astype(dq_refs[j].dtype)
+                ).astype(out_dtype)
             )
             dk_cols[j].append(
                 (
@@ -2066,7 +2055,7 @@ def _tm_bwd_kernel(*refs, S: int, H: int, s_list: tuple):
                         dimension_numbers=(((0,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32,
                     ) * scale
-                ).astype(dk_refs[j].dtype)
+                ).astype(out_dtype)
             )
             pc = p * c_sh
             dv_h = pc if dv_h is None else dv_h + pc
@@ -2075,8 +2064,31 @@ def _tm_bwd_kernel(*refs, S: int, H: int, s_list: tuple):
                 dv_h.astype(g_h.dtype), g_h,
                 dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ).astype(dv_ref.dtype)
+            ).astype(out_dtype)
         )
+    return dq_cols, dk_cols, dv_cols
+
+
+def _tm_bwd_kernel(*refs, S: int, H: int, s_list: tuple):
+    """Whole-T backward for the streams in ``s_list`` over token-major
+    refs, one program per batch row — the factored math of
+    :func:`_bwd_fused_kernel` (see :func:`_tm_bwd_columns`); outputs are
+    stored per-stream as lane concats (see _tm_fwd_kernel on why the
+    mid-dim form cannot store).
+
+    refs: q_s (T, H*d) per listed stream | k_s likewise | v (T, H*dv) |
+    g (T, H*dv) | lse (T, H*S) | delta (T, H*S) | c (BH, S) SMEM |
+    bias (T, T) bf16 | dq_s per stream | dk_s per stream | dv (T, H*dv)."""
+    ns = len(s_list)
+    q_refs, refs = refs[:ns], refs[ns:]
+    k_refs, refs = refs[:ns], refs[ns:]
+    (v_ref, g_ref, lse_ref, delta_ref, c_ref, bias_ref, *outs) = refs
+    dq_refs, dk_refs, dv_ref = outs[:ns], outs[ns : 2 * ns], outs[2 * ns]
+    dq_cols, dk_cols, dv_cols = _tm_bwd_columns(
+        q_refs, k_refs, v_ref, g_ref, lse_ref, delta_ref, c_ref,
+        bias_ref[...].astype(jnp.float32),
+        S=S, H=H, s_list=s_list, out_dtype=dq_refs[0].dtype,
+    )
     for j in range(ns):
         dq_refs[j][...] = jnp.concatenate(dq_cols[j], axis=1)
         dk_refs[j][...] = jnp.concatenate(dk_cols[j], axis=1)
@@ -2230,6 +2242,262 @@ def multi_stream_flash_attention_tm(
         v.reshape(B, T, H * dv),
         c_r, blocks, interpret,
     )
+    return out.reshape(B, T, H, dv)
+
+
+# ---------------------------------------------------------------------------
+# Packed-projection tm variant: q/k/v ride as COLUMN WINDOWS of one
+# (B, T, W) array — the raw output of a single fused projection matmul
+# x @ [Wq1|..|WqS|Wk1|..|WkS|Wv]. pallas receives the same array once per
+# logical operand with window-offset index maps (zero copies), and the
+# backward emits ONE packed dproj in the same column order, which is
+# exactly the operand the projection's own dx/dW matmuls need — no
+# gradient concat materializes either. RoPE families cannot use this
+# (rotating the q/k windows would need slice+concat copies); they stay on
+# the per-array entry above.
+# ---------------------------------------------------------------------------
+
+
+def _tm_packed_specs(S, H, d, dv, T, block_q):
+    """(in_specs for q_0..q_{S-1}, k_0.., v) over one packed (B, T, W)
+    array, W = 2*S*H*d + H*dv. Offsets are in per-spec block units, so
+    the v window offset 2*S*H*d must be a multiple of H*dv (holds for
+    dv = 2d and even S, and for S = 1, dv = d)."""
+    Hd, Hdv = H * d, H * dv
+    assert (2 * S * Hd) % Hdv == 0, "packed v window misaligned"
+    vcol = 2 * S * Hd // Hdv
+    qspecs = [
+        pl.BlockSpec(
+            (None, block_q, Hd),
+            (lambda s: lambda b, i: (b, i, s))(s),
+            memory_space=pltpu.VMEM,
+        )
+        for s in range(S)
+    ]
+    kspecs = [
+        pl.BlockSpec(
+            (None, T, Hd),
+            (lambda s: lambda b, i: (b, 0, S + s))(s),
+            memory_space=pltpu.VMEM,
+        )
+        for s in range(S)
+    ]
+    vspec = pl.BlockSpec(
+        (None, T, Hdv), lambda b, i: (b, 0, vcol), memory_space=pltpu.VMEM
+    )
+    return qspecs + kspecs + [vspec]
+
+
+def _tm_fwd_call_packed(
+    proj, coeffs, *, S, H, d, dv, block_q, save_residuals, interpret
+):
+    """Packed twin of :func:`_tm_fwd_call`: same kernel body, operands
+    windowed out of ``proj`` (B, T, W)."""
+    B, T, W = proj.shape
+    BH = B * H
+    block_q = _pick_block(block_q, T)
+    nq = T // block_q
+
+    in_specs = _tm_packed_specs(S, H, d, dv, T, block_q) + [
+        pl.BlockSpec((block_q, T), lambda b, i: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((BH, S), lambda b, i: (0, 0),
+                     memory_space=pltpu.SMEM),
+    ]
+    out_shapes = [jax.ShapeDtypeStruct((B, T, H * dv), proj.dtype)]
+    out_specs = [
+        pl.BlockSpec(
+            (None, block_q, H * dv), lambda b, i: (b, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+    ]
+    if save_residuals:
+        out_shapes += [
+            jax.ShapeDtypeStruct((B, H, S, T, dv), proj.dtype),
+            jax.ShapeDtypeStruct((B, T, H * S), jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec(
+                (None, H, S, block_q, dv),
+                lambda b, i: (b, 0, 0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (None, block_q, H * S), lambda b, i: (b, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+    results = pl.pallas_call(
+        functools.partial(
+            _tm_fwd_kernel, S=S, H=H, save_residuals=save_residuals
+        ),
+        grid=(B, nq),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+            vmem_limit_bytes=28 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(*([proj] * (2 * S + 1)), _tm_bias(T),
+      coeffs.astype(jnp.float32))
+    if save_residuals:
+        return results
+    return results[0], None, None
+
+
+def _tm_bwd_kernel_packed(*refs, S: int, H: int):
+    """Packed twin of :func:`_tm_bwd_kernel` (all streams; same shared
+    math, :func:`_tm_bwd_columns`): the per-stream dq/dk and dv column
+    groups store as ONE (T, W) ref in the packed projection order."""
+    q_refs, refs = refs[:S], refs[S:]
+    k_refs, refs = refs[:S], refs[S:]
+    (v_ref, g_ref, lse_ref, delta_ref, c_ref, bias_ref, dproj_ref) = refs
+    dq_cols, dk_cols, dv_cols = _tm_bwd_columns(
+        q_refs, k_refs, v_ref, g_ref, lse_ref, delta_ref, c_ref,
+        bias_ref[...].astype(jnp.float32),
+        S=S, H=H, s_list=tuple(range(S)), out_dtype=dproj_ref.dtype,
+    )
+    cols = (
+        [c for s_i in range(S) for c in dq_cols[s_i]]
+        + [c for s_i in range(S) for c in dk_cols[s_i]]
+        + dv_cols
+    )
+    dproj_ref[...] = jnp.concatenate(cols, axis=1)  # (T, W)
+
+
+def _tm_bwd_call_packed(
+    proj, g, lse, delta, coeffs, *, S, H, d, dv, interpret
+):
+    """Returns dproj (B, T, W) — the single packed gradient the fused
+    projection matmul's own backward consumes directly."""
+    B, T, W = proj.shape
+    BH = B * H
+    # packed windows with the whole-T 1-D grid index signature
+    vspec = pl.BlockSpec(
+        (None, T, H * dv), lambda b: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    stspec = pl.BlockSpec(
+        (None, T, H * S), lambda b: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    Hd, Hdv = H * d, H * dv
+    vcol = 2 * S * Hd // Hdv
+    qspecs = [
+        pl.BlockSpec(
+            (None, T, Hd), (lambda s: lambda b: (b, 0, s))(s),
+            memory_space=pltpu.VMEM,
+        )
+        for s in range(S)
+    ]
+    kspecs = [
+        pl.BlockSpec(
+            (None, T, Hd), (lambda s: lambda b: (b, 0, S + s))(s),
+            memory_space=pltpu.VMEM,
+        )
+        for s in range(S)
+    ]
+    pvspec = pl.BlockSpec(
+        (None, T, Hdv), lambda b: (b, 0, vcol), memory_space=pltpu.VMEM
+    )
+    results = pl.pallas_call(
+        functools.partial(_tm_bwd_kernel_packed, S=S, H=H),
+        grid=(B,),
+        in_specs=qspecs + kspecs + [
+            pvspec,
+            vspec,
+            stspec,
+            stspec,
+            pl.BlockSpec((BH, S), lambda b: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((T, T), lambda b: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, T, W), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B, T, W), proj.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+            vmem_limit_bytes=28 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(*([proj] * (2 * S + 1)), g, lse, delta,
+      coeffs.astype(jnp.float32), _tm_bias(T))
+    return results[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _flash_tm_packed(proj, coeffs, S, H, d, dv, blocks, interpret):
+    out, _, _ = _tm_fwd_call_packed(
+        proj, coeffs, S=S, H=H, d=d, dv=dv,
+        block_q=blocks[0], save_residuals=False, interpret=interpret,
+    )
+    return out
+
+
+def _flash_tm_packed_fwd(proj, coeffs, S, H, d, dv, blocks, interpret):
+    out, o_all, lse = _tm_fwd_call_packed(
+        proj, coeffs, S=S, H=H, d=d, dv=dv,
+        block_q=blocks[2], save_residuals=True, interpret=interpret,
+    )
+    return out, (proj, coeffs, o_all, lse)
+
+
+def _flash_tm_packed_bwd(S, H, d, dv, blocks, interpret, res, g):
+    proj, coeffs, o_all, lse = res
+    B, _, _, T, _ = o_all.shape
+    g32 = g.astype(jnp.float32).reshape(B, T, H, dv)
+    base = jnp.einsum("bthd,bhstd->bths", g32, o_all.astype(jnp.float32))
+    dcoeffs = base.sum(1).reshape(B * H, S)
+    delta = (
+        base * coeffs.astype(jnp.float32).reshape(B, 1, H, S)
+    ).reshape(B, T, H * S)
+    dproj = _tm_bwd_call_packed(
+        proj, g.astype(proj.dtype), lse, delta, coeffs,
+        S=S, H=H, d=d, dv=dv, interpret=interpret,
+    )
+    return dproj, dcoeffs.astype(coeffs.dtype)
+
+
+_flash_tm_packed.defvjp(_flash_tm_packed_fwd, _flash_tm_packed_bwd)
+
+
+def multi_stream_flash_attention_tm_packed(
+    proj: jnp.ndarray,  # (B, T, 2*S*H*d + H*dv) — [q_0..q_S|k_0..k_S|v]
+    coeffs: jnp.ndarray,  # (S, H) float32
+    B: int, H: int, S: int, d: int, dv: int,
+    *,
+    block_q: Optional[int] = None,
+    block_q_train: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Packed-projection token-major entry (see the section comment):
+    ``proj`` is the raw output of ONE fused projection matmul; returns
+    (B, T, H, dv). No-RoPE families only; callers check use_tm."""
+    if interpret is None:
+        interpret = _auto_interpret()
+    T = proj.shape[1]
+    assert use_tm(S, T, 0.0), (
+        f"tm kernels do not cover S={S}, T={T}; dispatch via use_tm"
+    )
+    dq, _, dqt, _ = default_blocks()
+    blocks = (
+        _pick_block(block_q if block_q is not None else dq, T),
+        0,
+        _pick_block(
+            block_q_train
+            if block_q_train is not None
+            else min(dqt, _TM_TRAIN_BLOCK_Q),
+            T,
+        ),
+        0,
+    )
+    c_r = jnp.broadcast_to(
+        coeffs.astype(jnp.float32).T[None], (B, H, S)
+    ).reshape(B * H, S)
+    out = _flash_tm_packed(proj, c_r, S, H, d, dv, blocks, interpret)
     return out.reshape(B, T, H, dv)
 
 

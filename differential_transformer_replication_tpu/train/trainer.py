@@ -388,8 +388,9 @@ def train(cfg: TrainConfig) -> dict:
     last_ckpt_path = cfg.resolved_last_checkpoint_path()
     best_snapshot = None  # device-side best state not yet written to disk
     # seeded at loop entry: "at most one best write per interval" holds
-    # from the start (interval 0 still writes on every improvement)
-    last_best_write = time.time() - cfg.checkpoint_min_interval_s
+    # from the start (interval 0 still writes on every improvement).
+    # monotonic: a backward wall-clock step (NTP) must not defer writes
+    last_best_write = time.monotonic() - cfg.checkpoint_min_interval_s
     # set by the except below — NOT derived from sys.exc_info(), which
     # would also be truthy when train() runs inside a caller's exception
     # handler (e.g. a retry wrapper) and would wrongly suppress the
@@ -442,7 +443,7 @@ def train(cfg: TrainConfig) -> dict:
                     # decision must AGREE across ranks (save_checkpoint is
                     # a collective): rank 0's clock decides.
                     write_now = (
-                        time.time() - last_best_write
+                        time.monotonic() - last_best_write
                         >= cfg.checkpoint_min_interval_s
                     )
                     if process_count() > 1:
@@ -458,7 +459,7 @@ def train(cfg: TrainConfig) -> dict:
                             cfg.checkpoint_path, state, best_val_loss, cfg
                         )
                         best_snapshot = None
-                        last_best_write = time.time()
+                        last_best_write = time.monotonic()
                     else:
                         best_snapshot = jax.tree_util.tree_map(
                             jnp.copy, state
@@ -498,54 +499,66 @@ def train(cfg: TrainConfig) -> dict:
         # included.
         skip_collective_rescue = crashed and process_count() > 1
         try:
-            if last_ckpt_path and not skip_collective_rescue:
-                # resumable last-state checkpoint, written whatever the
-                # exit path (save_checkpoint canonicalizes pipeline
-                # layouts; every process participates in its collective
-                # gather, the primary writes). The SIGTERM handler is
-                # still ours here, so a follow-up SIGTERM during this
-                # save cannot kill the write; the atomic rename inside
-                # save_checkpoint protects against harder kills.
-                finite = True
-                if metrics is not None:
-                    # a NaN/diverged state must not overwrite the previous
-                    # good rescue checkpoint — save-exceptions were already
-                    # caught, but bad VALUES were not
-                    finite = bool(
-                        np.isfinite(float(jax.device_get(metrics["loss"])))
+            try:
+                if last_ckpt_path and not skip_collective_rescue:
+                    # resumable last-state checkpoint, written whatever the
+                    # exit path (save_checkpoint canonicalizes pipeline
+                    # layouts; every process participates in its collective
+                    # gather, the primary writes). The SIGTERM handler is
+                    # still ours here, so a follow-up SIGTERM during this
+                    # save cannot kill the write; the atomic rename inside
+                    # save_checkpoint protects against harder kills.
+                    finite = True
+                    if metrics is not None:
+                        # a NaN/diverged state must not overwrite the
+                        # previous good rescue checkpoint — save-exceptions
+                        # were already caught, but bad VALUES were not
+                        finite = bool(
+                            np.isfinite(float(jax.device_get(metrics["loss"])))
+                        )
+                    if finite:
+                        save_checkpoint(
+                            last_ckpt_path, state, best_val_loss, cfg
+                        )
+                    elif is_primary():
+                        print(
+                            f"skipping last-checkpoint rescue save: "
+                            f"non-finite loss at iter {iter_num} (previous "
+                            f"checkpoint at {last_ckpt_path!r} left intact)"
+                        )
+            except Exception as e:  # noqa: BLE001
+                # on the crash path the state itself may be poisoned
+                # (device OOM) — never let the rescue save mask the real
+                # exception
+                print(f"last-checkpoint save failed: {e!r}")
+            try:
+                if best_snapshot is not None and not skip_collective_rescue:
+                    # flush the throttled best-state snapshot AFTER the
+                    # resumable rescue save above — under a bounded
+                    # preemption grace window the last-ckpt (what resume
+                    # needs) must land first; the best flush is the
+                    # nice-to-have. On the multi-process CRASH path this
+                    # (like the rescue save) is skipped — a deferred
+                    # improvement is then lost and best.ckpt stays at the
+                    # last written state; that is the throttle's one
+                    # divergence from write-every-improvement (the
+                    # collective gather cannot run from an asymmetric
+                    # crash, see skip_collective_rescue above).
+                    if is_primary():
+                        print(
+                            f"writing pending best checkpoint "
+                            f"(val loss {best_val_loss:.4f})"
+                        )
+                    save_checkpoint(
+                        cfg.checkpoint_path, best_snapshot, best_val_loss, cfg
                     )
-                if finite:
-                    save_checkpoint(last_ckpt_path, state, best_val_loss, cfg)
-                elif is_primary():
-                    print(
-                        f"skipping last-checkpoint rescue save: non-finite "
-                        f"loss at iter {iter_num} (previous checkpoint at "
-                        f"{last_ckpt_path!r} left intact)"
-                    )
-        except Exception as e:  # noqa: BLE001
-            # on the crash path the state itself may be poisoned (device
-            # OOM) — never let the rescue save mask the real exception
-            print(f"last-checkpoint save failed: {e!r}")
-        try:
-            if best_snapshot is not None and not skip_collective_rescue:
-                # flush the throttled best-state snapshot AFTER the
-                # resumable rescue save above — under a bounded preemption
-                # grace window the last-ckpt (what resume needs) must land
-                # first; the best flush is the nice-to-have. The on-disk
-                # best checkpoint ends identical to the
-                # write-every-improvement behavior.
-                if is_primary():
-                    print(
-                        f"writing pending best checkpoint "
-                        f"(val loss {best_val_loss:.4f})"
-                    )
-                save_checkpoint(
-                    cfg.checkpoint_path, best_snapshot, best_val_loss, cfg
-                )
-                best_snapshot = None
-        except Exception as e:  # noqa: BLE001
-            print(f"pending best-checkpoint save failed: {e!r}")
+                    best_snapshot = None
+            except Exception as e:  # noqa: BLE001
+                print(f"pending best-checkpoint save failed: {e!r}")
         finally:
+            # restore the caller's SIGTERM handler on EVERY exit path —
+            # including a KeyboardInterrupt mid-rescue-save (BaseException
+            # escapes the inner except-Exception blocks)
             if prev_handler is not None:
                 signal.signal(signal.SIGTERM, prev_handler)
     if cfg.mesh.pipeline > 1:

@@ -425,3 +425,45 @@ class TestTokenMajor:
         v = jnp.einsum("bte,ehd->bthd", x, wv)
         ref = vanilla_attention(q, k, v, mask=causal_mask(T))
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_packed_grad_parity_tm(self):
+        """The packed-projection entry (one fused matmul, windowed
+        operands, single packed dproj) must match dense gradients — this
+        is the recipe-hot diff training path (models/common.py packed
+        branch)."""
+        from differential_transformer_replication_tpu.ops.flash import (
+            multi_stream_flash_attention_tm_packed,
+        )
+        from differential_transformer_replication_tpu.ops.streams import (
+            diff_coeffs,
+        )
+
+        q1, k1, q2, k2, v, lam = self._diff_inputs(seed=29)
+        coeffs = diff_coeffs(lam)
+        d, dv = D, 2 * D
+
+        def pack(q1, q2, k1, k2, v):
+            return jnp.concatenate(
+                [a.reshape(B, T, -1) for a in (q1, q2, k1, k2, v)], axis=-1
+            )
+
+        def loss_packed(args):
+            out = multi_stream_flash_attention_tm_packed(
+                pack(*args), coeffs, B, H, 2, d, dv
+            )
+            return jnp.sum(out * jnp.cos(out))
+
+        def loss_ref(args):
+            q1, q2, k1, k2, v = args
+            out = diff_attention(
+                q1, k1, q2, k2, v, lam, mask=causal_mask(T)
+            )
+            return jnp.sum(out * jnp.cos(out))
+
+        args = (q1, q2, k1, k2, v)
+        g_p = jax.grad(loss_packed)(args)
+        g_r = jax.grad(loss_ref)(args)
+        for name, a, b in zip("q1 q2 k1 k2 v".split(), g_p, g_r):
+            np.testing.assert_allclose(
+                a, b, rtol=1e-4, atol=1e-4, err_msg=name
+            )
