@@ -200,9 +200,18 @@ def main() -> None:
     with open(args.corpus, encoding="utf-8") as f:
         corpus_lines = [l for l in f.read().splitlines() if len(l) > 200]
 
+    if tok.get_vocab_size() == 0:
+        raise SystemExit(f"empty tokenizer at {args.tokenizer!r}")
+
     results = {}
     for ckpt in args.checkpoint:
         params, cfg = _load_model(ckpt)
+        if tok.get_vocab_size() > cfg.vocab_size:
+            raise SystemExit(
+                f"tokenizer vocab {tok.get_vocab_size()} exceeds model "
+                f"vocab {cfg.vocab_size} for {ckpt!r} — pass the tokenizer "
+                "the checkpoint was trained with"
+            )
         per_depth = {}
         for depth in args.depths:
             rng = random.Random(args.seed)  # identical windows per model

@@ -57,6 +57,14 @@ def main() -> None:
                    help="preset: the FULL reference recipe (8L/768d/block-512/"
                         "40k iters, TinyStories 1M docs, BPE-12k, 200 eval "
                         "batches, eval every 500). Explicit flags still win.")
+    p.add_argument("--checkpoint-min-interval-s", type=float, default=0.0,
+                   help="throttle best-checkpoint disk writes (trainer "
+                        "flag; a recipe-scale write costs ~3 min on this "
+                        "image's tunneled chip)")
+    p.add_argument("--no-last-ckpt", action="store_true",
+                   help="skip the resumable last-state checkpoint (saves "
+                        "one multi-minute exit write per family when the "
+                        "run will not be resumed)")
     p.add_argument("--out", default="ppl_gap.json")
     args = p.parse_args()
 
@@ -107,7 +115,10 @@ def main() -> None:
             vocab_size=args.vocab_size,
             seed=args.seed,
             checkpoint_path=f"ppl_gap_{kind}.ckpt",
-            last_checkpoint_path=f"ppl_gap_{kind}_last.ckpt",
+            last_checkpoint_path=(
+                None if args.no_last_ckpt else f"ppl_gap_{kind}_last.ckpt"
+            ),
+            checkpoint_min_interval_s=args.checkpoint_min_interval_s,
             metrics_path=f"ppl_gap_{kind}.jsonl",
         )
         print(f"=== training {kind} ({args.iters} iters) ===")
