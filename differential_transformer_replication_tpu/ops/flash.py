@@ -1988,7 +1988,7 @@ def _tm_fwd_call(
         out_shape=out_shapes,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel"),
-            vmem_limit_bytes=28 * 1024 * 1024,
+            vmem_limit_bytes=_TM_VMEM_LIMIT,
         ),
         interpret=interpret,
     )(*qs, *ks, v, _tm_bias(T), coeffs.astype(jnp.float32))
@@ -2136,7 +2136,7 @@ def _tm_bwd_call(qs, ks, v, g, lse, delta, coeffs, *, H: int, interpret: bool):
         ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",),
-            vmem_limit_bytes=28 * 1024 * 1024,
+            vmem_limit_bytes=_TM_VMEM_LIMIT,
         ),
         interpret=interpret,
     )(*qs, *ks, v, g, lse, delta, coeffs.astype(jnp.float32), _tm_bias(T))
@@ -2145,16 +2145,21 @@ def _tm_bwd_call(qs, ks, v, g, lse, delta, coeffs, *, H: int, interpret: bool):
     return dqs, dks, results[2 * S]
 
 
+# Scoped-VMEM budget for ALL tm pallas_calls (fwd and bwd, per-array and
+# packed): 28 MB, ~1/4 of v5e's 128 MB physical VMEM (the 16 MB default
+# is conservative). Defined once because the training q-block size below
+# is only compilable under it — deriving one from the other keeps them
+# from drifting apart (advisor, round 4).
+_TM_VMEM_LIMIT = 28 * 1024 * 1024
+
 # Training-forward q-block rows. The residual-saving forward carries
 # oall + lse blocks on top of the compute blocks; at the recipe shape the
-# 512-row block needs ~18 MB of scoped VMEM (measured round 4), which
-# only fits because BOTH tm pallas_calls raise vmem_limit_bytes to 28 MB
-# (~1/4 of v5e's 128 MB physical VMEM — the 16 MB default is
-# conservative). If that limit is ever lowered back, this must drop to
-# 256 or the recipe-shape compile fails with a Mosaic VMEM overflow.
-# 512 measured ~0.5% faster end-to-end than 256 (fewer programs, one
-# bias stripe).
-_TM_TRAIN_BLOCK_Q = 512
+# 512-row block needs ~18 MB of scoped VMEM (measured round 4), so 512
+# requires _TM_VMEM_LIMIT comfortably above that; under a smaller limit
+# fall back to 256 rows automatically instead of a Mosaic VMEM overflow
+# at recipe shape. 512 measured ~0.5% faster end-to-end than 256 (fewer
+# programs, one bias stripe).
+_TM_TRAIN_BLOCK_Q = 512 if _TM_VMEM_LIMIT >= 20 * 1024 * 1024 else 256
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -2258,13 +2263,26 @@ def multi_stream_flash_attention_tm(
 # ---------------------------------------------------------------------------
 
 
+def tm_packed_ok(S: int, H: int, d: int, dv: int) -> bool:
+    """Shape eligibility for the packed tm kernels: the fused (B, T, W)
+    projection is windowed with H*d- and H*dv-wide column blocks, so the
+    V window offset 2*S*H*d must be a whole number of H*dv blocks (holds
+    for dv = 2d and even S, and for S = 1, dv = d), and both window
+    widths must be 128-lane multiples — a BlockSpec block narrower than
+    the array's last dim must divide into lanes (Mosaic lowering rule;
+    narrow test-scale models miss it). Callers route ineligible shapes
+    to the per-array tm path, whose blocks span each array's full last
+    dim and are always legal."""
+    Hd, Hdv = H * d, H * dv
+    return (2 * S * Hd) % Hdv == 0 and Hd % 128 == 0 and Hdv % 128 == 0
+
+
 def _tm_packed_specs(S, H, d, dv, T, block_q):
     """(in_specs for q_0..q_{S-1}, k_0.., v) over one packed (B, T, W)
-    array, W = 2*S*H*d + H*dv. Offsets are in per-spec block units, so
-    the v window offset 2*S*H*d must be a multiple of H*dv (holds for
-    dv = 2d and even S, and for S = 1, dv = d)."""
+    array, W = 2*S*H*d + H*dv. Offsets are in per-spec block units (see
+    tm_packed_ok for the alignment rules)."""
     Hd, Hdv = H * d, H * dv
-    assert (2 * S * Hd) % Hdv == 0, "packed v window misaligned"
+    assert tm_packed_ok(S, H, d, dv), "packed tm windows misaligned"
     vcol = 2 * S * Hd // Hdv
     qspecs = [
         pl.BlockSpec(
@@ -2337,7 +2355,7 @@ def _tm_fwd_call_packed(
         out_shape=out_shapes,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel"),
-            vmem_limit_bytes=28 * 1024 * 1024,
+            vmem_limit_bytes=_TM_VMEM_LIMIT,
         ),
         interpret=interpret,
     )(*([proj] * (2 * S + 1)), _tm_bias(T),
@@ -2420,7 +2438,7 @@ def _tm_bwd_call_packed(
         out_shape=[jax.ShapeDtypeStruct((B, T, W), proj.dtype)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",),
-            vmem_limit_bytes=28 * 1024 * 1024,
+            vmem_limit_bytes=_TM_VMEM_LIMIT,
         ),
         interpret=interpret,
     )(*([proj] * (2 * S + 1)), g, lse, delta,

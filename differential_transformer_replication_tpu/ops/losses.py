@@ -211,7 +211,15 @@ def _dense_bwd(res, g):
         # fuses into the pass that produces ``d`` instead of forcing a
         # separate fp32 (N, V) materialization of d32 — profiled ~1.8
         # ms/step of pure HBM traffic at the recipe scale on v5e (r4)
-        counts = jnp.zeros((V,), jnp.float32).at[targets.reshape(-1)].add(1.0)
+        # targets must be in [0, V): scatter .add wraps NEGATIVE indices
+        # (unlike the one-hot formulation, which ignored them), so a future
+        # ignore-index sentinel (e.g. -1) would silently corrupt column V-1.
+        # The mask makes such sentinels contribute nothing here; full
+        # ignore-index support would also need masking in the fwd gather.
+        t = targets.reshape(-1)
+        counts = jnp.zeros((V,), jnp.float32).at[t].add(
+            jnp.where(t >= 0, 1.0, 0.0)
+        )
         colsum = jnp.sum(p, axis=tuple(range(p.ndim - 1)))
         db = ((colsum - counts) * (g / n)).astype(b.dtype)
     d_targets = jnp.zeros(targets.shape, jax.dtypes.float0)

@@ -269,6 +269,48 @@ def train(cfg: TrainConfig) -> dict:
     if cfg.mesh.pipeline <= 1:
         eval_many = make_eval_many(cfg, mesh=eval_mesh)
 
+    if cfg.checkpoint_min_interval_s > 0:
+        # The throttle's deferred-improvement snapshot pins a SECOND full
+        # train state in HBM until the next write or exit; surface the
+        # headroom risk at startup instead of OOM-ing a run that fit
+        # without the throttle (advisor, round 4). Count DEVICE-0 shard
+        # bytes, not global bytes — on sharded runs the snapshot adds only
+        # each device's own shard.
+        dev0 = jax.local_devices()[0]
+
+        def _dev0_bytes(leaf):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                return sum(
+                    s.data.nbytes for s in shards if s.device == dev0
+                )
+            return getattr(leaf, "nbytes", 0)
+
+        state_bytes = sum(
+            _dev0_bytes(leaf) for leaf in jax.tree_util.tree_leaves(state)
+        )
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+        except Exception:  # platforms without memory_stats (e.g. CPU)
+            stats = {}
+        limit = stats.get("bytes_limit", 0)
+        in_use = stats.get("bytes_in_use", 0)
+        # in_use already counts the live state; the deferred snapshot pins
+        # exactly ONE additional copy
+        if limit and in_use + state_bytes > 0.92 * limit:
+            import warnings
+
+            warnings.warn(
+                "checkpoint_min_interval_s > 0 keeps an on-device snapshot "
+                f"of the full train state (~{state_bytes / 2**20:.0f} MiB) "
+                "while a best-checkpoint write is deferred; estimated HBM "
+                f"({(in_use + state_bytes) / 2**20:.0f} of "
+                f"{limit / 2**20:.0f} MiB) leaves little headroom — a run "
+                "that fits without the throttle may OOM with it. Set "
+                "--checkpoint-min-interval-s 0 if memory-tight",
+                stacklevel=2,
+            )
+
     data_rng = np.random.default_rng(cfg.seed)
     eval_rng = np.random.default_rng(cfg.seed + 1)
 

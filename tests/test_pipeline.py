@@ -437,3 +437,14 @@ class TestPipelineTensorComposition:
     # train-step and dropout parity under pp x tp run as the
     # pp2xtp2xdp2 parametrization of TestPipelineTrainStep's
     # test_step_matches_single_device_step / test_dropout_through_pipeline
+
+
+def test_sequence_impl_inert_under_pipeline_warns():
+    """pipeline x sequence runs GSPMD-SP dense attention; the configured
+    ring/ulysses schedule cannot nest inside the pipeline's shard_map and
+    is IGNORED — the config must say so out loud rather than silently
+    running something else (parallel/pipeline.py:_check_pipeline_cfg)."""
+    m = tiny_model("diff")
+    mesh = create_mesh(MeshConfig(pipeline=2, sequence=2, data=2))
+    with pytest.warns(UserWarning, match="GSPMD-SP only"):
+        make_pipeline_loss(m, mesh)

@@ -139,3 +139,17 @@ def test_train_blob_loads_via_importer(tmp_path):
         jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(back)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+def test_non_fp32_leaf_fails_loud():
+    """A non-fp32 params leaf (e.g. a future bf16-saved checkpoint) must
+    be rejected, not silently rewritten to fp32 (utils/torch_export.py:_t):
+    export is a parity surface and a dtype rewrite would hand the reference
+    different numbers than the checkpoint holds."""
+    import jax.numpy as jnp
+
+    cfg = _cfg("control")
+    params = init_model(jax.random.PRNGKey(7), cfg)
+    params = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), params)
+    with pytest.raises(TypeError, match="expected float32 params"):
+        export_reference_state_dict(params, cfg)
