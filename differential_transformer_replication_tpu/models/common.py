@@ -205,6 +205,7 @@ def flash_bh_fn(
         from differential_transformer_replication_tpu.ops.flash import (
             multi_stream_flash_attention_bh,
             multi_stream_flash_attention_tm,
+            tm_packed_ok,
             use_tm,
         )
         from differential_transformer_replication_tpu.ops.rope import apply_rope
@@ -213,13 +214,9 @@ def flash_bh_fn(
         S, _, H, d = wq.shape
         dv = wv.shape[-1]
         rate_live = dropout_rate if rng is not None else 0.0
-        from differential_transformer_replication_tpu.ops.flash import (
-            tm_packed_ok,
-        )
-
-        # Ineligible shapes (odd-S offset, narrow lane widths — see
-        # tm_packed_ok) fall through to the per-array tm path instead of
-        # tripping the kernel's spec assert at trace time.
+        # Ineligible shapes (exotic dv/d offset ratios, narrow lane
+        # widths — see tm_packed_ok) fall through to the per-array tm
+        # path instead of tripping the kernel's spec assert at trace time.
         if use_tm(S, T, rate_live) and cos is None and tm_packed_ok(S, H, d, dv):
             # PACKED token-major fast path (no-RoPE families): ONE fused
             # projection matmul x @ [Wq..|Wk..|Wv]; the kernel reads

@@ -1828,17 +1828,33 @@ def multi_stream_flash_attention_bh(
 #
 # Scope (use_tm): the recipe-hot region only — dropout 0.0, T small enough
 # for the additive-bias resident forward AND the fused whole-T backward
-# (S*T*T <= _FUSED_BWD_BUDGET). Everything else (long context, dropout,
-# ring chunks) stays on the head-major path; callers dispatch via use_tm.
+# (T and S within the _TM_BWD_MAX_* envelope). Everything else (long context,
+# dropout, ring chunks) stays on the head-major path; dispatch via use_tm.
 # ---------------------------------------------------------------------------
+
+# Whole-T tm backward admission, SEPARATE from the head-major
+# _FUSED_BWD_BUDGET. Two measured walls (round 5, v5e, recipe widths):
+#   - streams scale gently: the kernel walks (head, stream) pairs
+#     sequentially, so S only grows the resident per-stream q/k/dq/dk
+#     arrays (~0.4 MB each) — S=4 at T=512 compiles and runs inside
+#     _TM_VMEM_LIMIT with 256-row forward blocks (the r4 2*512*512 cap
+#     was a holdover from the head-major straight-line kernel, not a tm
+#     measurement), so ndiff's n_terms=4 recipe dispatches token-major
+#     like diff/control instead of paying the bh transpose copies;
+#   - T scales hard: the backward's T x T fp32 score/prob transients are
+#     duplicated across the unrolled head loop, so T=1024 at S=1 blows
+#     scoped VMEM (73 MB measured). T stays capped at 512; longer T
+#     belongs to the head-major / KV-tiled paths.
+_TM_BWD_MAX_T = 512
+_TM_BWD_MAX_S = 4
 
 
 def use_tm(S: int, T: int, rate: float) -> bool:
     """True when the token-major kernels cover this config: no attention
     dropout (the tm kernels drop the counter-based mask machinery), the
     resident additive-bias forward applies, and the whole-T fused backward
-    fits its score-matrix budget."""
-    return rate == 0.0 and T <= _BIAS_MAX_T and _use_fused_bwd(S, T)
+    fits its measured VMEM envelope (see the admission constants above)."""
+    return rate == 0.0 and T <= _TM_BWD_MAX_T and S <= _TM_BWD_MAX_S
 
 
 def _tm_bias(T: int) -> jnp.ndarray:
@@ -2154,12 +2170,18 @@ _TM_VMEM_LIMIT = 28 * 1024 * 1024
 
 # Training-forward q-block rows. The residual-saving forward carries
 # oall + lse blocks on top of the compute blocks; at the recipe shape the
-# 512-row block needs ~18 MB of scoped VMEM (measured round 4), so 512
-# requires _TM_VMEM_LIMIT comfortably above that; under a smaller limit
-# fall back to 256 rows automatically instead of a Mosaic VMEM overflow
-# at recipe shape. 512 measured ~0.5% faster end-to-end than 256 (fewer
-# programs, one bias stripe).
+# 512-row block needs ~18 MB of scoped VMEM at S<=2 (measured round 4)
+# but 32.3 MB at S=4 — over the limit. Rather than raising the limit
+# (probed round 5 on v5e, S=4: 28 MB/block-256 = 16.3 ms, 40 MB/block-512
+# = 18.0 ms, 48 MB/block-512 = 24.9 ms — extra scoped VMEM *slows* the
+# kernel by squeezing pipelining headroom), S>=3 drops to 256-row blocks
+# under the unchanged limit, which is also the fastest point. At S<=2,
+# 512 stays ~0.5% faster than 256 (fewer programs, one bias stripe).
 _TM_TRAIN_BLOCK_Q = 512 if _TM_VMEM_LIMIT >= 20 * 1024 * 1024 else 256
+
+
+def _tm_train_block_q(S: int) -> int:
+    return min(_TM_TRAIN_BLOCK_Q, 256 if S >= 3 else 512)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -2227,13 +2249,17 @@ def multi_stream_flash_attention_tm(
         f"tm kernels do not cover S={S}, T={T}; dispatch via use_tm"
     )
     dq, _, dqt, _ = default_blocks()
+    # the S>=3 clamp applies to BOTH forward variants: the no-grad
+    # (eval/inference) forward keeps S full-T k/v arrays resident just
+    # like the residual-saving one, so it shares the VMEM envelope
     blocks = (
-        _pick_block(block_q if block_q is not None else dq, T),
+        _pick_block(min(block_q if block_q is not None else dq,
+                        _tm_train_block_q(S)), T),
         0,
         _pick_block(
             block_q_train
             if block_q_train is not None
-            else min(dqt, _TM_TRAIN_BLOCK_Q),
+            else min(dqt, _tm_train_block_q(S)),
             T,
         ),
         0,
@@ -2267,22 +2293,24 @@ def tm_packed_ok(S: int, H: int, d: int, dv: int) -> bool:
     """Shape eligibility for the packed tm kernels: the fused (B, T, W)
     projection is windowed with H*d- and H*dv-wide column blocks, so the
     V window offset 2*S*H*d must be a whole number of H*dv blocks (holds
-    for dv = 2d and even S, and for S = 1, dv = d), and both window
-    widths must be 128-lane multiples — a BlockSpec block narrower than
-    the array's last dim must divide into lanes (Mosaic lowering rule;
-    narrow test-scale models miss it). Callers route ineligible shapes
-    to the per-array tm path, whose blocks span each array's full last
-    dim and are always legal."""
+    for every S when dv = 2d, and for S = 1, dv = d — only exotic dv/d
+    ratios miss it), and both window widths must be 128-lane multiples —
+    a BlockSpec block narrower than the array's last dim must divide
+    into lanes (Mosaic lowering rule; narrow test-scale models miss it).
+    Callers route ineligible shapes to the per-array tm path, whose
+    blocks span each array's full last dim and are always legal."""
     Hd, Hdv = H * d, H * dv
     return (2 * S * Hd) % Hdv == 0 and Hd % 128 == 0 and Hdv % 128 == 0
 
 
 def _tm_packed_specs(S, H, d, dv, T, block_q):
     """(in_specs for q_0..q_{S-1}, k_0.., v) over one packed (B, T, W)
-    array, W = 2*S*H*d + H*dv. Offsets are in per-spec block units (see
-    tm_packed_ok for the alignment rules)."""
+    array, W = 2*S*H*d + H*dv. Asserts only the offset-alignment
+    invariant (wrong windows = wrong math); the 128-lane width rule in
+    tm_packed_ok is a TPU-lowering concern the DISPATCHER enforces —
+    direct narrow-shape callers still work in interpret mode."""
     Hd, Hdv = H * d, H * dv
-    assert tm_packed_ok(S, H, d, dv), "packed tm windows misaligned"
+    assert (2 * S * Hd) % Hdv == 0, "packed v window misaligned"
     vcol = 2 * S * Hd // Hdv
     qspecs = [
         pl.BlockSpec(
@@ -2501,13 +2529,17 @@ def multi_stream_flash_attention_tm_packed(
         f"tm kernels do not cover S={S}, T={T}; dispatch via use_tm"
     )
     dq, _, dqt, _ = default_blocks()
+    # the S>=3 clamp applies to BOTH forward variants: the no-grad
+    # (eval/inference) forward keeps S full-T k/v arrays resident just
+    # like the residual-saving one, so it shares the VMEM envelope
     blocks = (
-        _pick_block(block_q if block_q is not None else dq, T),
+        _pick_block(min(block_q if block_q is not None else dq,
+                        _tm_train_block_q(S)), T),
         0,
         _pick_block(
             block_q_train
             if block_q_train is not None
-            else min(dqt, _TM_TRAIN_BLOCK_Q),
+            else min(dqt, _tm_train_block_q(S)),
             T,
         ),
         0,

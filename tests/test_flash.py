@@ -305,7 +305,10 @@ class TestTokenMajor:
 
         assert flash.use_tm(2, 512, 0.0)  # the flagship recipe point
         assert flash.use_tm(1, 512, 0.0)  # control
-        assert not flash.use_tm(4, 512, 0.0)  # ndiff: over the fused budget
+        assert flash.use_tm(4, 512, 0.0)  # ndiff n_terms=4 (round 5)
+        assert not flash.use_tm(1, 1024, 0.0)  # T^2 transients blow VMEM
+        assert not flash.use_tm(4, 1024, 0.0)  # likewise at any S
+        assert not flash.use_tm(8, 512, 0.0)  # past the measured stream cap
         assert not flash.use_tm(2, 512, 0.1)  # dropout stays head-major
         assert not flash.use_tm(1, 2048, 0.0)  # past the bias-resident max
 
@@ -467,3 +470,53 @@ class TestTokenMajor:
             np.testing.assert_allclose(
                 a, b, rtol=1e-4, atol=1e-4, err_msg=name
             )
+
+
+class TestTokenMajorNdiff:
+    """S=4 (ndiff n_terms=4) on the token-major kernels — the stream
+    count the round-5 tm admission envelope allows at recipe T (the tm
+    backward walks (head, stream) pairs sequentially, so its transients
+    do not scale with S; see ops/flash.py use_tm)."""
+
+    def test_ndiff_s4_grad_parity_tm(self):
+        from differential_transformer_replication_tpu.ops.flash import (
+            multi_stream_flash_attention_tm,
+        )
+        from differential_transformer_replication_tpu.ops.attention import (
+            ndiff_attention,
+        )
+        from differential_transformer_replication_tpu.ops.lambdas import (
+            ndiff_signs,
+        )
+        from differential_transformer_replication_tpu.ops.streams import (
+            ndiff_coeffs,
+        )
+
+        n = 4
+        ks = jax.random.split(jax.random.PRNGKey(31), 3)
+        qs = _rand(ks[0], n, B, T, H, D)
+        kss = _rand(ks[1], n, B, T, H, D)
+        v = _rand(ks[2], B, T, H, 2 * D)
+        lams = jnp.linspace(0.2, 0.7, n * H).reshape(n, H)
+        signs = ndiff_signs(n)
+        coeffs = ndiff_coeffs(lams, signs)
+
+        def loss_ref(qs, kss, v):
+            out = ndiff_attention(qs, kss, v, lams, signs, mask=causal_mask(T))
+            return jnp.sum(out * jnp.cos(out))
+
+        def loss_tm(qs, kss, v):
+            out = multi_stream_flash_attention_tm(
+                tuple(qs[i] for i in range(n)),
+                tuple(kss[i] for i in range(n)),
+                v, coeffs, B, H,
+            )
+            return jnp.sum(out * jnp.cos(out))
+
+        np.testing.assert_allclose(
+            loss_tm(qs, kss, v), loss_ref(qs, kss, v), rtol=1e-5
+        )
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(qs, kss, v)
+        g_tm = jax.grad(loss_tm, argnums=(0, 1, 2))(qs, kss, v)
+        for r, g in zip(g_ref, g_tm):
+            np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-4)
