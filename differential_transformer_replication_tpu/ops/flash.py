@@ -2249,19 +2249,17 @@ def multi_stream_flash_attention_tm(
         f"tm kernels do not cover S={S}, T={T}; dispatch via use_tm"
     )
     dq, _, dqt, _ = default_blocks()
-    # the S>=3 clamp applies to BOTH forward variants: the no-grad
-    # (eval/inference) forward keeps S full-T k/v arrays resident just
-    # like the residual-saving one, so it shares the VMEM envelope
+    # the S>=3 clamp is a hard VMEM envelope, applied uniformly: both
+    # forward variants keep S full-T k/v arrays resident, and EXPLICIT
+    # block picks are clamped the same as defaults (an un-clamped
+    # explicit 512 at S=4 is exactly the measured 32.3 MB > 28 MB
+    # Mosaic overflow the clamp exists to prevent)
+    cap = _tm_train_block_q(S)
     blocks = (
-        _pick_block(min(block_q if block_q is not None else dq,
-                        _tm_train_block_q(S)), T),
+        _pick_block(min(block_q if block_q is not None else dq, cap), T),
         0,
-        _pick_block(
-            block_q_train
-            if block_q_train is not None
-            else min(dqt, _tm_train_block_q(S)),
-            T,
-        ),
+        _pick_block(min(block_q_train if block_q_train is not None else dqt,
+                        cap), T),
         0,
     )
     c_r = jnp.broadcast_to(
@@ -2529,19 +2527,17 @@ def multi_stream_flash_attention_tm_packed(
         f"tm kernels do not cover S={S}, T={T}; dispatch via use_tm"
     )
     dq, _, dqt, _ = default_blocks()
-    # the S>=3 clamp applies to BOTH forward variants: the no-grad
-    # (eval/inference) forward keeps S full-T k/v arrays resident just
-    # like the residual-saving one, so it shares the VMEM envelope
+    # the S>=3 clamp is a hard VMEM envelope, applied uniformly: both
+    # forward variants keep S full-T k/v arrays resident, and EXPLICIT
+    # block picks are clamped the same as defaults (an un-clamped
+    # explicit 512 at S=4 is exactly the measured 32.3 MB > 28 MB
+    # Mosaic overflow the clamp exists to prevent)
+    cap = _tm_train_block_q(S)
     blocks = (
-        _pick_block(min(block_q if block_q is not None else dq,
-                        _tm_train_block_q(S)), T),
+        _pick_block(min(block_q if block_q is not None else dq, cap), T),
         0,
-        _pick_block(
-            block_q_train
-            if block_q_train is not None
-            else min(dqt, _tm_train_block_q(S)),
-            T,
-        ),
+        _pick_block(min(block_q_train if block_q_train is not None else dqt,
+                        cap), T),
         0,
     )
     c_r = jnp.broadcast_to(

@@ -124,8 +124,28 @@ def _attention_rows(params, cfg, idx):
             )
             att = a1 - lam[None, :, None, None] * a2  # signed map, :70
             rows.append(att[:, :, -1, :])
-        else:
-            raise SystemExit("probe supports control and diff checkpoints")
+        else:  # ndiff: signed sum of n RoPE'd maps (Ndiff_transformer.py:117-123)
+            from differential_transformer_replication_tpu.ops.lambdas import (
+                ndiff_lambdas,
+                ndiff_signs,
+            )
+
+            n = p["wq"].shape[0]
+            qs = jnp.einsum("bte,nehd->nbthd", xn, p["wq"].astype(xn.dtype))
+            ks = jnp.einsum("bte,nehd->nbthd", xn, p["wk"].astype(xn.dtype))
+            qs, ks = apply_rope(qs, cos, sin), apply_rope(ks, cos, sin)
+            lams = ndiff_lambdas(
+                p["lambda_q"], p["lambda_k"], lambda_init_schedule(li)
+            )
+            coeff = ndiff_signs(n)[:, None].astype(jnp.float32) * lams
+            att = sum(
+                coeff[i][None, :, None, None]
+                * masked_softmax(
+                    jnp.einsum("bthd,bshd->bhts", qs[i], ks[i]) * scale, mask
+                )
+                for i in range(n)
+            )
+            rows.append(att[:, :, -1, :])
         x = mod.block_forward(x, blk, li, cfg, cos, sin, mask)
     return rows  # n_layer x (B, H, T)
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
@@ -106,7 +107,8 @@ def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--windows", type=int, default=3)
+    p.add_argument("--windows", type=int,
+               default=int(os.environ.get("BENCH_WINDOWS", "3")))
     p.add_argument("--attention-impl", default="pallas",
                    choices=["xla", "pallas"])
     p.add_argument("--only", type=int, default=None,
