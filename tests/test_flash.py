@@ -520,3 +520,24 @@ class TestTokenMajorNdiff:
         g_tm = jax.grad(loss_tm, argnums=(0, 1, 2))(qs, kss, v)
         for r, g in zip(g_ref, g_tm):
             np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-4)
+
+
+def test_tm_block_clamp_and_packed_ok():
+    """Round-5 dispatch helpers: the S>=3 VMEM block clamp (including
+    explicit overrides) and packed-window eligibility (offset + 128-lane
+    rules)."""
+    from differential_transformer_replication_tpu.ops import flash
+
+    assert flash._tm_train_block_q(1) == 512
+    assert flash._tm_train_block_q(2) == 512
+    assert flash._tm_train_block_q(3) == 256
+    assert flash._tm_train_block_q(4) == 256
+
+    # recipe widths: diff S=2 H=4 d=96 dv=192 -> packed eligible
+    assert flash.tm_packed_ok(2, 4, 96, 192)
+    # control S=1, dv=d -> offset 2*Hd is 2 v-blocks, eligible at H*d>=128
+    assert flash.tm_packed_ok(1, 4, 96, 96)
+    # narrow test-scale model: H*d = 32 < 128 lanes -> per-array path
+    assert not flash.tm_packed_ok(2, 2, 16, 32)
+    # exotic dv/d ratio that misaligns the v window offset
+    assert not flash.tm_packed_ok(1, 1, 128, 384)

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-5 sequential chip jobs, launched after the control 40k leg exits.
+# Each stage logs to results/; failures don't block later stages.
+set -u
+cd /root/repo
+
+CONTROL_PID=${1:?usage: r5_chip_queue.sh <control_train_pid>}
+while kill -0 "$CONTROL_PID" 2>/dev/null; do sleep 20; done
+echo "[queue] control trainer exited at $(date)"
+
+# 1. Attention probe on the control 40k checkpoints (3 seeds), matching
+#    the diff probes already recorded.
+for s in 0 1 2; do
+  python tools/attn_probe.py \
+    --checkpoint results/recipe40k_control/best.ckpt \
+    --checkpoint results/recipe40k_control/last.ckpt \
+    --tokenizer tokenizer --corpus /tmp/imgcorpus4/image_corpus.txt \
+    --trials 8 --seed $s --out results/attn_probe_control40k_s$s.json \
+    || echo "[queue] control probe seed $s FAILED"
+done
+echo "[queue] probes done $(date)"
+
+# 2. Five-config bench on the round-5 kernels.
+python tools/bench_configs.py --out results/bench_configs_r5.json \
+  || echo "[queue] bench_configs FAILED"
+echo "[queue] bench_configs done $(date)"
+
+# 3. Batched decode bench (VERDICT r4 item 5).
+python tools/decode_bench.py --batches 1 8 32 --new-tokens 1024 \
+  --out results/decode_bench_r5.json \
+  || echo "[queue] decode_bench FAILED"
+echo "[queue] decode_bench done $(date)"
+
+# 4. Saturated matched-wall-clock leg, seeds 1338/1339 (VERDICT item 7;
+#    protocol of results/ppl_gap_image_mwc_s1337.json).
+for s in 1338 1339; do
+  python tools/ppl_gap.py --models diff --iters 5253 \
+    --n-layer 8 --n-embd 768 --n-head 4 --block-size 512 \
+    --vocab-size 12000 \
+    --dataset /tmp/imgcorpus/image_corpus.txt --num-train-samples 200000 \
+    --eval-iters 100 --seed $s --attention-impl xla \
+    --out results/ppl_gap_image_mwc_s$s.json \
+    || echo "[queue] mwc seed $s FAILED"
+done
+echo "[queue] ALL DONE $(date)"
