@@ -65,3 +65,51 @@ def encode_corpus(tokenizer, texts: Sequence[str]) -> np.ndarray:
     for enc in tokenizer.encode_batch(list(texts)):
         parts.append(np.asarray(enc.ids + [eot_id], dtype=np.int32))
     return np.concatenate(parts) if parts else np.zeros((0,), np.int32)
+
+
+def tokenizer_fingerprint(tok) -> str:
+    """Content hash of the tokenizer's vocab (16 hex chars). Recorded in
+    checkpoint meta at save time so downstream tools can verify they were
+    handed the SAME tokenizer the model was trained with — equal vocab
+    SIZE is not enough (every run targets 12000, so a shared tokenizer
+    dir clobbered by a different corpus's run passes a size check with
+    entirely different token ids)."""
+    import hashlib
+    import json as _json
+
+    blob = _json.dumps(
+        sorted(tok.get_vocab().items()), ensure_ascii=False
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def check_tokenizer_matches(
+    tok, model_vocab_size: int, expected_fingerprint: str | None = None,
+    context: str = "",
+) -> None:
+    """Fail loud when a tokenizer cannot belong to the checkpointed
+    model: vocab-size mismatch always; content-fingerprint mismatch when
+    the checkpoint meta recorded one (older checkpoints did not). Both
+    failure modes otherwise produce silently-valid token ids and garbage
+    measurements (the per-run truth lives in
+    ``<tokenizer_dir>/cache-<key>/``, which pairs vocab+tokens and
+    cannot be cross-contaminated)."""
+    where = f" for {context}" if context else ""
+    if tok.get_vocab_size() != model_vocab_size:
+        raise SystemExit(
+            f"tokenizer vocab {tok.get_vocab_size()} != model vocab "
+            f"{model_vocab_size}{where} — pass the tokenizer the "
+            "checkpoint was trained with (usually "
+            "<tokenizer_dir>/cache-<key>/ from its training run)"
+        )
+    if expected_fingerprint:
+        fp = tokenizer_fingerprint(tok)
+        if fp != expected_fingerprint:
+            raise SystemExit(
+                f"tokenizer content fingerprint {fp} != the checkpoint's "
+                f"recorded {expected_fingerprint}{where}: same vocab "
+                "size, different tokenizer (a shared tokenizer dir was "
+                "likely overwritten by another run) — use the "
+                "<tokenizer_dir>/cache-<key>/ copy from this "
+                "checkpoint's training run"
+            )

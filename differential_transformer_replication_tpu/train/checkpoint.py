@@ -76,7 +76,8 @@ def _stack(state: dict) -> dict:
 
 
 def save_checkpoint(
-    path: str, state: dict, best_val_loss: float, cfg: TrainConfig
+    path: str, state: dict, best_val_loss: float, cfg: TrainConfig,
+    tokenizer_fingerprint: str | None = None,
 ) -> None:
     """train.py:310-317 equivalent (model+optimizer+scheduler state; the
     schedule is stateless here, so `step` covers it). Always written in
@@ -104,6 +105,10 @@ def save_checkpoint(
         "iter_num": int(state["step"]),
         "config": cfg.to_dict(),
     }
+    if tokenizer_fingerprint:
+        # lets downstream tools (sample.py, tools/attn_probe.py) verify
+        # tokenizer CONTENT, not just vocab size (data/tokenizer.py)
+        meta["tokenizer_fingerprint"] = tokenizer_fingerprint
     # Write-then-rename so a crash mid-save (preemption) never destroys the
     # previous good checkpoint.
     _atomic_write(os.path.join(path, "state.msgpack"), serialization.to_bytes(state))

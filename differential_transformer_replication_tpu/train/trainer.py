@@ -199,6 +199,29 @@ def train(cfg: TrainConfig) -> dict:
 
     tokenizer, vocab_size, train_ds, val_ds = build_data(cfg)
     cfg = cfg.replace(vocab_size=vocab_size)
+    from differential_transformer_replication_tpu.data.tokenizer import (
+        check_tokenizer_matches,
+        tokenizer_fingerprint,
+    )
+
+    tok_fp = tokenizer_fingerprint(tokenizer)
+    if cfg.resume_from:
+        # Resume must continue on the SAME token stream: if the cache
+        # entry was lost and the corpus re-resolved to different content,
+        # every id is still valid and training silently continues on a
+        # differently-tokenized stream — then overwrites the checkpoint,
+        # destroying the evidence. Compare content fingerprints up front
+        # (older checkpoints without one degrade to the size check).
+        import json as _json
+        import os as _os
+
+        meta_path = _os.path.join(cfg.resume_from, "meta.json")
+        if _os.path.exists(meta_path):
+            with open(meta_path) as f:
+                recorded = _json.load(f).get("tokenizer_fingerprint")
+            check_tokenizer_matches(
+                tokenizer, cfg.vocab_size, recorded, context=cfg.resume_from
+            )
 
     logger = MetricLogger(cfg)
     if cfg.mesh.pipeline > 1:
@@ -498,7 +521,8 @@ def train(cfg: TrainConfig) -> dict:
                     if write_now:
                         # collective host-gather inside; the primary writes
                         save_checkpoint(
-                            cfg.checkpoint_path, state, best_val_loss, cfg
+                            cfg.checkpoint_path, state, best_val_loss, cfg,
+                            tokenizer_fingerprint=tok_fp,
                         )
                         best_snapshot = None
                         last_best_write = time.monotonic()
@@ -560,7 +584,8 @@ def train(cfg: TrainConfig) -> dict:
                         )
                     if finite:
                         save_checkpoint(
-                            last_ckpt_path, state, best_val_loss, cfg
+                            last_ckpt_path, state, best_val_loss, cfg,
+                            tokenizer_fingerprint=tok_fp,
                         )
                     elif is_primary():
                         print(
@@ -592,7 +617,8 @@ def train(cfg: TrainConfig) -> dict:
                             f"(val loss {best_val_loss:.4f})"
                         )
                     save_checkpoint(
-                        cfg.checkpoint_path, best_snapshot, best_val_loss, cfg
+                        cfg.checkpoint_path, best_snapshot, best_val_loss,
+                        cfg, tokenizer_fingerprint=tok_fp,
                     )
                     best_snapshot = None
             except Exception as e:  # noqa: BLE001

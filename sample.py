@@ -58,11 +58,13 @@ def main() -> None:
         create_train_state,
     )
 
+    fp = None  # save_pretrained dirs carry no meta.json / fingerprint
     if os.path.exists(os.path.join(args.checkpoint, "params.msgpack")):
         params, model_cfg = from_pretrained(args.checkpoint)
     else:
         with open(os.path.join(args.checkpoint, "meta.json")) as f:
             meta = json.load(f)
+        fp = meta.get("tokenizer_fingerprint")
         saved = meta["config"]
         model_cfg = ModelConfig(**saved["model"])
         cfg = TrainConfig(
@@ -74,7 +76,16 @@ def main() -> None:
         state, _ = load_checkpoint(args.checkpoint, cfg, state)
         params, model_cfg = state["params"], cfg.resolved_model()
 
+    from differential_transformer_replication_tpu.data.tokenizer import (
+        check_tokenizer_matches,
+    )
+
     tokenizer = load_tokenizer(args.tokenizer)
+    # training checkpoints record the tokenizer's content fingerprint;
+    # fail loud on any mismatch instead of decoding gibberish
+    check_tokenizer_matches(
+        tokenizer, model_cfg.vocab_size, fp, context=args.checkpoint
+    )
     ids = tokenizer.encode(args.prompt).ids
     if not ids:
         raise SystemExit("prompt encoded to zero tokens")

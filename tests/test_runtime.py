@@ -201,3 +201,43 @@ class TestCheckpointThrottle:
         out = capsys.readouterr().out
         assert "Saving best model" in out
         assert "writing pending best checkpoint" not in out
+
+
+class TestTokenizerFingerprint:
+    def test_checkpoint_records_and_guard_verifies(self, tmp_path):
+        """Checkpoints record the tokenizer's content fingerprint, and
+        check_tokenizer_matches rejects a SAME-SIZE different tokenizer
+        (vocab-size equality alone cannot catch a clobbered shared
+        tokenizer dir — every run targets the same vocab size)."""
+        import json as _json
+        import os
+
+        import pytest as _pytest
+
+        from differential_transformer_replication_tpu.data.tokenizer import (
+            check_tokenizer_matches,
+            load_tokenizer,
+            tokenizer_fingerprint,
+        )
+
+        cfg = tiny_cfg(tmp_path, max_iters=6, eval_interval=5)
+        train(cfg)
+        meta = _json.load(
+            open(os.path.join(cfg.checkpoint_path, "meta.json"))
+        )
+        fp = meta.get("tokenizer_fingerprint")
+        assert fp, "checkpoint meta must record the tokenizer fingerprint"
+
+        cache = next(
+            d for d in os.listdir(cfg.tokenizer_dir) if d.startswith("cache-")
+        )
+        tok = load_tokenizer(os.path.join(cfg.tokenizer_dir, cache))
+        assert tokenizer_fingerprint(tok) == fp
+        # matching tokenizer passes both checks
+        check_tokenizer_matches(tok, tok.get_vocab_size(), fp)
+        # same size, different content -> fail loud
+        with _pytest.raises(SystemExit, match="fingerprint"):
+            check_tokenizer_matches(tok, tok.get_vocab_size(), "0" * 16)
+        # wrong size -> fail loud regardless of fingerprint
+        with _pytest.raises(SystemExit, match="vocab"):
+            check_tokenizer_matches(tok, tok.get_vocab_size() + 1)

@@ -64,7 +64,7 @@ def _load_model(ckpt: str):
     cfg = TrainConfig(model=model_cfg, **cd)
     state = create_train_state(jax.random.PRNGKey(0), cfg)
     state, _ = load_checkpoint(ckpt, cfg, state)
-    return state["params"], cfg.resolved_model()
+    return state["params"], cfg.resolved_model(), meta.get("tokenizer_fingerprint")
 
 
 def _attention_rows(params, cfg, idx):
@@ -224,14 +224,16 @@ def main() -> None:
         raise SystemExit(f"empty tokenizer at {args.tokenizer!r}")
 
     results = {}
+    from differential_transformer_replication_tpu.data.tokenizer import (
+        check_tokenizer_matches,
+    )
+
     for ckpt in args.checkpoint:
-        params, cfg = _load_model(ckpt)
-        if tok.get_vocab_size() > cfg.vocab_size:
-            raise SystemExit(
-                f"tokenizer vocab {tok.get_vocab_size()} exceeds model "
-                f"vocab {cfg.vocab_size} for {ckpt!r} — pass the tokenizer "
-                "the checkpoint was trained with"
-            )
+        params, cfg, fp = _load_model(ckpt)
+        # fail loud on vocab-size AND content-fingerprint mismatches — a
+        # wrong same-size tokenizer yields valid ids and silently
+        # measures the model on gibberish windows (data/tokenizer.py)
+        check_tokenizer_matches(tok, cfg.vocab_size, fp, context=ckpt)
         per_depth = {}
         for depth in args.depths:
             rng = random.Random(args.seed)  # identical windows per model
