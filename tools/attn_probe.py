@@ -25,8 +25,8 @@ on the needle sentence, and on the distractor context ("noise"), plus the
 paper's signal-to-noise ratio, averaged over heads and layers and broken
 out by needle depth.
 
-    python tools/attn_probe.py --checkpoint sp_s1337/ppl_gap_diff.ckpt \
-        --tokenizer sp_s1337/tokenizer --corpus image_corpus.txt
+    python tools/attn_probe.py --checkpoint results/recipe40k/best.ckpt \
+        --tokenizer tokenizer/cache-<key> --corpus image_corpus.txt
 """
 
 from __future__ import annotations
