@@ -109,6 +109,70 @@ class ModelConfig:
 
 
 @dataclass(frozen=True)
+class ServingConfig:
+    """Continuous-batching inference engine knobs (serving/engine.py).
+
+    The engine holds a fixed pool of ``num_slots`` KV-cache slots (one
+    per in-flight sequence) and runs one iteration per step: admit queued
+    requests into free slots, advance prefill by at most
+    ``prefill_budget`` prompt tokens (in power-of-two chunks no larger
+    than ``prefill_chunk``), then decode every active slot as one batched
+    length-1 chunk. All shapes are static — slot count, chunk ladder and
+    RoPE table length are fixed at engine build — so admissions and
+    retirements never recompile (Orca-style iteration-level scheduling
+    over a vLLM-style slot pool; no reference analog).
+    """
+
+    # Fixed decode batch = KV slot pool size. Memory scales linearly:
+    # each slot owns a full (n_layer, S, block_size) K/V ring.
+    num_slots: int = 8
+    # Largest single prefill chunk (tokens). Prompts are split into
+    # descending power-of-two chunks <= this, so at most
+    # log2(prefill_chunk)+1 prefill shapes ever compile.
+    prefill_chunk: int = 128
+    # Max prompt tokens prefilled per engine iteration, across all
+    # admissions (FCFS). Bounds how long a burst of long prompts can
+    # stall decoding sequences — Orca's iteration-level fairness knob.
+    prefill_budget: int = 256
+    # RoPE table length = hard cap on prompt + generated tokens for the
+    # RoPE families (control/ndiff), which may roll past block_size on
+    # the ring cache. 0 = block_size (in-window only). The diff family's
+    # learned absolute position table cannot roll (models/decode.py), so
+    # it is always capped at block_size regardless of this value.
+    max_seq_len: int = 0
+    # Default stop token; a request's SamplingParams.eos_token_id
+    # overrides. None = length-only termination (the reference has no
+    # EOS concept in generation, control.py:163-171).
+    eos_token_id: Optional[int] = None
+
+    def __post_init__(self):
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
+        if self.prefill_chunk < 1 or (
+            self.prefill_chunk & (self.prefill_chunk - 1)
+        ):
+            raise ValueError(
+                f"prefill_chunk must be a positive power of two, got "
+                f"{self.prefill_chunk}"
+            )
+        if self.prefill_budget < 1:
+            raise ValueError(
+                f"prefill_budget must be >= 1, got {self.prefill_budget}"
+            )
+        if self.max_seq_len < 0:
+            raise ValueError(f"max_seq_len must be >= 0, got {self.max_seq_len}")
+
+    def resolved_max_seq_len(self, model: "ModelConfig") -> int:
+        """Hard cap on prompt + generated length for this model family."""
+        if model.model == "diff":
+            return model.block_size
+        return max(self.max_seq_len, model.block_size)
+
+    def replace(self, **kw) -> "ServingConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Logical device mesh. The reference has no working distributed path
     (NCCL/DDP imported but never initialized, train.py:7-10,88); this is the

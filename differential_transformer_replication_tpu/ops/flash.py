@@ -56,6 +56,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from differential_transformer_replication_tpu.utils.compat import (
+    CompilerParams as _CompilerParams,
+)
+
 from differential_transformer_replication_tpu.ops.streams import (
     NEG_INF,
     diff_coeffs,
@@ -532,7 +536,7 @@ def _fwd_call(
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shapes,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
@@ -706,7 +710,7 @@ def _tiled_fwd_call(
             pltpu.VMEM((S, block_q), jnp.float32),
             pltpu.VMEM((S, block_q, dv), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -950,7 +954,7 @@ def _tiled_bwd_call(
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((S, block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -991,7 +995,7 @@ def _tiled_bwd_call(
             pltpu.VMEM((S, block_k, d), jnp.float32),
             pltpu.VMEM((block_k, dv_width), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -1350,7 +1354,7 @@ def _fused_bwd_call(
             jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
             jax.ShapeDtypeStruct((BH, T, dv_width), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)
         ),
         interpret=interpret,
@@ -1450,7 +1454,7 @@ def _bwd_call(
         out_specs=pl.BlockSpec((1, S, block_q, d), lambda b, i: (b, 0, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
@@ -1496,7 +1500,7 @@ def _bwd_call(
             jax.ShapeDtypeStruct((BH, S, T, d), q.dtype),
             jax.ShapeDtypeStruct((BH, T, dv_width), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
@@ -1632,7 +1636,7 @@ def _chunk_fwd_call(q, k, v, offset, *, block_q, block_k, interpret,
             jax.ShapeDtypeStruct((BH, S, T, dv), q.dtype),
             jax.ShapeDtypeStruct((BH, S, T), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
@@ -2002,7 +2006,7 @@ def _tm_fwd_call(
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shapes,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel"),
             vmem_limit_bytes=_TM_VMEM_LIMIT,
         ),
@@ -2150,7 +2154,7 @@ def _tm_bwd_call(qs, ks, v, g, lse, delta, coeffs, *, H: int, interpret: bool):
             + [jax.ShapeDtypeStruct((B, T, Hd), qs[0].dtype)] * S
             + [jax.ShapeDtypeStruct((B, T, Hdv), v.dtype)]
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",),
             vmem_limit_bytes=_TM_VMEM_LIMIT,
         ),
@@ -2181,7 +2185,11 @@ _TM_TRAIN_BLOCK_Q = 512 if _TM_VMEM_LIMIT >= 20 * 1024 * 1024 else 256
 
 
 def _tm_train_block_q(S: int) -> int:
-    return min(_TM_TRAIN_BLOCK_Q, 256 if S >= 3 else 512)
+    # S>=3 drops to 256-row blocks (the VMEM measurement above), still
+    # capped by _TM_TRAIN_BLOCK_Q; S<=2 takes _TM_TRAIN_BLOCK_Q
+    # directly. The limit-dependent choice lives in ONE place and cannot
+    # drift from a future _TM_VMEM_LIMIT edit (ADVICE r5 finding 3).
+    return min(_TM_TRAIN_BLOCK_Q, 256) if S >= 3 else _TM_TRAIN_BLOCK_Q
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -2379,7 +2387,7 @@ def _tm_fwd_call_packed(
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shapes,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel"),
             vmem_limit_bytes=_TM_VMEM_LIMIT,
         ),
@@ -2462,7 +2470,7 @@ def _tm_bwd_call_packed(
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[jax.ShapeDtypeStruct((B, T, W), proj.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",),
             vmem_limit_bytes=_TM_VMEM_LIMIT,
         ),

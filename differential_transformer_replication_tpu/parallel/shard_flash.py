@@ -38,6 +38,7 @@ from differential_transformer_replication_tpu.ops.streams import (
     ndiff_coeffs,
     vanilla_coeffs,
 )
+from differential_transformer_replication_tpu.utils.compat import shard_map as _shard_map
 
 _BATCH_AXES = ("data", "fsdp")
 _HEAD_AXIS = "tensor"
@@ -86,7 +87,7 @@ def shard_flash_multi_stream_attention(
                 dropout_rng=jax.random.fold_in(rng, pos),
             )
 
-        inner = jax.shard_map(
+        inner = _shard_map(
             body,
             mesh=mesh,
             in_specs=(qk_spec, qk_spec, v_spec, c_spec, P()),
@@ -98,7 +99,7 @@ def shard_flash_multi_stream_attention(
     def body(qs_l, ks_l, v_l, c_l):
         return multi_stream_flash_attention(qs_l, ks_l, v_l, c_l)
 
-    inner = jax.shard_map(
+    inner = _shard_map(
         body,
         mesh=mesh,
         in_specs=(qk_spec, qk_spec, v_spec, c_spec),

@@ -1,0 +1,29 @@
+"""Continuous-batching inference serving (see serving/engine.py)."""
+
+from differential_transformer_replication_tpu.serving.engine import (
+    ServingEngine,
+)
+from differential_transformer_replication_tpu.serving.request import (
+    Request,
+    RequestOutput,
+    SamplingParams,
+)
+from differential_transformer_replication_tpu.serving.scheduler import (
+    Scheduler,
+)
+from differential_transformer_replication_tpu.serving.server import (
+    EngineRunner,
+    ServingClient,
+    serve,
+)
+
+__all__ = [
+    "ServingEngine",
+    "Request",
+    "RequestOutput",
+    "SamplingParams",
+    "Scheduler",
+    "EngineRunner",
+    "ServingClient",
+    "serve",
+]

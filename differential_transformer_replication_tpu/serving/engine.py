@@ -1,0 +1,409 @@
+"""Continuous-batching inference engine.
+
+The single-request generators (models/generate.py, models/decode.py:
+``generate_cached``) answer one prompt at a time; a serving workload has
+many concurrent users with different prompt lengths, arrival times and
+sampling params. This engine closes that gap with the two standard
+techniques:
+
+- **Slot-pool KV cache** (vLLM-style, minus paging): one fixed
+  ``init_cache(cfg, num_slots)`` pool holds every in-flight sequence's
+  K/V rings. A request owns one slot row from admission to retirement;
+  rows are reused WITHOUT clearing because the ring mask derives
+  visibility purely from position arithmetic (models/decode.py:
+  ``_attn_chunk``) — a fresh prefill at pos=0 makes every stale key
+  invisible by construction.
+- **Iteration-level (Orca-style) scheduling**: each :meth:`step` admits
+  queued requests into free slots, advances prefill by a bounded token
+  budget (serving/scheduler.py), then decodes ALL active slots as one
+  batched length-1 ``forward_chunk``. Sequences retire on EOS or
+  max-tokens without stalling the rest of the batch; the freed slot is
+  refilled on the next iteration.
+
+Everything device-side is shape-static, so continuous batching costs no
+recompilation as requests come and go:
+
+- the decode step is one jitted call over the FULL pool — per-slot
+  positions/tokens/active-mask are runtime arrays (inactive rows compute
+  garbage that a masked cache-merge discards);
+- prefill chunks come from a power-of-two ladder, so at most
+  log2(prefill_chunk)+1 prefill shapes ever compile;
+- sampling is one jitted batched kernel with per-row temperature/top-k
+  ARRAYS (models/generate.py:``sample_token`` bakes them into the trace
+  as statics; rows here must differ without recompiling). The greedy and
+  default paths are bit-identical to ``sample_token`` — pinned by
+  tests/test_serving.py.
+
+Mixed per-slot positions ride a ``jax.vmap`` over ``forward_chunk``
+(each row carries its own ``pos`` scalar, exactly the traced-position
+path the chunked decoder already supports); ``forward_chunk``'s
+concrete-position validity guards are enforced host-side at submit
+instead. Per-request determinism: the key for the t-th generated token
+is ``fold_in(PRNGKey(seed), t)``, a pure function of the request — not
+of slot assignment, batch composition, or admission order.
+
+Family limits (models/decode.py module docstring): control/ndiff roll
+the ring past block_size up to ``ServingConfig.max_seq_len``; the diff
+family's learned absolute position table cannot roll, so its requests
+are capped at ``prompt + max_new_tokens <= block_size``.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import lru_cache
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from differential_transformer_replication_tpu.config import (
+    ModelConfig,
+    ServingConfig,
+)
+from differential_transformer_replication_tpu.models.decode import (
+    forward_chunk,
+    init_cache,
+)
+from differential_transformer_replication_tpu.serving.request import (
+    Request,
+    RequestOutput,
+    SamplingParams,
+)
+from differential_transformer_replication_tpu.serving.scheduler import (
+    ACTIVE,
+    Scheduler,
+    Slot,
+)
+
+
+@lru_cache(maxsize=None)
+def _build_step_fns(cfg: ModelConfig, rope_len: int):
+    """Jitted (prefill, decode, sample) closures for (cfg, rope_len).
+
+    Cached at module level so engines with the same model/config share
+    compile caches (and tests can count compiles across engine
+    rebuilds); every argument below is a runtime array, so each closure
+    compiles once per distinct input SHAPE only.
+    """
+    row_axes = [{"k": 1, "v": 0}] * cfg.n_layer  # pool layout per layer
+
+    def _one_row(params, token, pos, cache_row):
+        # cache_row: per-layer {"k": (S, M, H, d), "v": (M, H, dv)} — one
+        # pool row; re-add the batch axis forward_chunk expects.
+        cache_b = [
+            {"k": c["k"][:, None], "v": c["v"][None]} for c in cache_row
+        ]
+        logits, new_cache = forward_chunk(
+            params, token[None, None], pos, cache_b, cfg, rope_len=rope_len
+        )
+        new_row = [{"k": c["k"][:, 0], "v": c["v"][0]} for c in new_cache]
+        return logits[0, -1].astype(jnp.float32), new_row
+
+    def _decode(params, tokens, pos, active, cache):
+        """One batched length-1 step over the WHOLE slot pool.
+
+        tokens/pos/active: (B,) runtime arrays. Inactive rows run the
+        same math on garbage inputs (static shapes are the point); the
+        masked merge below discards their cache writes so a mid-prefill
+        or free slot is never corrupted by the fused step.
+        """
+        logits, new_cache = jax.vmap(
+            _one_row, in_axes=(None, 0, 0, row_axes), out_axes=(0, row_axes)
+        )(params, tokens, pos, cache)
+        merged = [
+            {
+                "k": jnp.where(
+                    active[None, :, None, None, None], nc["k"], oc["k"]
+                ),
+                "v": jnp.where(active[:, None, None, None], nc["v"], oc["v"]),
+            }
+            for nc, oc in zip(new_cache, cache)
+        ]
+        return logits, merged
+
+    def _prefill(params, cache, slot, tokens, pos):
+        """One prompt chunk for one slot, in place in the pool.
+
+        tokens: (1, L) with L from the power-of-two ladder; slot/pos are
+        runtime scalars (dynamic gather/scatter on the pool's batch
+        axis), so only L distinguishes compiles.
+        """
+        row = [
+            {"k": c["k"][:, slot][:, None], "v": c["v"][slot][None]}
+            for c in cache
+        ]
+        logits, new_row = forward_chunk(
+            params, tokens, pos, row, cfg, rope_len=rope_len
+        )
+        new_cache = [
+            {
+                "k": c["k"].at[:, slot].set(nr["k"][:, 0]),
+                "v": c["v"].at[slot].set(nr["v"][0]),
+            }
+            for c, nr in zip(cache, new_row)
+        ]
+        return logits[0, -1].astype(jnp.float32), new_cache
+
+    def _sample(bases, counts, logits, temperature, top_k):
+        """Batched per-request sampling over (B, V) fp32 logits.
+
+        bases (B, 2) uint32 + counts (B,): the t-th token's key is
+        fold_in(base, t). temperature/top_k are PER-ROW arrays;
+        semantics match sample_token row-for-row (<=0 temp = greedy,
+        top_k <= 0 = off, mask-below-kth-logit otherwise).
+        """
+        keys = jax.vmap(jax.random.fold_in)(bases, counts)
+        V = logits.shape[-1]
+        kth = jnp.clip(top_k - 1, 0, V - 1)
+        sorted_desc = -jnp.sort(-logits, axis=-1)
+        thresh = jnp.take_along_axis(sorted_desc, kth[:, None], axis=-1)
+        masked = jnp.where(
+            (top_k > 0)[:, None] & (logits < thresh), -jnp.inf, logits
+        )
+        greedy = jnp.argmax(masked, axis=-1)
+        safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+        drawn = jax.vmap(lambda k, lg: jax.random.categorical(k, lg))(
+            keys, masked / safe_t
+        )
+        return jnp.where(temperature <= 0, greedy, drawn).astype(jnp.int32)
+
+    # Donate the cache pool so XLA updates it in place instead of
+    # allocating + copying a second full pool per chunk/step (the engine
+    # always rebinds self.cache to the result, so the old buffers are
+    # dead). CPU has no donation support and would warn on every call.
+    donate = jax.default_backend() != "cpu"
+    return (
+        jax.jit(_prefill, donate_argnums=(1,) if donate else ()),
+        jax.jit(_decode, donate_argnums=(4,) if donate else ()),
+        jax.jit(_sample),
+    )
+
+
+class ServingEngine:
+    """Continuous-batching engine over one model's params.
+
+    Drive it either synchronously — ``submit()`` then ``run()`` /
+    ``generate()`` — or one :meth:`step` at a time (what the background
+    thread in serving/server.py does). Not thread-safe by itself; wrap
+    it in :class:`serving.server.EngineRunner` for concurrent callers.
+    """
+
+    def __init__(self, params: dict, cfg: ModelConfig,
+                 serving: Optional[ServingConfig] = None):
+        self.params = params
+        self.cfg = cfg
+        self.serving = serving or ServingConfig()
+        self.max_total = self.serving.resolved_max_seq_len(cfg)
+        self._prefill_fn, self._decode_fn, self._sample_fn = _build_step_fns(
+            cfg, self.max_total
+        )
+        self.cache = init_cache(cfg, self.serving.num_slots)
+        self.scheduler = Scheduler(self.serving)
+        self._next_id = 0
+        self._base_keys: dict = {}  # request_id -> np (2,) uint32 PRNG base
+        self.stats = {
+            "iterations": 0,
+            "prefill_tokens": 0,
+            "decode_tokens": 0,
+            "completed": 0,
+        }
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, prompt: Sequence[int],
+               params: Optional[SamplingParams] = None, **kw) -> int:
+        """Queue one request; returns its request_id. ``kw`` are
+        SamplingParams fields (max_new_tokens, temperature, top_k, seed,
+        eos_token_id). Raises ValueError when the request cannot fit the
+        engine's static shapes (see module docstring on family limits).
+        """
+        rid = self._next_id
+        self._next_id += 1
+        req = Request.make(rid, prompt, params, **kw)
+        M = self.cfg.block_size
+        p = np.asarray(req.prompt, np.int32)
+        if self.cfg.model == "diff":
+            if p.shape[0] + req.params.max_new_tokens > M:
+                raise ValueError(
+                    f"prompt ({p.shape[0]}) + max_new_tokens "
+                    f"({req.params.max_new_tokens}) exceeds block_size ({M}) "
+                    "and the diff family's learned absolute position table "
+                    "cannot roll with a KV cache (models/decode.py)"
+                )
+        else:
+            if p.shape[0] > M:
+                p = p[-M:]  # the reference's own crop (control.py:165)
+            if p.shape[0] + req.params.max_new_tokens > self.max_total:
+                raise ValueError(
+                    f"cropped prompt ({p.shape[0]}) + max_new_tokens "
+                    f"({req.params.max_new_tokens}) exceeds the engine's "
+                    f"max_seq_len ({self.max_total}); build the engine with "
+                    "a larger ServingConfig.max_seq_len"
+                )
+        self._base_keys[rid] = np.asarray(
+            jax.random.PRNGKey(req.params.seed), np.uint32
+        )
+        self.scheduler.submit(req, p, time.perf_counter())
+        return rid
+
+    # -- one engine iteration -----------------------------------------
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    def step(self) -> List[RequestOutput]:
+        """Admit -> prefill (budgeted) -> batched decode. Returns the
+        requests that finished THIS iteration."""
+        if not self.scheduler.has_work():
+            return []
+        finished: List[RequestOutput] = []
+
+        for slot, start, size in self.scheduler.plan():
+            tokens = jnp.asarray(slot.prompt[start:start + size][None])
+            logits, self.cache = self._prefill_fn(
+                self.params, self.cache, np.int32(slot.index), tokens,
+                np.int32(start),
+            )
+            slot.filled = start + size
+            self.stats["prefill_tokens"] += size
+            if slot.filled == slot.prompt_len:
+                # prompt complete: the chunk's last-position logits give
+                # the first generated token (generate_cached's contract)
+                tok = self._sample_rows([slot], logits[None])[0]
+                self._emit(slot, int(tok), time.perf_counter(), finished)
+
+        active = self.scheduler.active_slots()
+        if active:
+            B = self.serving.num_slots
+            tokens = np.zeros((B,), np.int32)
+            pos = np.zeros((B,), np.int32)
+            mask = np.zeros((B,), bool)
+            for s in active:
+                tokens[s.index] = s.generated[-1]
+                pos[s.index] = s.prompt_len + len(s.generated) - 1
+                mask[s.index] = True
+            logits, self.cache = self._decode_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(pos),
+                jnp.asarray(mask), self.cache,
+            )
+            sampled = self._sample_all_slots(logits)
+            now = time.perf_counter()
+            self.stats["decode_tokens"] += len(active)
+            for s in active:
+                self._emit(s, int(sampled[s.index]), now, finished)
+
+        self.stats["iterations"] += 1
+        return finished
+
+    def run(self) -> List[RequestOutput]:
+        """Drain the queue; returns every output, in completion order."""
+        outs: List[RequestOutput] = []
+        while self.scheduler.has_work():
+            outs.extend(self.step())
+        return outs
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 params: Optional[Sequence[SamplingParams]] = None,
+                 **kw) -> List[RequestOutput]:
+        """Submit-all + drain convenience; outputs in submission order.
+        ``params`` gives per-request SamplingParams; otherwise ``kw``
+        build one shared SamplingParams."""
+        shared = SamplingParams(**kw) if params is None else None
+        ids = [
+            self.submit(p, params=shared if shared else params[i])
+            for i, p in enumerate(prompts)
+        ]
+        by_id = {o.request_id: o for o in self.run()}
+        return [by_id[i] for i in ids]
+
+    def compile_stats(self) -> dict:
+        """Compile-cache sizes of the engine's jitted closures. Pinned by
+        tests/test_serving.py: decode must stay at 1 entry no matter how
+        requests come and go. NOTE the closures are shared across engines
+        with identical (cfg, max_seq_len) — counts are per-config, not
+        per-instance."""
+        return {
+            "prefill": self._prefill_fn._cache_size(),
+            "decode": self._decode_fn._cache_size(),
+            "sample": self._sample_fn._cache_size(),
+        }
+
+    # -- internals ----------------------------------------------------
+
+    def _sample_rows(self, slots: List[Slot], logits) -> np.ndarray:
+        """Sample one token for each given slot from (n, V) logits."""
+        bases = jnp.asarray(
+            np.stack([
+                self._base_keys[s.request.request_id] for s in slots
+            ])
+        )
+        counts = jnp.asarray(
+            [len(s.generated) for s in slots], jnp.int32
+        )
+        temps = jnp.asarray(
+            [s.request.params.temperature for s in slots], jnp.float32
+        )
+        topks = jnp.asarray(
+            [(s.request.params.top_k or 0) for s in slots], jnp.int32
+        )
+        return np.asarray(
+            self._sample_fn(bases, counts, logits, temps, topks)
+        )
+
+    def _sample_all_slots(self, logits) -> np.ndarray:
+        """Full-pool variant with inert defaults on non-active rows, so
+        the decode-path sampler always sees the same (B, V) shape."""
+        B = self.serving.num_slots
+        bases = np.zeros((B, 2), np.uint32)
+        counts = np.zeros((B,), np.int32)
+        temps = np.ones((B,), np.float32)
+        topks = np.zeros((B,), np.int32)
+        for s in self.scheduler.active_slots():
+            p = s.request.params
+            bases[s.index] = self._base_keys[s.request.request_id]
+            counts[s.index] = len(s.generated)
+            temps[s.index] = p.temperature
+            topks[s.index] = p.top_k or 0
+        return np.asarray(
+            self._sample_fn(
+                jnp.asarray(bases), jnp.asarray(counts), logits,
+                jnp.asarray(temps), jnp.asarray(topks),
+            )
+        )
+
+    def _emit(self, slot: Slot, token: int, now: float,
+              finished: List[RequestOutput]) -> None:
+        slot.generated.append(token)
+        slot.token_times.append(now)
+        if len(slot.generated) == 1:
+            slot.first_token_time = now
+            slot.state = ACTIVE
+        p = slot.request.params
+        eos = (
+            p.eos_token_id
+            if p.eos_token_id is not None
+            else self.serving.eos_token_id
+        )
+        hit_eos = eos is not None and token == eos
+        if hit_eos or len(slot.generated) >= p.max_new_tokens:
+            finished.append(
+                self._finish(slot, "eos" if hit_eos else "length")
+            )
+
+    def _finish(self, slot: Slot, reason: str) -> RequestOutput:
+        out = RequestOutput(
+            request_id=slot.request.request_id,
+            prompt=[int(t) for t in slot.prompt],
+            tokens=list(slot.generated),
+            finish_reason=reason,
+            submit_time=slot.submit_time,
+            first_token_time=slot.first_token_time,
+            finish_time=slot.token_times[-1],
+            token_times=list(slot.token_times),
+        )
+        del self._base_keys[slot.request.request_id]
+        self.stats["completed"] += 1
+        self.scheduler.retire(slot)
+        return out

@@ -1,0 +1,114 @@
+"""Serving request/response dataclasses.
+
+The unit of work for the continuous-batching engine (serving/engine.py):
+a token-id prompt plus per-request sampling parameters threaded through
+the same ``sample_token`` contract as models/generate.py (temperature-1
+categorical by default, temperature 0 = greedy, optional top-k). Each
+request carries its own ``seed``: the engine derives the key for the
+t-th generated token as ``fold_in(PRNGKey(seed), t)``, so sampled output
+is a pure function of (params, prompt, params, seed) — independent of
+slot assignment, batch composition, and admission order. Timing fields
+on the output feed the serving bench's TTFT/ITL percentiles
+(tools/serve_bench.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs (models/generate.py:sample_token).
+
+    Defaults reproduce the reference generation contract: temperature 1,
+    no top-k (control.py:168-169). ``temperature <= 0`` means greedy
+    argmax; ``top_k`` None/<=0 means off.
+    """
+
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    seed: int = 0
+    # Stop token for THIS request; None defers to ServingConfig's
+    # engine-wide default. The matching token is included in the output.
+    eos_token_id: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+            )
+        # type-check here, where every construction path (HTTP handler,
+        # client kwargs, programmatic) funnels through: a non-int top_k
+        # would otherwise only explode later inside the engine's batched
+        # sampler — on the engine thread, wedging the whole server
+        if self.top_k is not None and not isinstance(self.top_k, int):
+            raise ValueError(f"top_k must be an int or None, got {self.top_k!r}")
+        if self.eos_token_id is not None and not isinstance(
+            self.eos_token_id, int
+        ):
+            raise ValueError(
+                f"eos_token_id must be an int or None, got {self.eos_token_id!r}"
+            )
+        if not isinstance(self.temperature, (int, float)):
+            raise ValueError(
+                f"temperature must be a number, got {self.temperature!r}"
+            )
+
+
+@dataclass(frozen=True)
+class Request:
+    """One queued generation: a prompt (token ids) + sampling params."""
+
+    request_id: int
+    prompt: tuple  # token ids, length >= 1
+    params: SamplingParams = field(default_factory=SamplingParams)
+
+    @staticmethod
+    def make(request_id: int, prompt: Sequence[int],
+             params: Optional[SamplingParams] = None, **kw) -> "Request":
+        """Convenience constructor: ``kw`` are SamplingParams fields."""
+        if params is None:
+            params = SamplingParams(**kw)
+        elif kw:
+            raise ValueError("pass params or keyword fields, not both")
+        prompt = tuple(int(t) for t in prompt)
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        return Request(request_id=request_id, prompt=prompt, params=params)
+
+
+@dataclass
+class RequestOutput:
+    """Completed generation + the timestamps the bench needs.
+
+    ``tokens`` holds only the GENERATED ids (eos included when hit);
+    ``prompt`` echoes the prompt the engine actually ran — for the RoPE
+    families a longer-than-block_size prompt is cropped to its last
+    block_size ids, the reference's own semantics (control.py:165,
+    mirrored by generate_cached, models/decode.py).
+    """
+
+    request_id: int
+    prompt: List[int]
+    tokens: List[int]
+    finish_reason: str  # "length" | "eos"
+    submit_time: float = 0.0
+    first_token_time: float = 0.0
+    finish_time: float = 0.0
+    # host timestamp at which each generated token was collected
+    token_times: List[float] = field(default_factory=list)
+
+    @property
+    def ttft(self) -> float:
+        """Time to first token (seconds)."""
+        return self.first_token_time - self.submit_time
+
+    @property
+    def itls(self) -> List[float]:
+        """Inter-token latencies (seconds) between consecutive tokens."""
+        return [
+            b - a for a, b in zip(self.token_times[:-1], self.token_times[1:])
+        ]

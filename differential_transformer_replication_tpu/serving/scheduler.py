@@ -1,0 +1,163 @@
+"""Admission + iteration-level scheduling for the serving engine.
+
+Orca-style continuous batching: scheduling decisions are made per
+ITERATION, not per request. Each call to :meth:`Scheduler.plan` (one
+engine step) does two things, both FCFS:
+
+1. **Admission** — queued requests move into FREE slots of the fixed
+   pool while any are free. A request occupies exactly one slot from
+   admission to retirement; the pool size never grows, so the decode
+   batch shape is static and admissions never recompile.
+2. **Prefill planning** — slots still prefilling advance by at most
+   ``prefill_budget`` prompt tokens per iteration, split into
+   descending power-of-two chunks no larger than ``prefill_chunk``.
+   The budget is the fairness knob: without it, one block_size-long
+   prompt would stall every decoding sequence for its whole prefill
+   (the "prefill starves decode" failure mode Orca's iteration-level
+   scheduling exists to fix). The power-of-two ladder bounds the set of
+   chunk shapes that ever compile to log2(prefill_chunk)+1.
+
+The scheduler is pure host-side bookkeeping — slot state, queue, stats.
+Device work (the actual chunk/decode calls) lives in serving/engine.py.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+from differential_transformer_replication_tpu.config import ServingConfig
+from differential_transformer_replication_tpu.serving.request import Request
+
+FREE = "free"
+PREFILL = "prefill"
+ACTIVE = "active"
+
+
+@dataclass
+class Slot:
+    """One KV-cache slot's host-side state."""
+
+    index: int
+    state: str = FREE
+    request: Optional[Request] = None
+    prompt: Optional[np.ndarray] = None  # cropped prompt actually run
+    filled: int = 0  # prompt tokens already prefilled
+    generated: List[int] = field(default_factory=list)
+    admit_seq: int = -1  # admission order, for FCFS prefill within a step
+    submit_time: float = 0.0
+    first_token_time: float = 0.0
+    token_times: List[float] = field(default_factory=list)
+
+    @property
+    def prompt_len(self) -> int:
+        return 0 if self.prompt is None else int(self.prompt.shape[0])
+
+    def reset(self) -> None:
+        self.state = FREE
+        self.request = None
+        self.prompt = None
+        self.filled = 0
+        self.generated = []
+        self.admit_seq = -1
+        self.submit_time = 0.0
+        self.first_token_time = 0.0
+        self.token_times = []
+
+
+def _pow2_chunk(n: int, cap: int) -> int:
+    """Largest power of two <= min(n, cap); n, cap >= 1."""
+    m = min(n, cap)
+    return 1 << (m.bit_length() - 1)
+
+
+class Scheduler:
+    """FCFS queue + slot pool bookkeeping (see module docstring)."""
+
+    def __init__(self, serving: ServingConfig):
+        self.serving = serving
+        self.slots = [Slot(index=i) for i in range(serving.num_slots)]
+        self.queue: Deque[Tuple[Request, np.ndarray, float]] = deque()
+        self._admit_seq = 0
+        # invariant checked by tests: concurrent occupied slots never
+        # exceed the pool
+        self.max_concurrent = 0
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, request: Request, prompt: np.ndarray,
+               submit_time: float) -> None:
+        """Enqueue an engine-validated (request, cropped prompt) pair."""
+        self.queue.append((request, prompt, submit_time))
+
+    # -- queries ------------------------------------------------------
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(s.state != FREE for s in self.slots)
+
+    def free_slots(self) -> List[Slot]:
+        return [s for s in self.slots if s.state == FREE]
+
+    def active_slots(self) -> List[Slot]:
+        return [s for s in self.slots if s.state == ACTIVE]
+
+    def occupied(self) -> int:
+        return sum(1 for s in self.slots if s.state != FREE)
+
+    # -- the per-iteration decision -----------------------------------
+
+    def plan(self) -> List[Tuple[Slot, int, int]]:
+        """Admit + plan this iteration's prefill work.
+
+        Returns ``[(slot, start, length), ...]`` chunks (FCFS by
+        admission order, budget-capped); the engine executes them in
+        order and flips a slot to ACTIVE when its prompt completes.
+        """
+        for slot in self.slots:
+            if not self.queue:
+                break
+            if slot.state != FREE:
+                continue
+            request, prompt, t_submit = self.queue.popleft()
+            slot.state = PREFILL
+            slot.request = request
+            slot.prompt = prompt
+            slot.filled = 0
+            slot.generated = []
+            slot.token_times = []
+            slot.submit_time = t_submit
+            slot.admit_seq = self._admit_seq
+            self._admit_seq += 1
+        self.max_concurrent = max(self.max_concurrent, self.occupied())
+
+        budget = self.serving.prefill_budget
+        chunks: List[Tuple[Slot, int, int]] = []
+        pending = sorted(
+            (s for s in self.slots if s.state == PREFILL),
+            key=lambda s: s.admit_seq,
+        )
+        for slot in pending:
+            start = slot.filled
+            while budget > 0 and start < slot.prompt_len:
+                size = _pow2_chunk(
+                    min(slot.prompt_len - start, budget),
+                    self.serving.prefill_chunk,
+                )
+                chunks.append((slot, start, size))
+                start += size
+                budget -= size
+            if budget <= 0:
+                break
+        return chunks
+
+    # -- retirement ---------------------------------------------------
+
+    def retire(self, slot: Slot) -> None:
+        """Return a slot to the FREE pool. The KV rows need no clearing:
+        the ring mask derives visibility purely from position arithmetic
+        (models/decode.py:_attn_chunk), so a fresh prefill at pos=0
+        masks every stale key the previous occupant left behind."""
+        slot.reset()

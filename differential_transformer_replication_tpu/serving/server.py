@@ -1,0 +1,323 @@
+"""Minimal serving front-ends over the continuous-batching engine.
+
+Three layers, smallest useful surface each:
+
+- :class:`EngineRunner` — a background thread that owns a
+  :class:`ServingEngine` (which is not thread-safe) and drains it:
+  concurrent callers enqueue requests through a lock, the loop moves
+  them into the engine and steps until idle, then parks on a condition
+  variable. This is the concurrency boundary — everything device-side
+  stays single-threaded.
+- :class:`ServingClient` — the programmatic client tests and the bench
+  use: blocking ``generate()`` per caller thread, n callers = n
+  concurrent streams batched by the engine. Runs fully in-process under
+  ``JAX_PLATFORMS=cpu``.
+- :func:`serve` / ``python -m ...serving.server`` — a stdlib
+  ``http.server`` JSON endpoint (no new dependencies): POST /generate
+  with ``{"prompt_ids": [...]}`` (or ``{"prompt": "text"}`` when a
+  tokenizer dir is given), GET /health for engine stats. One engine,
+  many HTTP threads, continuous batching across them.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Sequence
+
+from differential_transformer_replication_tpu.serving.engine import (
+    ServingEngine,
+)
+from differential_transformer_replication_tpu.serving.request import (
+    RequestOutput,
+    SamplingParams,
+)
+
+
+class EngineRunner:
+    """Owns the engine on a background thread; see module docstring."""
+
+    def __init__(self, engine: ServingEngine):
+        self.engine = engine
+        self._cond = threading.Condition()
+        self._incoming: deque = deque()  # (prompt, params, done Event, box)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-engine", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, prompt: Sequence[int],
+               params: Optional[SamplingParams] = None, **kw):
+        """Thread-safe enqueue; returns (event, box) — ``box[0]`` holds
+        the RequestOutput (or ``box[1]`` an exception) once set."""
+        params = params or SamplingParams(**kw)
+        done = threading.Event()
+        box: list = [None, None]
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("EngineRunner is closed")
+            self._incoming.append((list(prompt), params, done, box))
+            self._cond.notify()
+        return done, box
+
+    def generate(self, prompt: Sequence[int],
+                 params: Optional[SamplingParams] = None,
+                 timeout: Optional[float] = None, **kw) -> RequestOutput:
+        done, box = self.submit(prompt, params, **kw)
+        if not done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if box[1] is not None:
+            raise box[1]
+        return box[0]
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        self._thread.join(timeout=30)
+
+    def _loop(self) -> None:
+        waiters: dict = {}  # request_id -> (Event, box)
+        while True:
+            with self._cond:
+                while not self._incoming and not self.engine.has_work():
+                    if self._stop:
+                        return
+                    self._cond.wait()
+                incoming = list(self._incoming)
+                self._incoming.clear()
+                stopping = self._stop
+            for prompt, params, done, box in incoming:
+                try:
+                    rid = self.engine.submit(prompt, params=params)
+                    waiters[rid] = (done, box)
+                except Exception as e:  # invalid request: fail the caller
+                    box[1] = e
+                    done.set()
+            try:
+                for out in self.engine.step():
+                    done, box = waiters.pop(out.request_id)
+                    box[0] = out
+                    done.set()
+            except Exception as e:
+                # a device-side failure (OOM, runtime error) must not
+                # strand callers on a dead thread: fail every waiter and
+                # refuse further work
+                for done, box in waiters.values():
+                    box[1] = e
+                    done.set()
+                with self._cond:
+                    self._stop = True
+                    for _, _, done, box in self._incoming:
+                        box[1] = e
+                        done.set()
+                    self._incoming.clear()
+                raise
+            if stopping and not self.engine.has_work():
+                return
+
+
+class ServingClient:
+    """In-process client: one engine, blocking calls from any thread."""
+
+    def __init__(self, engine: ServingEngine):
+        self.runner = EngineRunner(engine)
+
+    def generate(self, prompt: Sequence[int],
+                 params: Optional[SamplingParams] = None,
+                 timeout: Optional[float] = None, **kw) -> RequestOutput:
+        return self.runner.generate(prompt, params, timeout=timeout, **kw)
+
+    def generate_batch(self, prompts: Sequence[Sequence[int]],
+                       params: Optional[Sequence[SamplingParams]] = None,
+                       timeout: Optional[float] = None,
+                       **kw) -> List[RequestOutput]:
+        """Submit all prompts, then wait — batched by the engine."""
+        shared = SamplingParams(**kw) if params is None else None
+        handles = [
+            self.runner.submit(p, shared if shared else params[i])
+            for i, p in enumerate(prompts)
+        ]
+        outs = []
+        for done, box in handles:
+            if not done.wait(timeout):
+                raise TimeoutError("generation timed out")
+            if box[1] is not None:
+                raise box[1]
+            outs.append(box[0])
+        return outs
+
+    @property
+    def stats(self) -> dict:
+        return dict(self.runner.engine.stats)
+
+    def close(self) -> None:
+        self.runner.close()
+
+
+def _make_handler(client: ServingClient, tokenizer=None):
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._reply(200, {"ok": True, "stats": client.stats})
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                prompt_ids = req.get("prompt_ids")
+                if prompt_ids is None and "prompt" in req:
+                    if tokenizer is None:
+                        raise ValueError(
+                            "text prompts need the server started with a "
+                            "tokenizer dir; send prompt_ids instead"
+                        )
+                    prompt_ids = tokenizer.encode(req["prompt"]).ids
+                if not prompt_ids:
+                    raise ValueError("prompt_ids (or prompt) required")
+                top_k = req.get("top_k")
+                eos = req.get("eos_token_id")
+                params = SamplingParams(
+                    max_new_tokens=int(req.get("max_new_tokens", 16)),
+                    temperature=float(req.get("temperature", 1.0)),
+                    top_k=None if top_k is None else int(top_k),
+                    seed=int(req.get("seed", 0)),
+                    eos_token_id=None if eos is None else int(eos),
+                )
+                out = client.generate(
+                    [int(t) for t in prompt_ids], params,
+                    timeout=float(req.get("timeout", 600.0)),
+                )
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e)})
+                return
+            except TimeoutError:
+                self._reply(503, {"error": "generation timed out"})
+                return
+            except RuntimeError as e:  # runner closed / engine failure
+                self._reply(500, {"error": str(e)})
+                return
+            payload = {
+                "request_id": out.request_id,
+                "prompt_ids": out.prompt,
+                "tokens": out.tokens,
+                "finish_reason": out.finish_reason,
+                "ttft_ms": round(out.ttft * 1e3, 3),
+            }
+            if tokenizer is not None:
+                payload["text"] = tokenizer.decode(out.tokens)
+            self._reply(200, payload)
+
+        def log_message(self, *a):  # quiet by default
+            pass
+
+    return Handler
+
+
+def serve(client: ServingClient, host: str = "127.0.0.1", port: int = 8000,
+          tokenizer=None) -> ThreadingHTTPServer:
+    """Build the HTTP server (not yet serving; call serve_forever())."""
+    return ThreadingHTTPServer(
+        (host, port), _make_handler(client, tokenizer)
+    )
+
+
+def main() -> None:
+    """CLI: serve a checkpoint (or a random-init demo model) over HTTP."""
+    import argparse
+
+    import jax
+
+    from differential_transformer_replication_tpu.config import (
+        ModelConfig,
+        ServingConfig,
+    )
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint", default=None,
+                   help="training checkpoint dir (meta.json + "
+                        "state.msgpack); omit for a random-init demo model")
+    p.add_argument("--tokenizer", default=None,
+                   help="tokenizer dir enabling text prompts "
+                        "(vocab.json + merges.txt)")
+    p.add_argument("--model", default="control",
+                   help="demo model family when no checkpoint is given")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--num-slots", type=int, default=8)
+    p.add_argument("--prefill-chunk", type=int, default=128)
+    p.add_argument("--prefill-budget", type=int, default=256)
+    p.add_argument("--max-seq-len", type=int, default=0)
+    args = p.parse_args()
+
+    meta = None
+    if args.checkpoint:
+        from differential_transformer_replication_tpu.train.checkpoint import (
+            load_params_for_inference,
+        )
+
+        params, model_cfg, meta = load_params_for_inference(args.checkpoint)
+    else:
+        from differential_transformer_replication_tpu.models import init_model
+
+        model_cfg = ModelConfig(
+            model=args.model, vocab_size=512, n_embd=64, n_head=2,
+            n_layer=2, block_size=128, compute_dtype="float32",
+        )
+        params = init_model(jax.random.PRNGKey(0), model_cfg)
+        print("[serve] no checkpoint given: random-init demo model")
+
+    tokenizer = None
+    if args.tokenizer:
+        from differential_transformer_replication_tpu.data.tokenizer import (
+            check_tokenizer_matches,
+            load_tokenizer,
+        )
+
+        tokenizer = load_tokenizer(args.tokenizer)
+        if meta is not None:
+            # refuse to serve text through a tokenizer that cannot belong
+            # to the checkpoint (same guard as sample.py — a clobbered
+            # shared tokenizer dir would silently emit garbage text)
+            check_tokenizer_matches(
+                tokenizer, model_cfg.vocab_size,
+                meta.get("tokenizer_fingerprint"), context=args.checkpoint,
+            )
+
+    serving = ServingConfig(
+        num_slots=args.num_slots, prefill_chunk=args.prefill_chunk,
+        prefill_budget=args.prefill_budget, max_seq_len=args.max_seq_len,
+    )
+    client = ServingClient(ServingEngine(params, model_cfg, serving))
+    httpd = serve(client, args.host, args.port, tokenizer)
+    print(
+        f"[serve] {model_cfg.model} model, {serving.num_slots} slots — "
+        f"POST http://{args.host}:{args.port}/generate"
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        client.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -147,6 +147,33 @@ def load_checkpoint(path: str, cfg: TrainConfig, target_state: dict) -> Tuple[di
     return state, meta["best_val_loss"]
 
 
+def load_params_for_inference(path: str) -> Tuple[dict, ModelConfig, dict]:
+    """Load a TRAINING checkpoint dir (meta.json + state.msgpack) for
+    inference-only use: returns (params, resolved ModelConfig, meta).
+
+    This is the meta->TrainConfig->create_train_state->load_checkpoint
+    dance every inference front-end needs (sample.py, the serving
+    server, tools/serve_bench.py) in one place; ``meta`` is the raw
+    meta.json dict so callers can check ``tokenizer_fingerprint``
+    (data/tokenizer.py:check_tokenizer_matches). For ``save_pretrained``
+    dirs use :func:`from_pretrained` instead."""
+    from differential_transformer_replication_tpu.train.step import (
+        create_train_state,
+    )
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    saved = meta["config"]
+    cfg = TrainConfig(
+        model=ModelConfig(**saved["model"]),
+        vocab_size=saved["vocab_size"],
+        control_head_multiplier=saved["control_head_multiplier"],
+    )
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    state, _ = load_checkpoint(path, cfg, state)
+    return state["params"], cfg.resolved_model(), meta
+
+
 def save_pretrained(path: str, params: dict, model_cfg: ModelConfig) -> None:
     """Self-describing model checkpoint (Ndiff_transformer.py:251-265),
     for any of the three families."""

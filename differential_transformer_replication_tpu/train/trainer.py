@@ -218,9 +218,25 @@ def train(cfg: TrainConfig) -> dict:
         meta_path = _os.path.join(cfg.resume_from, "meta.json")
         if _os.path.exists(meta_path):
             with open(meta_path) as f:
-                recorded = _json.load(f).get("tokenizer_fingerprint")
+                meta = _json.load(f)
+            # compare against the CHECKPOINT's recorded vocab size, not
+            # cfg.vocab_size — the latter was just overwritten from this
+            # very tokenizer (cfg.replace above), which made the size leg
+            # vacuous: a wrong-size tokenizer then only failed later on
+            # an unhelpful flax shape mismatch (ADVICE r5 finding 1)
+            saved_cfg = meta.get("config", {})
+            # the TOP-LEVEL vocab_size is the one save_checkpoint records
+            # from the live run (trainer resolves the tokenizer's vocab
+            # into it; the nested model.vocab_size keeps its un-resolved
+            # construction-time default)
+            recorded_vocab = (
+                saved_cfg.get("vocab_size")
+                or (saved_cfg.get("model") or {}).get("vocab_size")
+                or cfg.vocab_size  # very old meta: degrade to vacuous
+            )
             check_tokenizer_matches(
-                tokenizer, cfg.vocab_size, recorded, context=cfg.resume_from
+                tokenizer, recorded_vocab,
+                meta.get("tokenizer_fingerprint"), context=cfg.resume_from,
             )
 
     logger = MetricLogger(cfg)

@@ -1,0 +1,58 @@
+"""JAX version-compatibility aliases.
+
+The repo targets current JAX but must also run on the older runtimes
+some environments pin (e.g. 0.4.x, where ``shard_map`` still lives in
+``jax.experimental`` with the ``check_rep``/``auto`` kwarg spellings,
+and Pallas-TPU compiler params are named ``TPUCompilerParams``). Import
+the symbols from here instead of version-probing at every call site.
+Call sites use the CURRENT spellings (``check_vma=``, ``axis_names=``);
+the wrapper translates for old runtimes.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+try:  # jax >= 0.4.35: top-level export
+    _raw_shard_map = jax.shard_map
+except AttributeError:  # older: experimental namespace
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+if "check_vma" in inspect.signature(_raw_shard_map).parameters:
+    shard_map = _raw_shard_map
+else:
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+                  axis_names=None, **kw):
+        """Old-API adapter: ``check_vma`` was ``check_rep``; manual
+        ``axis_names`` were spelled as their complement ``auto``."""
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        if axis_names is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _raw_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+
+    def axis_size(name):
+        """Size of a named mesh axis inside shard_map. ``psum`` of the
+        Python constant 1 is evaluated eagerly to a concrete int, so
+        this is usable in host control flow exactly like the real
+        ``jax.lax.axis_size``."""
+        return jax.lax.psum(1, name)
+
+
+# Renamed TPUCompilerParams -> CompilerParams when pallas TPU stabilized.
+CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
+__all__ = ["shard_map", "CompilerParams"]

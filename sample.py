@@ -15,7 +15,6 @@ jitted loop.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 
 import jax
@@ -39,10 +38,6 @@ def main() -> None:
                    help="keep only the k highest logits (reference: off)")
     args = p.parse_args()
 
-    from differential_transformer_replication_tpu.config import (
-        ModelConfig,
-        TrainConfig,
-    )
     from differential_transformer_replication_tpu.data.tokenizer import (
         load_tokenizer,
     )
@@ -52,29 +47,15 @@ def main() -> None:
     )
     from differential_transformer_replication_tpu.train.checkpoint import (
         from_pretrained,
-        load_checkpoint,
-    )
-    from differential_transformer_replication_tpu.train.step import (
-        create_train_state,
+        load_params_for_inference,
     )
 
     fp = None  # save_pretrained dirs carry no meta.json / fingerprint
     if os.path.exists(os.path.join(args.checkpoint, "params.msgpack")):
         params, model_cfg = from_pretrained(args.checkpoint)
     else:
-        with open(os.path.join(args.checkpoint, "meta.json")) as f:
-            meta = json.load(f)
+        params, model_cfg, meta = load_params_for_inference(args.checkpoint)
         fp = meta.get("tokenizer_fingerprint")
-        saved = meta["config"]
-        model_cfg = ModelConfig(**saved["model"])
-        cfg = TrainConfig(
-            model=model_cfg,
-            vocab_size=saved["vocab_size"],
-            control_head_multiplier=saved["control_head_multiplier"],
-        )
-        state = create_train_state(jax.random.PRNGKey(0), cfg)
-        state, _ = load_checkpoint(args.checkpoint, cfg, state)
-        params, model_cfg = state["params"], cfg.resolved_model()
 
     from differential_transformer_replication_tpu.data.tokenizer import (
         check_tokenizer_matches,

@@ -74,7 +74,9 @@ _SLOW_PATTERNS = (
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
+        # explicit @pytest.mark.slow decorators (e.g. the multi-second
+        # serving tests, test_serving.py) count like pattern membership
         if any(pat in item.nodeid for pat in _SLOW_PATTERNS):
             item.add_marker(pytest.mark.slow)
-        else:
+        elif item.get_closest_marker("slow") is None:
             item.add_marker(pytest.mark.quick)

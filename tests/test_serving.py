@@ -1,0 +1,388 @@
+"""Continuous-batching serving engine (serving/).
+
+The load-bearing contract: batched continuous-batching output is
+BIT-IDENTICAL to sequential ``generate_cached`` greedy decoding for all
+three families on mixed-length prompt sets — the engine is a scheduler
+over the same math, never a different model. Plus: slot reuse after
+retirement, per-request seed determinism (independent of batch
+composition), EOS retirement, scheduler budget/pool invariants, and
+jit-stability (no recompilation as requests come and go).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.config import (
+    ModelConfig,
+    ServingConfig,
+)
+from differential_transformer_replication_tpu.models import (
+    generate_cached,
+    init_model,
+)
+from differential_transformer_replication_tpu.serving import (
+    SamplingParams,
+    Scheduler,
+    ServingClient,
+    ServingEngine,
+    serve,
+)
+from differential_transformer_replication_tpu.serving.scheduler import (
+    FREE,
+    PREFILL,
+)
+
+
+def _cfg(kind, vocab=61):
+    return ModelConfig(
+        model=kind, vocab_size=vocab, n_embd=32, n_head=2, n_layer=2,
+        block_size=32, dropout=0.0, n_terms=3, compute_dtype="float32",
+    )
+
+
+@lru_cache(maxsize=None)
+def _setup(kind, vocab=61):
+    cfg = _cfg(kind, vocab)
+    return cfg, init_model(jax.random.PRNGKey(0), cfg)
+
+
+def _prompts(lens, vocab, seed=1):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=L).tolist() for L in lens]
+
+
+def _ref_greedy(params, cfg, prompt, n):
+    out = generate_cached(
+        params, jnp.asarray(prompt, jnp.int32)[None], cfg, n,
+        jax.random.PRNGKey(0), temperature=0.0,
+    )
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# one family stays in the quick tier as the representative parity pin;
+# the other two ride the full tier (conftest honors explicit slow marks)
+@pytest.mark.parametrize("kind", [
+    "control",
+    pytest.param("diff", marks=pytest.mark.slow),
+    pytest.param("ndiff", marks=pytest.mark.slow),
+])
+def test_batched_greedy_bit_identical_to_generate_cached(kind):
+    """Acceptance pin: mixed-length prompts through a 2-slot pool (so
+    requests queue and slots are reused) produce exactly the tokens
+    sequential per-request generate_cached produces."""
+    cfg, params = _setup(kind)
+    prompts = _prompts([3, 9, 14, 6, 11], cfg.vocab_size)
+    eng = ServingEngine(
+        params, cfg,
+        ServingConfig(num_slots=2, prefill_chunk=4, prefill_budget=6),
+    )
+    outs = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+    for p, o in zip(prompts, outs):
+        assert o.tokens == _ref_greedy(params, cfg, p, 8)
+        assert o.prompt == p  # all in-window: no crop
+        assert o.finish_reason == "length"
+    # slot reuse + pool invariant: 5 requests through 2 slots
+    assert eng.stats["completed"] == 5
+    assert eng.scheduler.max_concurrent <= 2
+    assert all(s.state == FREE for s in eng.scheduler.slots)
+
+
+@pytest.mark.slow
+def test_long_prompt_crop_and_rolling_decode_parity():
+    """RoPE families crop prompts > block_size to the last block_size ids
+    (the reference's own semantics, control.py:165) and roll the ring
+    cache past block_size during decode — both bit-matching
+    generate_cached."""
+    cfg, params = _setup("control")
+    eng = ServingEngine(
+        params, cfg,
+        ServingConfig(
+            num_slots=3, prefill_chunk=8, prefill_budget=16, max_seq_len=64,
+        ),
+    )
+    long_p, short_p = _prompts([40, 5], cfg.vocab_size, seed=2)
+    outs = eng.generate([long_p, short_p], max_new_tokens=10, temperature=0.0)
+    assert outs[0].tokens == _ref_greedy(params, cfg, long_p, 10)
+    assert outs[0].prompt == long_p[-cfg.block_size:]  # cropped echo
+    assert outs[1].tokens == _ref_greedy(params, cfg, short_p, 10)
+
+
+@pytest.mark.slow
+def test_per_request_seed_determinism_across_batch_compositions():
+    """Sampled output is a function of (params, prompt, sampling params)
+    only — the key chain fold_in(PRNGKey(seed), t) must not see slot
+    assignment, pool size, or admission order."""
+    cfg, params = _setup("control")
+    reqs = list(zip(_prompts([4, 9, 6], cfg.vocab_size, seed=3), [7, 7, 99]))
+
+    def run(num_slots, order):
+        eng = ServingEngine(
+            params, cfg,
+            ServingConfig(num_slots=num_slots, prefill_chunk=4,
+                          prefill_budget=4),
+        )
+        ids = {}
+        for i in order:
+            p, seed = reqs[i]
+            ids[eng.submit(p, temperature=1.0, top_k=5, seed=seed,
+                           max_new_tokens=6)] = i
+        return {ids[o.request_id]: o.tokens for o in eng.run()}
+
+    a = run(1, [0, 1, 2])
+    b = run(3, [2, 0, 1])
+    assert a == b
+    assert all(len(t) == 6 for t in a.values())
+    # and every draw is a valid token id
+    assert all(0 <= tok < cfg.vocab_size for t in a.values() for tok in t)
+
+
+@pytest.mark.slow
+def test_sampled_chain_matches_sample_token_reference():
+    """The engine's batched sampler must be bit-identical, token for
+    token, to the single-request sample_token contract with the same
+    fold_in key chain (models/generate.py)."""
+    from differential_transformer_replication_tpu.models.decode import (
+        forward_chunk,
+        init_cache,
+    )
+    from differential_transformer_replication_tpu.models.generate import (
+        sample_token,
+    )
+
+    cfg, params = _setup("control")
+    prompt = _prompts([5], cfg.vocab_size, seed=4)[0]
+    eng = ServingEngine(params, cfg, ServingConfig(num_slots=2))
+    out = eng.generate(
+        [prompt], temperature=1.0, top_k=5, seed=11, max_new_tokens=6
+    )[0]
+
+    base = jax.random.PRNGKey(11)
+    cache = init_cache(cfg, 1)
+    logits, cache = forward_chunk(
+        params, jnp.asarray(prompt, jnp.int32)[None], 0, cache, cfg,
+        rope_len=cfg.block_size,
+    )
+    toks = []
+    for t in range(6):
+        key = jax.random.fold_in(base, t)
+        tok = int(sample_token(
+            key, logits[:, -1].astype(jnp.float32), 1.0, 5
+        )[0])
+        toks.append(tok)
+        if t < 5:
+            logits, cache = forward_chunk(
+                params, jnp.asarray([[tok]], jnp.int32), len(prompt) + t,
+                cache, cfg, rope_len=cfg.block_size,
+            )
+    assert out.tokens == toks
+
+
+def test_eos_retires_slot_early_without_stalling_batch():
+    cfg, params = _setup("control")
+    prompts = _prompts([5, 8], cfg.vocab_size, seed=5)
+    eng = ServingEngine(params, cfg, ServingConfig(num_slots=2))
+    ref = eng.generate(prompts, max_new_tokens=6, temperature=0.0)
+    first_tok = ref[0].tokens[0]
+
+    eng2 = ServingEngine(params, cfg, ServingConfig(num_slots=2))
+    a = eng2.submit(prompts[0], max_new_tokens=6, temperature=0.0,
+                    eos_token_id=first_tok)
+    b = eng2.submit(prompts[1], max_new_tokens=6, temperature=0.0)
+    outs = {o.request_id: o for o in eng2.run()}
+    assert outs[a].tokens == [first_tok]
+    assert outs[a].finish_reason == "eos"
+    # the other sequence is unaffected by the early retirement
+    assert outs[b].tokens == ref[1].tokens
+    assert outs[b].finish_reason == "length"
+
+
+def test_submit_validation():
+    cfg, params = _setup("diff")
+    eng = ServingEngine(params, cfg, ServingConfig(num_slots=1))
+    with pytest.raises(ValueError):  # diff cannot roll past block_size
+        eng.submit(list(range(30)), max_new_tokens=10)
+    with pytest.raises(ValueError):
+        eng.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        eng.submit([1, 2], max_new_tokens=0)
+
+    ccfg, cparams = _setup("control")
+    ceng = ServingEngine(cparams, ccfg, ServingConfig(num_slots=1))
+    with pytest.raises(ValueError):  # past the engine's RoPE table
+        ceng.submit(list(range(30)), max_new_tokens=10)
+
+
+def test_decode_stays_jit_stable_as_requests_come_and_go():
+    """Acceptance pin: a first wave compiles everything (decode step,
+    prefill ladder, samplers); a second wave with different lengths,
+    seeds, sampling params and admission patterns must not add a single
+    cache entry, and the decode step must have compiled exactly once."""
+    cfg, params = _setup("control", vocab=53)  # fresh compile-cache key
+    serving = ServingConfig(num_slots=3, prefill_chunk=8, prefill_budget=8)
+    eng = ServingEngine(params, cfg, serving)
+    eng.generate(
+        _prompts([1, 3, 9, 14], cfg.vocab_size, seed=6),
+        max_new_tokens=4, temperature=0.0,
+    )
+    baseline = eng.compile_stats()
+    assert baseline["decode"] == 1
+    # ladder {8,4,2,1} -> at most 4 prefill shapes; first-token + pool
+    # samplers -> at most 2
+    assert baseline["prefill"] <= 4
+    assert baseline["sample"] <= 2
+
+    eng2 = ServingEngine(params, cfg, serving)  # same config: shared jits
+    outs = eng2.generate(
+        _prompts([2, 13, 7, 14, 5, 10, 1], cfg.vocab_size, seed=7),
+        max_new_tokens=6, temperature=0.8, top_k=3, seed=42,
+    )
+    assert len(outs) == 7
+    assert eng2.compile_stats() == baseline  # zero new compiles
+
+
+class TestScheduler:
+    """Host-side scheduling policy in isolation (no device work)."""
+
+    def _sched(self, **kw):
+        return Scheduler(ServingConfig(**kw))
+
+    def _submit(self, sched, lens):
+        from differential_transformer_replication_tpu.serving.request import (
+            Request,
+        )
+
+        for i, L in enumerate(lens):
+            sched.submit(
+                Request.make(i, [1] * L), np.ones(L, np.int32), 0.0
+            )
+
+    def test_admission_is_fcfs_and_bounded_by_pool(self):
+        s = self._sched(num_slots=2, prefill_chunk=8, prefill_budget=64)
+        self._submit(s, [4, 4, 4])
+        s.plan()
+        assert s.occupied() == 2  # third request waits
+        assert [sl.request.request_id
+                for sl in s.slots if sl.state != FREE] == [0, 1]
+        assert s.max_concurrent == 2
+
+    def test_prefill_budget_caps_tokens_per_iteration(self):
+        s = self._sched(num_slots=2, prefill_chunk=8, prefill_budget=8)
+        self._submit(s, [16, 16])
+        chunks = s.plan()
+        assert sum(c[2] for c in chunks) <= 8
+        assert all(c[0].index == chunks[0][0].index for c in chunks)  # FCFS
+        for slot, start, size in chunks:
+            slot.filled = start + size
+        chunks = s.plan()  # budget renews each iteration
+        assert sum(c[2] for c in chunks) <= 8
+
+    def test_chunks_come_from_power_of_two_ladder(self):
+        s = self._sched(num_slots=1, prefill_chunk=8, prefill_budget=64)
+        self._submit(s, [13])
+        sizes = [c[2] for c in s.plan()]
+        assert sizes == [8, 4, 1]
+        assert all(sz & (sz - 1) == 0 for sz in sizes)
+
+    def test_retire_frees_slot_for_next_request(self):
+        s = self._sched(num_slots=1, prefill_chunk=8, prefill_budget=8)
+        self._submit(s, [4, 4])
+        s.plan()
+        slot = s.slots[0]
+        assert slot.state == PREFILL and slot.request.request_id == 0
+        s.retire(slot)
+        s.plan()
+        assert slot.request.request_id == 1
+        assert s.max_concurrent == 1
+
+
+@pytest.mark.slow
+def test_serving_client_and_http_server():
+    """The concurrency boundary: many caller threads, one engine thread;
+    and the stdlib HTTP endpoint end-to-end on an ephemeral port."""
+    cfg, params = _setup("control")
+    prompts = _prompts([5, 9, 3, 12], cfg.vocab_size, seed=8)
+    refs = [_ref_greedy(params, cfg, p, 6) for p in prompts]
+
+    client = ServingClient(ServingEngine(
+        params, cfg, ServingConfig(num_slots=2, prefill_chunk=4,
+                                   prefill_budget=8),
+    ))
+    try:
+        # concurrent programmatic callers
+        outs = client.generate_batch(
+            prompts, max_new_tokens=6, temperature=0.0, timeout=120
+        )
+        assert [o.tokens for o in outs] == refs
+
+        httpd = serve(client, port=0)  # ephemeral port
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({
+                    "prompt_ids": prompts[0], "max_new_tokens": 6,
+                    "temperature": 0.0,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                body = json.load(r)
+            assert body["tokens"] == refs[0]
+            assert body["finish_reason"] == "length"
+            assert body["ttft_ms"] >= 0
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=30
+            ) as r:
+                health = json.load(r)
+            assert health["ok"] and health["stats"]["completed"] >= 5
+
+            # invalid request -> 400, server stays up
+            bad = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate", data=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=30)
+            assert ei.value.code == 400
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+    finally:
+        client.close()
+
+
+def test_serve_bench_smoke():
+    """Acceptance pin: the --smoke bench completes with rc=0 under
+    JAX_PLATFORMS=cpu and reports req/s, output tok/s and TTFT/ITL
+    percentiles as a single JSON line."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # no need for the 8-device mesh here
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "serve_bench.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "serving_output_tokens_per_sec"
+    assert line["value"] > 0
+    assert line["requests_per_sec"] > 0
+    assert line["n_requests"] == 8
+    for section in ("ttft_ms", "itl_ms"):
+        assert line[section]["p50"] is not None
+        assert line[section]["p95"] >= line[section]["p50"]

@@ -43,28 +43,12 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 
 def _load_model(ckpt: str):
-    import jax
-
-    from differential_transformer_replication_tpu.config import (
-        ModelConfig,
-        TrainConfig,
-    )
     from differential_transformer_replication_tpu.train.checkpoint import (
-        load_checkpoint,
-    )
-    from differential_transformer_replication_tpu.train.step import (
-        create_train_state,
+        load_params_for_inference,
     )
 
-    with open(os.path.join(ckpt, "meta.json")) as f:
-        meta = json.load(f)
-    cd = dict(meta["config"])
-    model_cfg = ModelConfig(**cd.pop("model"))
-    cd.pop("mesh", None)
-    cfg = TrainConfig(model=model_cfg, **cd)
-    state = create_train_state(jax.random.PRNGKey(0), cfg)
-    state, _ = load_checkpoint(ckpt, cfg, state)
-    return state["params"], cfg.resolved_model(), meta.get("tokenizer_fingerprint")
+    params, model_cfg, meta = load_params_for_inference(ckpt)
+    return params, model_cfg, meta.get("tokenizer_fingerprint")
 
 
 def _attention_rows(params, cfg, idx):

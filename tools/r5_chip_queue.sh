@@ -9,12 +9,39 @@ while kill -0 "$CONTROL_PID" 2>/dev/null; do sleep 20; done
 echo "[queue] control trainer exited at $(date)"
 
 # 1. Attention probe on the control 40k checkpoints (3 seeds), matching
-#    the diff probes already recorded.
+#    the diff probes already recorded. The probe must use the PER-RUN
+#    IMMUTABLE tokenizer copy (tokenizer/cache-<key>/), not the shared
+#    mutable `tokenizer` dir a concurrent run can clobber (ADVICE r5
+#    finding 2) — resolve it by matching the checkpoint's recorded
+#    content fingerprint against the cache entries; fall back to the
+#    shared dir (the fingerprint guard still aborts loudly on mismatch).
+TOK_DIR=$(python - results/recipe40k_control/best.ckpt tokenizer <<'EOF'
+import glob, json, sys
+from differential_transformer_replication_tpu.data.tokenizer import (
+    load_tokenizer, tokenizer_fingerprint,
+)
+ckpt, tokdir = sys.argv[1], sys.argv[2]
+try:
+    want = json.load(open(f"{ckpt}/meta.json")).get("tokenizer_fingerprint")
+except Exception:  # missing OR corrupt meta: degrade to the shared dir
+    want = None
+for d in sorted(glob.glob(f"{tokdir}/cache-*")):
+    try:
+        if want and tokenizer_fingerprint(load_tokenizer(d)) == want:
+            print(d)
+            break
+    except Exception:
+        pass
+else:
+    print(tokdir)
+EOF
+)
+echo "[queue] probe tokenizer: $TOK_DIR"
 for s in 0 1 2; do
   python tools/attn_probe.py \
     --checkpoint results/recipe40k_control/best.ckpt \
     --checkpoint results/recipe40k_control/last.ckpt \
-    --tokenizer tokenizer --corpus /tmp/imgcorpus4/image_corpus.txt \
+    --tokenizer "$TOK_DIR" --corpus /tmp/imgcorpus4/image_corpus.txt \
     --trials 8 --seed $s --out results/attn_probe_control40k_s$s.json \
     || echo "[queue] control probe seed $s FAILED"
 done

@@ -1,0 +1,219 @@
+"""Serving throughput/latency bench: closed-loop load against the
+continuous-batching engine (differential_transformer_replication_tpu/
+serving/).
+
+``--clients`` worker threads each run a closed loop — submit one
+request, wait for completion, submit the next — through the in-process
+``ServingClient``, so concurrency equals the client count and the
+engine's iteration-level scheduler batches across them. Prompt lengths
+are drawn uniformly from [--min-prompt, --max-prompt] with a fixed seed,
+so runs are comparable.
+
+Prints ONE JSON line (like bench.py) with requests/sec, output
+tokens/sec, and p50/p95 time-to-first-token + inter-token latency, e.g.::
+
+    {"metric": "serving_output_tokens_per_sec", "value": ..., ...}
+
+``--smoke`` shrinks everything (tiny random-init model, few requests)
+so the whole run completes in seconds under ``JAX_PLATFORMS=cpu`` —
+exercised by tests/test_serving.py as the quick-tier smoke.
+
+By default the model is RANDOM-INIT at the requested shape (throughput
+does not depend on trained weights); pass --checkpoint to serve real
+weights instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _percentiles(xs, ps=(50, 95)):
+    if not xs:
+        return {f"p{p}": None for p in ps}
+    return {f"p{p}": round(float(np.percentile(xs, p)), 3) for p in ps}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny model + few requests; seconds on CPU")
+    p.add_argument("--checkpoint", default=None,
+                   help="serve a trained checkpoint instead of random init")
+    p.add_argument("--model", default="diff",
+                   choices=("control", "diff", "ndiff"))
+    p.add_argument("--n-layer", type=int, default=8)
+    p.add_argument("--n-embd", type=int, default=768)
+    p.add_argument("--n-head", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=512)
+    p.add_argument("--vocab-size", type=int, default=12000)
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--clients", type=int, default=16,
+                   help="closed-loop concurrency")
+    p.add_argument("--num-slots", type=int, default=8)
+    p.add_argument("--prefill-chunk", type=int, default=128)
+    p.add_argument("--prefill-budget", type=int, default=256)
+    p.add_argument("--min-prompt", type=int, default=16)
+    p.add_argument("--max-prompt", type=int, default=128)
+    p.add_argument("--new-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None,
+                   help="also append the JSON line to this file")
+    args = p.parse_args()
+
+    if args.smoke:
+        args.model = "control"
+        args.n_layer, args.n_embd, args.n_head = 2, 32, 2
+        args.block_size, args.vocab_size = 32, 97
+        args.requests, args.clients, args.num_slots = 8, 4, 4
+        args.prefill_chunk, args.prefill_budget = 8, 16
+        args.min_prompt, args.max_prompt, args.new_tokens = 3, 12, 8
+
+    import jax
+
+    from differential_transformer_replication_tpu.config import (
+        ModelConfig,
+        ServingConfig,
+    )
+    from differential_transformer_replication_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+    )
+
+    if args.checkpoint:
+        from differential_transformer_replication_tpu.train.checkpoint import (
+            load_params_for_inference,
+        )
+
+        params, model_cfg, _ = load_params_for_inference(args.checkpoint)
+    else:
+        from differential_transformer_replication_tpu.models import (
+            init_model,
+        )
+
+        model_cfg = ModelConfig(
+            model=args.model, vocab_size=args.vocab_size,
+            n_embd=args.n_embd, n_head=args.n_head, n_layer=args.n_layer,
+            block_size=args.block_size, dropout=0.0,
+            compute_dtype="float32" if args.smoke else "bfloat16",
+        )
+        params = init_model(jax.random.PRNGKey(args.seed), model_cfg)
+
+    serving = ServingConfig(
+        num_slots=args.num_slots, prefill_chunk=args.prefill_chunk,
+        prefill_budget=args.prefill_budget,
+        # let RoPE families roll past block_size so a full-window prompt
+        # plus new_tokens always fits (the diff family ignores this and
+        # stays hard-capped at block_size)
+        max_seq_len=model_cfg.block_size + args.new_tokens,
+    )
+    engine = ServingEngine(params, model_cfg, serving)
+    client = ServingClient(engine)
+
+    rng = np.random.default_rng(args.seed)
+    max_prompt = min(
+        args.max_prompt, model_cfg.block_size - args.new_tokens
+        if model_cfg.model == "diff" else model_cfg.block_size
+    )
+    min_prompt = min(args.min_prompt, max_prompt)
+    prompts = [
+        rng.integers(
+            0, model_cfg.vocab_size,
+            size=int(rng.integers(min_prompt, max_prompt + 1)),
+        ).tolist()
+        for _ in range(args.requests)
+    ]
+
+    # warmup: compile outside the timed window. Every prefill chunk any
+    # request can use is a power of two <= min(prefill_chunk, max_prompt),
+    # so one warm request PER ladder size (each a single-chunk prefill)
+    # plus the shared decode step and samplers covers every shape — no
+    # first-compile lands in a measured TTFT/ITL.
+    ladder, size = [], 1
+    while size <= min(serving.prefill_chunk, max_prompt):
+        ladder.append(size)
+        size *= 2
+    client.generate_batch(
+        [prompts[0][:1] * n for n in ladder], max_new_tokens=2,
+        temperature=args.temperature, seed=0, timeout=600,
+    )
+
+    outputs = []
+    lock = threading.Lock()
+    next_idx = [0]
+
+    def worker():
+        while True:
+            with lock:
+                i = next_idx[0]
+                if i >= len(prompts):
+                    return
+                next_idx[0] += 1
+            out = client.generate(
+                prompts[i], max_new_tokens=args.new_tokens,
+                temperature=args.temperature, seed=args.seed + i,
+                timeout=600,
+            )
+            with lock:
+                outputs.append(out)
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=worker) for _ in range(args.clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    client.close()
+
+    out_tokens = sum(len(o.tokens) for o in outputs)
+    ttfts_ms = [o.ttft * 1e3 for o in outputs]
+    itls_ms = [itl * 1e3 for o in outputs for itl in o.itls]
+    line = {
+        "metric": "serving_output_tokens_per_sec",
+        "value": round(out_tokens / wall, 1),
+        "unit": "tokens/sec",
+        "requests_per_sec": round(len(outputs) / wall, 3),
+        "ttft_ms": _percentiles(ttfts_ms),
+        "itl_ms": _percentiles(itls_ms),
+        "n_requests": len(outputs),
+        "output_tokens": out_tokens,
+        "wall_s": round(wall, 3),
+        "model": model_cfg.model,
+        "num_slots": serving.num_slots,
+        "clients": args.clients,
+        "prefill_chunk": serving.prefill_chunk,
+        "prefill_budget": serving.prefill_budget,
+        "new_tokens": args.new_tokens,
+        "prompt_len_range": [min_prompt, max_prompt],
+        "smoke": bool(args.smoke),
+    }
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    print(
+        f"[serve_bench] {model_cfg.model} slots={serving.num_slots} "
+        f"clients={args.clients} reqs={len(outputs)} wall={wall:.2f}s "
+        f"out_tok/s={out_tokens / wall:.1f} "
+        f"engine_stats={engine.stats} compiles={engine.compile_stats()}",
+        file=sys.stderr,
+    )
+    assert len(outputs) == args.requests, "some requests did not complete"
+
+
+if __name__ == "__main__":
+    main()
