@@ -144,10 +144,20 @@ class ServingConfig:
     # overrides. None = length-only termination (the reference has no
     # EOS concept in generation, control.py:163-171).
     eos_token_id: Optional[int] = None
+    # Admission bound: submissions past this many WAITING requests (not
+    # yet holding a slot) are rejected immediately with QueueFullError
+    # (HTTP 503 from /generate) instead of growing the wait queue — and
+    # the caller's latency — without limit. 0 = unbounded (the
+    # pre-bound behavior).
+    max_queue_len: int = 0
 
     def __post_init__(self):
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
+        if self.max_queue_len < 0:
+            raise ValueError(
+                f"max_queue_len must be >= 0, got {self.max_queue_len}"
+            )
         if self.prefill_chunk < 1 or (
             self.prefill_chunk & (self.prefill_chunk - 1)
         ):
@@ -278,6 +288,44 @@ class TrainConfig:
     # device->host transfer is slow (measured 5-7 MB/s on this image's
     # tunneled chip: a recipe-scale state write costs ~3 min).
     checkpoint_min_interval_s: float = 0.0
+
+    # Fault tolerance (train/anomaly.py; no reference analog). The
+    # anomaly guard computes a per-step ``bad`` flag (non-finite
+    # loss/grad-norm, or grad-norm above spike_factor x a running EMA of
+    # good-step norms) INSIDE the jitted step and skips the optimizer
+    # update under lax.cond — zero recompiles, zero extra collectives.
+    # The trainer keeps a periodic on-device good-state snapshot, rolls
+    # back to it after rollback_after consecutive bad steps, and aborts
+    # with TrainingDivergedError after max_rollbacks rollbacks (the
+    # finite-check rescue save then refuses to overwrite the good
+    # checkpoint). Unsupported (auto-disabled) on the pipeline path.
+    anomaly_guard: bool = True
+    # spike when grad_norm > spike_factor * EMA(good grad norms); the
+    # non-finite check is always on regardless
+    anomaly_spike_factor: float = 4.0
+    anomaly_ema_beta: float = 0.99
+    # good steps before spike detection arms (the EMA must see real
+    # norms first; early training legitimately swings)
+    anomaly_warmup_steps: int = 50
+    # consecutive bad steps before the trainer rolls back to the
+    # snapshot (skipping already protected the state; a persistent
+    # streak means the state itself is suspect)
+    anomaly_rollback_after: int = 20
+    # rollbacks before the run aborts cleanly
+    anomaly_max_rollbacks: int = 3
+    # iterations between good-state snapshots (one extra train state in
+    # HBM — same footprint note as checkpoint_min_interval_s)
+    anomaly_snapshot_interval: int = 200
+    # iterations between host polls of the guard's bad_streak scalar.
+    # Each poll blocks on the step's result, costing the async-dispatch
+    # overlap for that iteration (~launch latency); 1 = react
+    # immediately, the default amortizes it to noise. Skipping itself
+    # happens every step on-device regardless of this cadence.
+    anomaly_check_interval: int = 10
+
+    # Fault injection spec (utils/faults.py), merged with the DTX_FAULTS
+    # env var. Testing/chaos only; None = inert.
+    faults: Optional[str] = None
 
     def resolved_last_checkpoint_path(self) -> Optional[str]:
         if self.last_checkpoint_path != "auto":

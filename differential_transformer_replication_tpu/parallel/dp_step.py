@@ -16,7 +16,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from differential_transformer_replication_tpu.config import TrainConfig
 from differential_transformer_replication_tpu.parallel.sharding import (
@@ -27,6 +27,7 @@ from differential_transformer_replication_tpu.train.step import (
     create_train_state,
     make_step_fn,
 )
+from differential_transformer_replication_tpu.utils import faults
 
 
 def make_sharded_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict):
@@ -39,10 +40,16 @@ def make_sharded_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict):
     # sees a bare pallas_call.
     st_sh = state_sharding(state_template, mesh)
     b_sh = batch_sharding(mesh)
+    batch_shardings = {"x": b_sh, "y": b_sh}
+    if faults.nan_armed():
+        # fault-injection poison scales ride replicated next to the batch
+        # (chaos tests only; absent in production, so the jit signature —
+        # and the compiled program — is unchanged when disarmed)
+        batch_shardings["poison"] = NamedSharding(mesh, P())
 
     jitted = jax.jit(
         make_step_fn(cfg, mesh=mesh),
-        in_shardings=(st_sh, {"x": b_sh, "y": b_sh}, None),
+        in_shardings=(st_sh, batch_shardings, None),
         out_shardings=(st_sh, None),
         donate_argnums=(0,),
     )

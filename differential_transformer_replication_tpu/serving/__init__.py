@@ -9,6 +9,7 @@ from differential_transformer_replication_tpu.serving.request import (
     SamplingParams,
 )
 from differential_transformer_replication_tpu.serving.scheduler import (
+    QueueFullError,
     Scheduler,
 )
 from differential_transformer_replication_tpu.serving.server import (
@@ -23,6 +24,7 @@ __all__ = [
     "RequestOutput",
     "SamplingParams",
     "Scheduler",
+    "QueueFullError",
     "EngineRunner",
     "ServingClient",
     "serve",

@@ -208,6 +208,8 @@ class ServingEngine:
             "prefill_tokens": 0,
             "decode_tokens": 0,
             "completed": 0,
+            "cancelled": 0,
+            "rejected": 0,
         }
 
     # -- submission ---------------------------------------------------
@@ -242,16 +244,41 @@ class ServingEngine:
                     f"max_seq_len ({self.max_total}); build the engine with "
                     "a larger ServingConfig.max_seq_len"
                 )
+        # admission bound first (scheduler.submit raises QueueFullError
+        # when the wait queue is at ServingConfig.max_queue_len) — a
+        # rejected request must leave no key-chain entry behind
+        try:
+            self.scheduler.submit(req, p, time.perf_counter())
+        except Exception:
+            self.stats["rejected"] += 1
+            raise
         self._base_keys[rid] = np.asarray(
             jax.random.PRNGKey(req.params.seed), np.uint32
         )
-        self.scheduler.submit(req, p, time.perf_counter())
         return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Abandon an in-flight request: dropped from the wait queue, or
+        its slot retired so the KV rows return to the pool. Without this
+        a caller that times out leaves the engine decoding to completion
+        for nobody — the slot leak serving/server.py's timeout path used
+        to have. Returns False when the request is unknown or already
+        finished (its output was, or is about to be, delivered)."""
+        if request_id not in self._base_keys:
+            return False
+        self.scheduler.cancel(request_id)
+        del self._base_keys[request_id]
+        self.stats["cancelled"] += 1
+        return True
 
     # -- one engine iteration -----------------------------------------
 
     def has_work(self) -> bool:
         return self.scheduler.has_work()
+
+    def queue_len(self) -> int:
+        """Requests waiting for a slot (admission-queue depth)."""
+        return self.scheduler.queue_len()
 
     def step(self) -> List[RequestOutput]:
         """Admit -> prefill (budgeted) -> batched decode. Returns the
@@ -311,10 +338,17 @@ class ServingEngine:
         ``params`` gives per-request SamplingParams; otherwise ``kw``
         build one shared SamplingParams."""
         shared = SamplingParams(**kw) if params is None else None
-        ids = [
-            self.submit(p, params=shared if shared else params[i])
-            for i, p in enumerate(prompts)
-        ]
+        ids = []
+        try:
+            for i, p in enumerate(prompts):
+                ids.append(self.submit(p, params=shared if shared else params[i]))
+        except Exception:
+            # mid-batch rejection (max_queue_len): the prompts already
+            # queued would otherwise sit in the scheduler and burn a
+            # later run()'s decode iterations for nobody
+            for rid in ids:
+                self.cancel(rid)
+            raise
         by_id = {o.request_id: o for o in self.run()}
         return [by_id[i] for i in ids]
 

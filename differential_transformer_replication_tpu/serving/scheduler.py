@@ -37,6 +37,12 @@ PREFILL = "prefill"
 ACTIVE = "active"
 
 
+class QueueFullError(RuntimeError):
+    """Admission rejected: the wait queue is at ``max_queue_len``. The
+    graceful-overload contract — callers get an immediate, retryable
+    error (HTTP 503 from the server) instead of an unbounded wait."""
+
+
 @dataclass
 class Slot:
     """One KV-cache slot's host-side state."""
@@ -90,13 +96,42 @@ class Scheduler:
 
     def submit(self, request: Request, prompt: np.ndarray,
                submit_time: float) -> None:
-        """Enqueue an engine-validated (request, cropped prompt) pair."""
+        """Enqueue an engine-validated (request, cropped prompt) pair.
+        Raises :class:`QueueFullError` when the wait queue is at
+        ``max_queue_len`` (0 = unbounded): overload must degrade into
+        fast rejections, not an ever-growing queue of requests that will
+        all miss their caller's deadline anyway."""
+        maxq = self.serving.max_queue_len
+        if maxq and len(self.queue) >= maxq:
+            raise QueueFullError(
+                f"admission queue full ({len(self.queue)}/{maxq} waiting, "
+                f"{self.occupied()}/{len(self.slots)} slots busy); retry "
+                "later"
+            )
         self.queue.append((request, prompt, submit_time))
+
+    def cancel(self, request_id: int) -> bool:
+        """Remove a request wherever it lives: still waiting (dropped
+        from the queue) or holding a slot (the slot is retired, so its
+        KV rows go back to the pool for the next admission). Returns
+        whether the request was found."""
+        for i, (req, _prompt, _t) in enumerate(self.queue):
+            if req.request_id == request_id:
+                del self.queue[i]
+                return True
+        for slot in self.slots:
+            if slot.state != FREE and slot.request.request_id == request_id:
+                self.retire(slot)
+                return True
+        return False
 
     # -- queries ------------------------------------------------------
 
     def has_work(self) -> bool:
         return bool(self.queue) or any(s.state != FREE for s in self.slots)
+
+    def queue_len(self) -> int:
+        return len(self.queue)
 
     def free_slots(self) -> List[Slot]:
         return [s for s in self.slots if s.state == FREE]

@@ -34,6 +34,29 @@ from differential_transformer_replication_tpu.serving.request import (
     RequestOutput,
     SamplingParams,
 )
+from differential_transformer_replication_tpu.serving.scheduler import (
+    QueueFullError,
+)
+
+
+class _Pending:
+    """One submitted request's handle across the thread boundary."""
+
+    __slots__ = ("prompt", "params", "done", "result", "error", "rid",
+                 "cancelled")
+
+    def __init__(self, prompt, params):
+        self.prompt = prompt
+        self.params = params
+        self.done = threading.Event()
+        self.result: Optional[RequestOutput] = None
+        self.error: Optional[BaseException] = None
+        self.rid: Optional[int] = None  # set once the engine admits it
+        self.cancelled = False
+
+    def fail(self, e: BaseException) -> None:
+        self.error = e
+        self.done.set()
 
 
 class EngineRunner:
@@ -42,7 +65,8 @@ class EngineRunner:
     def __init__(self, engine: ServingEngine):
         self.engine = engine
         self._cond = threading.Condition()
-        self._incoming: deque = deque()  # (prompt, params, done Event, box)
+        self._incoming: deque = deque()  # _Pending not yet in the engine
+        self._cancels: deque = deque()  # _Pending to cancel in the engine
         self._stop = False
         self._thread = threading.Thread(
             target=self._loop, name="serving-engine", daemon=True
@@ -50,28 +74,56 @@ class EngineRunner:
         self._thread.start()
 
     def submit(self, prompt: Sequence[int],
-               params: Optional[SamplingParams] = None, **kw):
-        """Thread-safe enqueue; returns (event, box) — ``box[0]`` holds
-        the RequestOutput (or ``box[1]`` an exception) once set."""
+               params: Optional[SamplingParams] = None, **kw) -> _Pending:
+        """Thread-safe enqueue; returns the request's :class:`_Pending`
+        handle. Raises :class:`QueueFullError` IMMEDIATELY when the
+        admission bound (ServingConfig.max_queue_len) is hit — counting
+        both the engine's wait queue and requests still in this runner's
+        hand-off deque — so overload degrades into fast rejections the
+        caller can act on."""
         params = params or SamplingParams(**kw)
-        done = threading.Event()
-        box: list = [None, None]
+        pending = _Pending(list(prompt), params)
         with self._cond:
             if self._stop:
                 raise RuntimeError("EngineRunner is closed")
-            self._incoming.append((list(prompt), params, done, box))
+            maxq = self.engine.serving.max_queue_len
+            # cancelled-but-undrained pendings no longer occupy the wait
+            # queue they are counted against — a burst of client
+            # timeouts must not cause spurious 503s for the next caller
+            waiting = sum(1 for p in self._incoming if not p.cancelled)
+            if maxq and waiting + self.engine.queue_len() >= maxq:
+                self.engine.stats["rejected"] += 1
+                raise QueueFullError(
+                    f"admission queue full ({maxq} waiting); retry later"
+                )
+            self._incoming.append(pending)
             self._cond.notify()
-        return done, box
+        return pending
+
+    def cancel(self, pending: _Pending) -> None:
+        """Abandon a request: if still in the hand-off deque it is
+        dropped before ever reaching the engine; if already admitted,
+        the engine reclaims its queue entry / KV slot on the next loop
+        pass (serving/engine.py:cancel). Safe to call concurrently with
+        completion — a request that finished first just ignores it."""
+        with self._cond:
+            pending.cancelled = True
+            self._cancels.append(pending)
+            self._cond.notify()
 
     def generate(self, prompt: Sequence[int],
                  params: Optional[SamplingParams] = None,
                  timeout: Optional[float] = None, **kw) -> RequestOutput:
-        done, box = self.submit(prompt, params, **kw)
-        if not done.wait(timeout):
+        pending = self.submit(prompt, params, **kw)
+        if not pending.done.wait(timeout):
+            # reclaim the engine-side resources before giving up — the
+            # old behavior decoded to completion for nobody, pinning a
+            # KV slot other callers were queued for
+            self.cancel(pending)
             raise TimeoutError("generation timed out")
-        if box[1] is not None:
-            raise box[1]
-        return box[0]
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
 
     def close(self) -> None:
         with self._cond:
@@ -80,40 +132,53 @@ class EngineRunner:
         self._thread.join(timeout=30)
 
     def _loop(self) -> None:
-        waiters: dict = {}  # request_id -> (Event, box)
+        waiters: dict = {}  # request_id -> _Pending
         while True:
             with self._cond:
-                while not self._incoming and not self.engine.has_work():
+                while (
+                    not self._incoming
+                    and not self._cancels
+                    and not self.engine.has_work()
+                ):
                     if self._stop:
                         return
                     self._cond.wait()
                 incoming = list(self._incoming)
                 self._incoming.clear()
+                cancels = list(self._cancels)
+                self._cancels.clear()
                 stopping = self._stop
-            for prompt, params, done, box in incoming:
+            for pending in cancels:
+                if pending.rid is not None:
+                    if self.engine.cancel(pending.rid):
+                        waiters.pop(pending.rid, None)
+                # rid None: either still in `incoming` (skipped below) or
+                # it finished before the cancel landed — nothing to undo
+            for pending in incoming:
+                if pending.cancelled:
+                    continue
                 try:
-                    rid = self.engine.submit(prompt, params=params)
-                    waiters[rid] = (done, box)
+                    pending.rid = self.engine.submit(
+                        pending.prompt, params=pending.params
+                    )
+                    waiters[pending.rid] = pending
                 except Exception as e:  # invalid request: fail the caller
-                    box[1] = e
-                    done.set()
+                    pending.fail(e)
             try:
                 for out in self.engine.step():
-                    done, box = waiters.pop(out.request_id)
-                    box[0] = out
-                    done.set()
+                    pending = waiters.pop(out.request_id)
+                    pending.result = out
+                    pending.done.set()
             except Exception as e:
                 # a device-side failure (OOM, runtime error) must not
                 # strand callers on a dead thread: fail every waiter and
                 # refuse further work
-                for done, box in waiters.values():
-                    box[1] = e
-                    done.set()
+                for pending in waiters.values():
+                    pending.fail(e)
                 with self._cond:
                     self._stop = True
-                    for _, _, done, box in self._incoming:
-                        box[1] = e
-                        done.set()
+                    for pending in self._incoming:
+                        pending.fail(e)
                     self._incoming.clear()
                 raise
             if stopping and not self.engine.has_work():
@@ -135,19 +200,37 @@ class ServingClient:
                        params: Optional[Sequence[SamplingParams]] = None,
                        timeout: Optional[float] = None,
                        **kw) -> List[RequestOutput]:
-        """Submit all prompts, then wait — batched by the engine."""
+        """Submit all prompts, then wait — batched by the engine. A
+        timeout cancels every still-unfinished request in the batch
+        before raising (no orphaned decodes)."""
         shared = SamplingParams(**kw) if params is None else None
-        handles = [
-            self.runner.submit(p, shared if shared else params[i])
-            for i, p in enumerate(prompts)
-        ]
+        handles = []
+        try:
+            for i, p in enumerate(prompts):
+                handles.append(
+                    self.runner.submit(p, shared if shared else params[i])
+                )
+        except Exception:
+            # a mid-batch rejection (QueueFullError, closed runner) must
+            # not orphan the prompts already accepted
+            for h in handles:
+                if not h.done.is_set():
+                    self.runner.cancel(h)
+            raise
         outs = []
-        for done, box in handles:
-            if not done.wait(timeout):
-                raise TimeoutError("generation timed out")
-            if box[1] is not None:
-                raise box[1]
-            outs.append(box[0])
+        for pending in handles:
+            ok = pending.done.wait(timeout)
+            if not ok or pending.error is not None:
+                # timeout OR one request failing: reclaim every still-
+                # running sibling before raising — nothing may keep
+                # decoding for a caller that is about to unwind
+                for h in handles:
+                    if not h.done.is_set():
+                        self.runner.cancel(h)
+                if not ok:
+                    raise TimeoutError("generation timed out")
+                raise pending.error
+            outs.append(pending.result)
         return outs
 
     @property
@@ -207,6 +290,11 @@ def _make_handler(client: ServingClient, tokenizer=None):
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._reply(400, {"error": str(e)})
                 return
+            except QueueFullError as e:
+                # overload: reject fast with the retryable status so
+                # load balancers/clients back off instead of piling on
+                self._reply(503, {"error": f"server overloaded: {e}"})
+                return
             except TimeoutError:
                 self._reply(503, {"error": "generation timed out"})
                 return
@@ -264,6 +352,9 @@ def main() -> None:
     p.add_argument("--prefill-chunk", type=int, default=128)
     p.add_argument("--prefill-budget", type=int, default=256)
     p.add_argument("--max-seq-len", type=int, default=0)
+    p.add_argument("--max-queue-len", type=int, default=0,
+                   help="reject (HTTP 503) submissions past this many "
+                        "waiting requests; 0 = unbounded")
     args = p.parse_args()
 
     meta = None
@@ -303,6 +394,7 @@ def main() -> None:
     serving = ServingConfig(
         num_slots=args.num_slots, prefill_chunk=args.prefill_chunk,
         prefill_budget=args.prefill_budget, max_seq_len=args.max_seq_len,
+        max_queue_len=args.max_queue_len,
     )
     client = ServingClient(ServingEngine(params, model_cfg, serving))
     httpd = serve(client, args.host, args.port, tokenizer)

@@ -1,3 +1,7 @@
+from differential_transformer_replication_tpu.train.anomaly import (
+    TrainingDivergedError,
+    init_guard_state,
+)
 from differential_transformer_replication_tpu.train.optim import (
     cosine_warmup_schedule,
     make_optimizer,
@@ -10,6 +14,7 @@ from differential_transformer_replication_tpu.train.step import (
     make_train_step,
 )
 from differential_transformer_replication_tpu.train.checkpoint import (
+    CheckpointError,
     from_pretrained,
     load_checkpoint,
     save_checkpoint,
@@ -23,6 +28,9 @@ from differential_transformer_replication_tpu.train.trainer import (
 )
 
 __all__ = [
+    "TrainingDivergedError",
+    "init_guard_state",
+    "CheckpointError",
     "cosine_warmup_schedule",
     "make_optimizer",
     "create_train_state",

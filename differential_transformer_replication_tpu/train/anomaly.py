@@ -1,0 +1,119 @@
+"""In-loop anomaly guard: skip bad updates inside the jitted step, roll
+back to an in-HBM snapshot when badness persists.
+
+Long runs die to single bad batches far more often than to hard faults:
+one non-finite loss poisons the params, and every later step trains a
+corpse until a human notices (the reference has no defense at all; its
+only health signal is the loss print, train.py:288). Production stacks
+(MegaScale, Jiang et al., 2024) treat this as a first-class subsystem.
+Three layers here, cheapest first:
+
+1. **Skip** (this module, traced into the step): the step computes a
+   ``bad`` flag — non-finite loss/grad-norm, or grad-norm above
+   ``anomaly_spike_factor`` × a running EMA of good-step grad norms —
+   and applies the optimizer update under ``lax.cond``, so a bad batch
+   leaves params, optimizer moments and the EMA untouched. Both branches
+   live in ONE compiled program: skipping adds zero recompiles (pinned
+   by tests/test_faults.py). The step counter still advances, so the lr
+   schedule and the epoch-sampler fast-forward (trainer.py) stay exact.
+2. **Rollback** (trainer host loop): the trainer keeps a periodic
+   on-device snapshot of a known-good state; when ``bad_streak`` reaches
+   ``anomaly_rollback_after`` — skipping didn't cure it, so the state
+   itself is suspect (corrupt params, poisoned moments) — it restores
+   the snapshot and rewinds the epoch sampler to match, i.e. an in-HBM
+   resume without touching disk.
+3. **Abort** (trainer): after ``anomaly_max_rollbacks`` rollbacks the
+   run raises :class:`TrainingDivergedError`; the trainer's finite-check
+   rescue save then refuses to overwrite the last good checkpoint with
+   the diverged state (trainer.py finally block).
+
+Multi-process agreement: the guarded step runs under GSPMD jit
+(parallel/dp_step.py), where the loss and global grad norm are already
+globally reduced values — the partitioner inserts the psums for the
+batch-sharded mean — so every rank computes the IDENTICAL ``bad`` flag
+and takes the same ``lax.cond`` branch by construction. Collectives
+stay matched with no extra communication; the host-side rollback
+decision reads a replicated scalar, so it also agrees without a
+collective.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised when the rollback budget is exhausted: the run cannot make
+    progress and must stop before corrupting its checkpoints."""
+
+
+def init_guard_state() -> dict:
+    """Guard state carried inside the train state (replicated scalars on
+    sharded meshes — parallel/sharding.py falls through to P() for them).
+    NOT checkpointed: train/checkpoint.py strips it on save and re-seeds
+    it on load, so the on-disk format is unchanged and guarded/unguarded
+    checkpoints interchange freely (the EMA re-warms after resume)."""
+    return {
+        # running EMA of grad norms over GOOD steps only (a spike must
+        # not raise its own threshold)
+        "ema": jnp.zeros((), jnp.float32),
+        # good updates applied so far; spike detection stays off until
+        # anomaly_warmup_steps of them have seeded the EMA
+        "good_steps": jnp.zeros((), jnp.int32),
+        # consecutive bad (skipped) steps — the trainer's rollback trigger
+        "bad_streak": jnp.zeros((), jnp.int32),
+        # total skipped steps this run (monotone; logged via metrics)
+        "skipped": jnp.zeros((), jnp.int32),
+    }
+
+
+def apply_guard(cfg, guard: dict, loss, grad_norm, do_update, params,
+                opt_state):
+    """The traced guard: decide ``bad``, gate the update, advance the
+    guard state. ``do_update: () -> (params, opt_state)`` runs the
+    optimizer (tx.update + apply_updates) and executes ONLY on good
+    steps — a skipped step pays the forward/backward it already ran,
+    nothing more. Returns (params, opt_state, guard, extra_metrics)."""
+    finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+    # at least one good step must have SEEDED the EMA before spike
+    # detection can arm — comparing against the zero-init EMA
+    # (warmup_steps=0) would flag every step bad forever
+    warmed = guard["good_steps"] >= max(cfg.anomaly_warmup_steps, 1)
+    spike = warmed & (
+        grad_norm > cfg.anomaly_spike_factor * guard["ema"]
+    )
+    bad = ~finite | spike
+
+    new_params, new_opt_state = jax.lax.cond(
+        bad, lambda: (params, opt_state), do_update
+    )
+
+    # EMA over good steps; the first good step seeds it directly so the
+    # warmup threshold reflects real norms, not a decay from zero
+    beta = jnp.float32(cfg.anomaly_ema_beta)
+    seeded = jnp.where(
+        guard["good_steps"] == 0,
+        grad_norm,
+        beta * guard["ema"] + (1.0 - beta) * grad_norm,
+    )
+    new_guard = {
+        "ema": jnp.where(bad, guard["ema"], seeded),
+        "good_steps": guard["good_steps"] + jnp.where(bad, 0, 1),
+        "bad_streak": jnp.where(bad, guard["bad_streak"] + 1, 0),
+        "skipped": guard["skipped"] + bad.astype(jnp.int32),
+    }
+    extra = {
+        "bad": bad.astype(jnp.int32),
+        "bad_streak": new_guard["bad_streak"],
+        "skipped": new_guard["skipped"],
+    }
+    return new_params, new_opt_state, new_guard, extra
+
+
+def snapshot_state(state: dict) -> dict:
+    """Deep on-device copy of a train state (sharding-preserving). Needed
+    both for taking the good-state snapshot and for restoring from it:
+    the jitted step DONATES its input state, so the snapshot and the live
+    state must never share buffers."""
+    return jax.tree_util.tree_map(jnp.copy, state)

@@ -26,6 +26,14 @@ from flax import serialization
 
 from differential_transformer_replication_tpu.config import ModelConfig, TrainConfig
 from differential_transformer_replication_tpu.models import common, init_model
+from differential_transformer_replication_tpu.utils import faults
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint on disk cannot be read: truncated/corrupt file or a
+    layout from an incompatible run. Always names the offending path —
+    the actionable signal (delete or re-point) a deep msgpack/KeyError
+    traceback buries."""
 
 
 def _map_blocks(tree, fn):
@@ -97,6 +105,11 @@ def save_checkpoint(
     state = gather_to_host(state)
     if not is_primary():
         return
+    # the anomaly-guard scalars (train/anomaly.py) are run-local health
+    # state, not model state: strip them so the on-disk format is
+    # identical with the guard on or off, and old checkpoints keep
+    # loading (load_checkpoint re-seeds a fresh guard from the target)
+    state = {k: v for k, v in state.items() if k != "guard"}
     os.makedirs(path, exist_ok=True)
     if _is_stacked(state):
         state = canonicalize_state(state, cfg.resolved_model().n_layer)
@@ -119,11 +132,23 @@ def save_checkpoint(
 
 def _atomic_write(dest: str, data: bytes) -> None:
     tmp = dest + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, dest)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        # injection point for the chaos tests (utils/faults.py
+        # "ckpt_write"): a crash HERE — temp fully written, rename not
+        # yet done — is exactly the window this function must survive;
+        # the previous ``dest`` stays intact
+        faults.check("ckpt_write")
+        os.replace(tmp, dest)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load_checkpoint(path: str, cfg: TrainConfig, target_state: dict) -> Tuple[dict, float]:
@@ -135,16 +160,61 @@ def load_checkpoint(path: str, cfg: TrainConfig, target_state: dict) -> Tuple[di
         raise FileNotFoundError(
             f"no checkpoint at {path!r} (expected {path}/state.msgpack)"
         )
-    stacked = _is_stacked(target_state)
+    # checkpoints never carry the anomaly-guard scalars (save_checkpoint
+    # strips them); a guarded target gets a fresh guard re-attached so
+    # the EMA/streak re-warm after resume
+    guard = target_state.get("guard")
+    target = {k: v for k, v in target_state.items() if k != "guard"}
+    stacked = _is_stacked(target)
     if stacked:
-        target_state = canonicalize_state(target_state, cfg.resolved_model().n_layer)
-    with open(os.path.join(path, "state.msgpack"), "rb") as f:
-        state = serialization.from_bytes(target_state, f.read())
+        target = canonicalize_state(target, cfg.resolved_model().n_layer)
+    state_path = os.path.join(path, "state.msgpack")
+    try:
+        with open(state_path, "rb") as f:
+            state = serialization.from_bytes(target, f.read())
+    except Exception as e:
+        raise CheckpointError(
+            f"cannot deserialize checkpoint state at {state_path!r}: "
+            f"{type(e).__name__}: {e}. The file is truncated/corrupt or "
+            "from an incompatible model/optimizer config — restore it "
+            "from a good copy or resume from a different checkpoint"
+        ) from e
     if stacked:
         state = _stack(state)
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
-    return state, meta["best_val_loss"]
+    if guard is not None:
+        state["guard"] = guard
+    meta = read_meta(path)
+    try:
+        best = meta["best_val_loss"]
+    except KeyError as e:
+        raise CheckpointError(
+            f"checkpoint meta at {os.path.join(path, 'meta.json')!r} has "
+            "no 'best_val_loss' — the file is corrupt or not a training "
+            "checkpoint"
+        ) from e
+    return state, best
+
+
+def read_meta(path: str) -> dict:
+    """Load and validate a checkpoint dir's meta.json, raising one clear
+    :class:`CheckpointError` (naming the path) on truncated/garbage
+    content instead of a bare JSONDecodeError."""
+    meta_path = os.path.join(path, "meta.json")
+    try:
+        with open(meta_path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        raise CheckpointError(
+            f"no checkpoint metadata at {meta_path!r} (the directory is "
+            "not a checkpoint, or the save was interrupted before the "
+            "atomic rename)"
+        ) from None
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointError(
+            f"cannot parse checkpoint metadata at {meta_path!r}: {e}. "
+            "The file is truncated or corrupt — restore it from a good "
+            "copy or resume from a different checkpoint"
+        ) from e
 
 
 def load_params_for_inference(path: str) -> Tuple[dict, ModelConfig, dict]:
@@ -161,16 +231,29 @@ def load_params_for_inference(path: str) -> Tuple[dict, ModelConfig, dict]:
         create_train_state,
     )
 
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
-    saved = meta["config"]
-    cfg = TrainConfig(
-        model=ModelConfig(**saved["model"]),
-        vocab_size=saved["vocab_size"],
-        control_head_multiplier=saved["control_head_multiplier"],
+    meta = read_meta(path)
+    try:
+        saved = meta["config"]
+        cfg = TrainConfig(
+            model=ModelConfig(**saved["model"]),
+            vocab_size=saved["vocab_size"],
+            control_head_multiplier=saved["control_head_multiplier"],
+        )
+    except (KeyError, TypeError) as e:
+        raise CheckpointError(
+            f"checkpoint metadata at "
+            f"{os.path.join(path, 'meta.json')!r} is missing the saved "
+            f"train config ({type(e).__name__}: {e}) — the file is "
+            "corrupt or from an incompatible version"
+        ) from e
+    # abstract target: only the pytree STRUCTURE matters to from_bytes,
+    # so skip materializing a random-init model + two Adam moment trees
+    # (~3x the params in transient memory at serving startup) that the
+    # deserialized buffers would immediately replace
+    target = jax.eval_shape(
+        lambda: create_train_state(jax.random.PRNGKey(0), cfg)
     )
-    state = create_train_state(jax.random.PRNGKey(0), cfg)
-    state, _ = load_checkpoint(path, cfg, state)
+    state, _ = load_checkpoint(path, cfg, target)
     return state["params"], cfg.resolved_model(), meta
 
 

@@ -65,10 +65,13 @@ class MetricLogger:
         loss: float,
         lr: float,
         tokens_per_sec: Optional[float] = None,
+        extra: Optional[dict] = None,
     ) -> None:
         """Per-log_interval metrics (train.py:286-294), plus the natively
         measured tokens/sec the reference never recorded (SURVEY.md
-        section 5.1; BASELINE.json north-star metric)."""
+        section 5.1; BASELINE.json north-star metric). ``extra`` carries
+        run-health counters (anomaly-guard skipped_steps/rollbacks,
+        trainer.py) into the same record."""
         if not self._primary:
             return
         print(f"iter {iter_num}: loss {loss:.4f}, lr {lr:.2e}")  # train.py:288
@@ -80,6 +83,8 @@ class MetricLogger:
         }
         if tokens_per_sec is not None:
             payload["tokens_per_sec"] = round(tokens_per_sec, 1)
+        if extra:
+            payload.update(extra)
         self._emit(payload)
 
     def log_eval(self, iter_num: int, train_loss: float, val_loss: float) -> None:

@@ -21,6 +21,10 @@ import optax
 
 from differential_transformer_replication_tpu.config import ModelConfig, TrainConfig
 from differential_transformer_replication_tpu.models import init_model, model_forward
+from differential_transformer_replication_tpu.train.anomaly import (
+    apply_guard,
+    init_guard_state,
+)
 from differential_transformer_replication_tpu.train.optim import make_optimizer
 
 
@@ -28,11 +32,17 @@ def create_train_state(key: jax.Array, cfg: TrainConfig) -> dict:
     model_cfg = cfg.resolved_model()
     params = init_model(key, model_cfg)
     tx, _ = make_optimizer(cfg)
-    return {
+    state = {
         "params": params,
         "opt_state": tx.init(params),
         "step": jnp.zeros((), jnp.int32),
     }
+    if cfg.anomaly_guard:
+        # guard scalars ride inside the state so the skip/streak logic is
+        # part of the one compiled step; checkpointing strips them
+        # (train/checkpoint.py), keeping the on-disk format unchanged
+        state["guard"] = init_guard_state()
+    return state
 
 
 def loss_fn(
@@ -61,8 +71,23 @@ def make_step_fn(cfg: TrainConfig, mesh=None):
     tx, schedule = make_optimizer(cfg)
     grad_fn = jax.value_and_grad(loss_fn)
 
+    def run_grad(params, x, y, r, scale):
+        """value_and_grad, optionally loss-scaled: ``scale`` is the
+        fault-injection poison (utils/faults.py) — NaN there makes the
+        loss AND every gradient NaN, the exact failure the anomaly guard
+        must catch. None (no fault armed) is the production path."""
+        if scale is None:
+            return grad_fn(params, x, y, model_cfg, r, mesh)
+        return jax.value_and_grad(
+            lambda p: loss_fn(p, x, y, model_cfg, r, mesh) * scale
+        )(params)
+
     def step(state: dict, batch: dict, rng: Optional[jax.Array] = None):
         n_micro = batch["x"].shape[0]
+        # (A,) poison scales, present ONLY when NaN faults are armed (the
+        # trainer then includes it in EVERY batch so the pytree structure
+        # — and the compiled program — never changes mid-run)
+        poison = batch.get("poison")
         if n_micro == 1:
             # the reference default (grad_acc_steps=1, train.py:68): skip
             # the scan entirely — the zero-init + accumulate + loop
@@ -70,38 +95,67 @@ def make_step_fn(cfg: TrainConfig, mesh=None):
             # (measured via profile; the adds alone pass over all 94M
             # params) for a one-iteration loop
             r = None if rng is None else jax.random.fold_in(rng, 0)
-            loss, grads = grad_fn(
-                state["params"], batch["x"][0], batch["y"][0], model_cfg, r, mesh
+            loss, grads = run_grad(
+                state["params"], batch["x"][0], batch["y"][0], r,
+                None if poison is None else poison[0],
             )
         else:
             def micro(carry, xs):
                 grads_acc, loss_acc, i = carry
-                x, y = xs
+                if poison is None:
+                    x, y = xs
+                    sc = None
+                else:
+                    x, y, sc = xs
                 r = None if rng is None else jax.random.fold_in(rng, i)
-                loss, grads = grad_fn(state["params"], x, y, model_cfg, r, mesh)
+                loss, grads = run_grad(state["params"], x, y, r, sc)
                 grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
                 return (grads_acc, loss_acc + loss, i + 1), None
 
+            xs = (batch["x"], batch["y"])
+            if poison is not None:
+                xs = xs + (poison,)
             zeros = jax.tree_util.tree_map(jnp.zeros_like, state["params"])
             (grads, loss_sum, _), _ = jax.lax.scan(
                 micro, (zeros, jnp.zeros(()), jnp.zeros((), jnp.int32)),
-                (batch["x"], batch["y"]),
+                xs,
             )
             grads = jax.tree_util.tree_map(lambda g: g / n_micro, grads)
             loss = loss_sum / n_micro
 
-        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
-        params = optax.apply_updates(state["params"], updates)
+        grad_norm = optax.global_norm(grads)
+        metrics = {
+            "loss": loss,
+            "learning_rate": schedule(state["step"]),
+            "grad_norm": grad_norm,
+        }
+
+        def do_update():
+            updates, opt_state = tx.update(
+                grads, state["opt_state"], state["params"]
+            )
+            return optax.apply_updates(state["params"], updates), opt_state
+
+        if cfg.anomaly_guard:
+            # skip the update on a bad step under lax.cond — one compiled
+            # program either way (compile count pinned, tests/test_faults
+            # .py); the step counter still advances so the lr schedule
+            # and the epoch-sampler fast-forward stay exact
+            params, opt_state, guard, extra = apply_guard(
+                cfg, state["guard"], loss, grad_norm, do_update,
+                state["params"], state["opt_state"],
+            )
+            metrics.update(extra)
+        else:
+            params, opt_state = do_update()
+
         new_state = {
             "params": params,
             "opt_state": opt_state,
             "step": state["step"] + 1,
         }
-        metrics = {
-            "loss": loss,
-            "learning_rate": schedule(state["step"]),
-            "grad_norm": optax.global_norm(grads),
-        }
+        if cfg.anomaly_guard:
+            new_state["guard"] = guard
         return new_state, metrics
 
     return step

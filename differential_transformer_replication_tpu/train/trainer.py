@@ -22,12 +22,17 @@ from differential_transformer_replication_tpu.data import (
     split_tokens,
     train_bpe_tokenizer,
 )
+from differential_transformer_replication_tpu.train.anomaly import (
+    TrainingDivergedError,
+    snapshot_state,
+)
 from differential_transformer_replication_tpu.train.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
 from differential_transformer_replication_tpu.train.metrics import MetricLogger
 from differential_transformer_replication_tpu.utils import ProfilerWindow, Throughput
+from differential_transformer_replication_tpu.utils import faults
 from differential_transformer_replication_tpu.train.step import (
     create_train_state,
     make_eval_many,
@@ -196,6 +201,9 @@ def train(cfg: TrainConfig) -> dict:
 
     distributed_initialize()  # no-op single-process (multihost.py)
     print(f"Using devices: {jax.devices()}")
+    # chaos-test fault injection (utils/faults.py); inert unless armed
+    # via cfg.faults or the DTX_FAULTS env var
+    faults.arm(cfg.faults)
 
     tokenizer, vocab_size, train_ds, val_ds = build_data(cfg)
     cfg = cfg.replace(vocab_size=vocab_size)
@@ -212,13 +220,15 @@ def train(cfg: TrainConfig) -> dict:
         # differently-tokenized stream — then overwrites the checkpoint,
         # destroying the evidence. Compare content fingerprints up front
         # (older checkpoints without one degrade to the size check).
-        import json as _json
         import os as _os
+
+        from differential_transformer_replication_tpu.train.checkpoint import (
+            read_meta,
+        )
 
         meta_path = _os.path.join(cfg.resume_from, "meta.json")
         if _os.path.exists(meta_path):
-            with open(meta_path) as f:
-                meta = _json.load(f)
+            meta = read_meta(cfg.resume_from)
             # compare against the CHECKPOINT's recorded vocab size, not
             # cfg.vocab_size — the latter was just overwritten from this
             # very tokenizer (cfg.replace above), which made the size leg
@@ -413,6 +423,23 @@ def train(cfg: TrainConfig) -> dict:
     model_cfg = cfg.resolved_model()
     use_dropout = model_cfg.dropout > 0.0
 
+    # Anomaly guard (train/anomaly.py): the jitted step skips bad
+    # updates on-device; the host side here keeps a periodic good-state
+    # snapshot, rolls back to it when badness persists, and aborts when
+    # rollbacks stop helping. Pipeline runs use a different step
+    # (parallel/pipeline.py) that does not carry the guard state.
+    guard_on = cfg.anomaly_guard and cfg.mesh.pipeline <= 1
+    if cfg.anomaly_guard and cfg.mesh.pipeline > 1 and is_primary():
+        print("[anomaly] guard is unsupported on the pipeline path; disabled")
+    # the pipeline step's jit signature declares only {"x","y"} batches
+    # (parallel/pipeline.py) — NaN injection is train-step-only, like
+    # the guard that exists to catch it
+    nan_fault_armed = faults.nan_armed() and cfg.mesh.pipeline <= 1
+    if faults.nan_armed() and cfg.mesh.pipeline > 1 and is_primary():
+        print("[faults] nan injection is unsupported on the pipeline "
+              "path; disabled")
+    rollbacks = 0
+
     print("Starting training...")
     t0 = time.time()
     tokens_seen = 0
@@ -477,25 +504,95 @@ def train(cfg: TrainConfig) -> dict:
     # handler (e.g. a retry wrapper) and would wrongly suppress the
     # multi-process rescue save on a clean run
     crashed = False
+    # the guard's rollback target: seeded at loop entry so one always
+    # exists, refreshed every anomaly_snapshot_interval good iterations.
+    # Like the throttle snapshot above, it pins ONE extra train state in
+    # HBM (device-0-shard-sized on sharded runs).
+    good_snapshot = snapshot_state(state) if guard_on else None
+    snapshot_iter = iter_num
     try:
         while iter_num < cfg.max_iters:
             if _agreed_stop(iter_num):
                 if is_primary():
                     print(f"SIGTERM received: stopping at iter {iter_num}")
                 break
+            faults.fire(iter_num)  # injected raise/SIGTERM/SIGKILL points
+            if faults.corrupt_params_at(iter_num):
+                # simulated state corruption (bitflip-class fault): NaN a
+                # param leaf — batch skipping cannot cure this; only the
+                # guard's rollback recovers the run
+                leaves, treedef = jax.tree_util.tree_flatten(state["params"])
+                leaves[0] = leaves[0] * jnp.float32(jnp.nan)
+                state["params"] = jax.tree_util.tree_unflatten(treedef, leaves)
             batch = draw_batch()
+            if nan_fault_armed:
+                # present in EVERY batch while armed, so the compiled
+                # step's input structure never changes (train/step.py)
+                scale = np.nan if faults.poison_at(iter_num) else 1.0
+                batch["poison"] = np.full(
+                    (cfg.grad_acc_steps,), scale, np.float32
+                )
             rng = jax.random.fold_in(dropout_key, iter_num) if use_dropout else None
             state, metrics = train_step(state, batch, rng)
             iter_num += 1
             profiler.step(iter_num, sync=metrics["loss"])
             tokens_seen += cfg.micro_batch_size * cfg.grad_acc_steps * model_cfg.block_size
 
+            if guard_on and iter_num % cfg.anomaly_check_interval == 0:
+                # one replicated-scalar read: every rank computes the same
+                # streak (the bad flag is a global value, train/anomaly
+                # .py), so rollback/abort decisions agree with no
+                # collective. This blocks on the step's completion —
+                # anomaly_check_interval amortizes that pipeline bubble.
+                streak = int(jax.device_get(metrics["bad_streak"]))
+                if streak == 0:
+                    if iter_num - snapshot_iter >= cfg.anomaly_snapshot_interval:
+                        good_snapshot = snapshot_state(state)
+                        snapshot_iter = iter_num
+                elif streak >= cfg.anomaly_rollback_after:
+                    rollbacks += 1
+                    if rollbacks > cfg.anomaly_max_rollbacks:
+                        raise TrainingDivergedError(
+                            f"{rollbacks - 1} rollback(s) did not recover "
+                            f"the run: still {streak} consecutive bad "
+                            f"steps at iter {iter_num}. Aborting without "
+                            "overwriting the last good checkpoint."
+                        )
+                    if is_primary():
+                        print(
+                            f"[anomaly] {streak} consecutive bad steps at "
+                            f"iter {iter_num}: rolling back to iter "
+                            f"{snapshot_iter} (rollback {rollbacks}/"
+                            f"{cfg.anomaly_max_rollbacks})"
+                        )
+                    # an in-HBM resume: restore the snapshot (copy — the
+                    # donated step must not consume it) and rewind the
+                    # epoch sampler to the matching position, exactly the
+                    # checkpoint-resume fast-forward. The replacement
+                    # sampler is stateless draws and simply continues.
+                    state = snapshot_state(good_snapshot)
+                    iter_num = snapshot_iter
+                    metrics = None
+                    if cfg.sampler == "epoch":
+                        consumed = (
+                            iter_num * cfg.grad_acc_steps * cfg.micro_batch_size
+                        )
+                        perm.epoch, perm.cursor = divmod(consumed, len(train_ds))
+                    continue
+
             if iter_num % cfg.log_interval == 0:
+                extra = None
+                if guard_on:
+                    extra = {
+                        "skipped_steps": int(metrics["skipped"]),
+                        "rollbacks": rollbacks,
+                    }
                 logger.log_step(
                     iter_num,
                     float(metrics["loss"]),
                     float(metrics["learning_rate"]),
                     tokens_per_sec=throughput.update(tokens_seen),
+                    extra=extra,
                 )
 
             if iter_num % cfg.eval_interval == 0:

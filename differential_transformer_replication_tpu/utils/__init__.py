@@ -1,7 +1,8 @@
+from differential_transformer_replication_tpu.utils import faults
 from differential_transformer_replication_tpu.utils.profiling import (
     ProfilerWindow,
     Throughput,
     trace,
 )
 
-__all__ = ["ProfilerWindow", "Throughput", "trace"]
+__all__ = ["ProfilerWindow", "Throughput", "trace", "faults"]

@@ -1,0 +1,164 @@
+"""Fault-injection harness: deterministic failures for chaos tests.
+
+None of the crash/resume machinery (SIGTERM graceful stop, rescue
+checkpoints, the anomaly guard, the crash supervisor) is trustworthy
+until a test actually kills a run mid-flight — this module is the
+injection side of those tests (tests/test_faults.py). It is inert
+unless explicitly armed; nothing here imports jax, so the supervisor
+and checkpoint layer can use it without device initialization.
+
+A fault PLAN is a comma-separated spec of ``kind@step`` (or
+``kind@a-b`` for an inclusive step range, or bare ``kind`` for
+call-point faults):
+
+  ``raise@K``           raise :class:`FaultInjected` at the top of
+                        training iteration K (a generic crash)
+  ``sigterm@K``         SIGTERM self at iteration K (exercises the
+                        graceful-stop path, trainer.py)
+  ``sigkill@K``         SIGKILL self at iteration K — uncatchable, no
+                        cleanup runs (the preemption/hard-crash case)
+  ``nan@K`` / ``nan@A-B``
+                        NaN-poison the loss of the batch(es) at those
+                        iterations (the trainer threads a poison scale
+                        into the jitted step; the gradient inherits the
+                        NaN, so the whole update is bad)
+  ``corrupt_params@K``  overwrite one param leaf with NaN before
+                        iteration K — state corruption that batch
+                        skipping CANNOT cure; only rollback recovers
+  ``ckpt_write`` / ``ckpt_write@N``
+                        fail the next (or the Nth upcoming) checkpoint
+                        file write, AFTER the temp file is written but
+                        BEFORE the atomic rename — the crash point
+                        ``_atomic_write`` exists to survive
+
+Armed from the ``DTX_FAULTS`` environment variable on first use (env
+crosses the supervisor's subprocess boundary) and/or programmatically
+via :func:`arm` (``TrainConfig.faults`` feeds this). One-shot kinds
+(raise/sigterm/sigkill/corrupt_params/ckpt_write) disarm after firing
+so a resumed run that replays the same step does not re-fire in
+process; across processes the supervisor strips ``DTX_FAULTS`` from the
+child environment on restarts (tools/train_supervisor.py).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Optional, Set
+
+ENV_VAR = "DTX_FAULTS"
+
+_STEP_KINDS = ("raise", "sigterm", "sigkill", "nan", "corrupt_params")
+_POINT_KINDS = ("ckpt_write",)
+
+
+class FaultInjected(RuntimeError):
+    """The injected failure (distinguishable from organic errors)."""
+
+
+_plan: Optional[dict] = None  # lazy; see _get()
+
+
+def _parse_steps(expr: str) -> Set[int]:
+    if "-" in expr:
+        a, b = expr.split("-", 1)
+        return set(range(int(a), int(b) + 1))
+    return {int(expr)}
+
+
+def _parse(spec: str) -> dict:
+    plan = {k: set() for k in _STEP_KINDS}
+    plan["points"] = {}  # point -> calls remaining until it fires
+    for token in spec.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        kind, _, arg = token.partition("@")
+        if kind in _STEP_KINDS:
+            if not arg:
+                raise ValueError(f"fault {kind!r} needs @step (got {token!r})")
+            plan[kind] |= _parse_steps(arg)
+        elif kind in _POINT_KINDS:
+            plan["points"][kind] = int(arg) if arg else 1
+        else:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {token!r}; known: "
+                f"{_STEP_KINDS + _POINT_KINDS}"
+            )
+    return plan
+
+
+def _get() -> dict:
+    global _plan
+    if _plan is None:
+        _plan = _parse(os.environ.get(ENV_VAR, ""))
+    return _plan
+
+
+def arm(spec: Optional[str]) -> None:
+    """Merge a spec into the armed plan (env faults stay armed)."""
+    if not spec:
+        _get()
+        return
+    extra = _parse(spec)
+    plan = _get()
+    for k in _STEP_KINDS:
+        plan[k] |= extra[k]
+    plan["points"].update(extra["points"])
+
+
+def reset() -> None:
+    """Disarm everything (tests); env re-arms lazily on next use."""
+    global _plan
+    _plan = None
+    if ENV_VAR in os.environ:  # a stale env spec must not re-arm
+        _plan = _parse("")
+
+
+def armed() -> bool:
+    p = _get()
+    return bool(p["points"]) or any(p[k] for k in _STEP_KINDS)
+
+
+def fire(step: int) -> None:
+    """Crash-class faults for this iteration; called at the top of the
+    train loop. raise/sigterm are one-shot; sigkill needs no disarm."""
+    p = _get()
+    if step in p["raise"]:
+        p["raise"].discard(step)
+        raise FaultInjected(f"injected crash at iteration {step}")
+    if step in p["sigterm"]:
+        p["sigterm"].discard(step)
+        os.kill(os.getpid(), signal.SIGTERM)
+    if step in p["sigkill"]:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def nan_armed() -> bool:
+    """Whether any NaN-poison steps are armed — when true the trainer
+    threads a poison scale through EVERY step so the batch pytree
+    structure (and therefore the compiled program) never changes."""
+    return bool(_get()["nan"])
+
+
+def poison_at(step: int) -> bool:
+    return step in _get()["nan"]
+
+
+def corrupt_params_at(step: int) -> bool:
+    p = _get()
+    if step in p["corrupt_params"]:
+        p["corrupt_params"].discard(step)
+        return True
+    return False
+
+
+def check(point: str) -> None:
+    """Call-point fault (e.g. ``ckpt_write``): raises on the armed call."""
+    points = _get()["points"]
+    if point not in points:
+        return
+    points[point] -= 1
+    if points[point] <= 0:
+        del points[point]
+        raise FaultInjected(f"injected failure at {point}")

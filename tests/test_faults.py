@@ -1,0 +1,628 @@
+"""Fault-tolerance tests: the anomaly guard (skip / rollback / abort),
+the fault-injection harness, checkpoint-corruption errors, and the crash
+supervisor.
+
+Tiering: the single-step and single-run tests here are quick (tier-1);
+the kill-and-resume chaos tests spawn real ``train.py`` subprocesses and
+are marked ``slow``. The compile-count pin
+(test_guard_adds_no_recompiles) is the acceptance check that the
+``lax.cond`` guard costs zero steady-state recompiles.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.config import ModelConfig, TrainConfig
+from differential_transformer_replication_tpu.train import (
+    CheckpointError,
+    TrainingDivergedError,
+    create_train_state,
+    load_checkpoint,
+    make_train_step,
+    save_checkpoint,
+    train,
+)
+from differential_transformer_replication_tpu.utils import faults
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+SUPERVISOR = os.path.join(TOOLS, "train_supervisor.py")
+TRAIN_PY = os.path.join(os.path.dirname(__file__), "..", "train.py")
+
+TINY_MODEL = dict(vocab_size=256, n_embd=32, n_head=2, n_layer=2,
+                  block_size=16, dropout=0.0, compute_dtype="float32")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """The fault plan is process-global; never leak between tests."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def tiny_cfg(tmp_path, **kw):
+    defaults = dict(
+        vocab_size=256,
+        dataset="synthetic",
+        num_train_samples=200,
+        micro_batch_size=4,
+        grad_acc_steps=1,
+        max_iters=20,
+        eval_interval=10,
+        eval_iters=2,
+        log_interval=5,
+        learning_rate=3e-3,
+        min_lr=3e-4,
+        warmup_iters=5,
+        control_head_multiplier=1,
+        tokenizer_dir=str(tmp_path / "tokenizer"),
+        checkpoint_path=str(tmp_path / "ckpt"),
+        last_checkpoint_path=str(tmp_path / "last_ckpt"),
+        metrics_path=str(tmp_path / "metrics.jsonl"),
+        seed=7,
+        # tight guard knobs so tiny runs exercise every path
+        anomaly_check_interval=1,
+        anomaly_snapshot_interval=5,
+        anomaly_rollback_after=3,
+        anomaly_max_rollbacks=2,
+    )
+    model_kw = kw.pop("model_kw", {})
+    return TrainConfig(
+        model=ModelConfig(model=kw.pop("model", "diff"),
+                          **{**TINY_MODEL, **model_kw}),
+        **{**defaults, **kw},
+    )
+
+
+def step_cfg(**kw):
+    return TrainConfig(
+        model=ModelConfig(model="control", **{**TINY_MODEL, "vocab_size": 31}),
+        vocab_size=31, learning_rate=1e-2, warmup_iters=2, max_iters=100,
+        control_head_multiplier=1, **kw,
+    )
+
+
+def _params_finite(state) -> bool:
+    return all(
+        bool(jnp.isfinite(leaf).all())
+        for leaf in jax.tree_util.tree_leaves(state["params"])
+    )
+
+
+def _batch(cfg, key=1, poison=None):
+    x = jax.random.randint(jax.random.PRNGKey(key), (1, 4, 16), 0,
+                           cfg.vocab_size)
+    b = {"x": x, "y": jnp.roll(x, -1, -1)}
+    if poison is not None:
+        b["poison"] = np.full((1,), poison, np.float32)
+    return b
+
+
+class TestFaultSpec:
+    def test_parse_kinds_and_ranges(self):
+        faults.arm("raise@3,nan@5-7,sigterm@9,ckpt_write@2")
+        assert faults.armed()
+        assert faults.nan_armed()
+        assert faults.poison_at(5) and faults.poison_at(7)
+        assert not faults.poison_at(8)
+        # raise is one-shot: fires once, then the same step is clean
+        with pytest.raises(faults.FaultInjected):
+            faults.fire(3)
+        faults.fire(3)  # disarmed
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.arm("meteor@4")
+
+    def test_inert_when_unarmed(self):
+        assert not faults.armed()
+        faults.fire(0)
+        faults.check("ckpt_write")  # no-op
+
+    def test_ckpt_write_counts_calls(self):
+        faults.arm("ckpt_write@2")
+        faults.check("ckpt_write")  # 1st call survives
+        with pytest.raises(faults.FaultInjected):
+            faults.check("ckpt_write")  # 2nd fires
+        faults.check("ckpt_write")  # disarmed
+
+
+class TestAnomalyGuard:
+    def test_nan_batch_skipped_and_params_protected(self):
+        """The tentpole contract: one NaN batch is SKIPPED — params and
+        optimizer state untouched, the skip counter increments, the
+        streak resets on the next good batch, and the step counter still
+        advances (lr schedule / sampler fast-forward stay exact)."""
+        cfg = step_cfg()
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        step = make_train_step(cfg)
+        for i in range(5):
+            poison = np.nan if i == 2 else 1.0
+            state, m = step(state, _batch(cfg, poison=poison))
+            if i == 2:
+                assert int(m["bad"]) == 1
+                assert not np.isfinite(float(m["loss"]))
+            else:
+                assert int(m["bad"]) == 0
+                assert np.isfinite(float(m["loss"]))
+        assert _params_finite(state)
+        assert int(m["skipped"]) == 1
+        assert int(m["bad_streak"]) == 0
+        assert int(state["step"]) == 5  # skipped steps still count
+
+    def test_unguarded_step_is_poisoned(self):
+        """The contrast run: without the guard the same NaN batch
+        corrupts the params permanently."""
+        cfg = step_cfg(anomaly_guard=False)
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        assert "guard" not in state
+        step = make_train_step(cfg)
+        state, _ = step(state, _batch(cfg, poison=np.nan))
+        assert not _params_finite(state)
+
+    def test_grad_spike_skipped_after_warmup(self):
+        """A finite but exploding gradient (norm >> spike_factor x EMA)
+        is skipped once the EMA has warmed up."""
+        cfg = step_cfg(anomaly_warmup_steps=3, anomaly_spike_factor=4.0)
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        step = make_train_step(cfg)
+        for _ in range(4):  # warm the EMA past warmup_steps good steps
+            state, m = step(state, _batch(cfg, poison=1.0))
+        before = jax.device_get(state["params"])
+        # x1e4 loss scale: grad norm stays FINITE (no overflow — this
+        # must exercise the spike leg, not the non-finite leg) but far
+        # beyond spike_factor x EMA
+        state, m = step(state, _batch(cfg, poison=1e4))
+        assert int(m["bad"]) == 1 and np.isfinite(float(m["grad_norm"]))
+        after = jax.device_get(state["params"])
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_array_equal(a, b)
+        # and a normal batch afterwards trains again
+        state, m = step(state, _batch(cfg, poison=1.0))
+        assert int(m["bad"]) == 0 and int(m["bad_streak"]) == 0
+
+    def test_guard_adds_no_recompiles(self):
+        """Acceptance pin: the guarded step compiles exactly once across
+        good AND bad batches — same count as the unguarded baseline.
+        lax.cond keeps both branches in one program."""
+        counts = {}
+        for guard in (True, False):
+            cfg = step_cfg(anomaly_guard=guard)
+            state = create_train_state(jax.random.PRNGKey(0), cfg)
+            step = make_train_step(cfg)
+            for i in range(6):
+                poison = np.nan if (guard and i == 3) else 1.0
+                state, _ = step(state, _batch(cfg, key=i, poison=poison))
+            counts[guard] = step._cache_size()
+        assert counts[True] == counts[False] == 1
+
+    def test_guard_state_not_checkpointed(self, tmp_path):
+        """Checkpoints are guard-agnostic: a guarded state saves to the
+        same on-disk format and loads into guarded AND unguarded
+        targets (and vice versa)."""
+        cfg = step_cfg()
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        step = make_train_step(cfg)
+        state, m = step(state, _batch(cfg, poison=np.nan))  # skipped=1
+        save_checkpoint(str(tmp_path / "c"), state, 1.0, cfg)
+        # guarded target: fresh guard re-seeded, not the saved counters
+        restored, _ = load_checkpoint(
+            str(tmp_path / "c"), cfg,
+            create_train_state(jax.random.PRNGKey(1), cfg),
+        )
+        assert int(restored["guard"]["skipped"]) == 0
+        assert int(restored["step"]) == 1
+        # unguarded target loads the same file
+        cfg_off = cfg.replace(anomaly_guard=False)
+        restored2, _ = load_checkpoint(
+            str(tmp_path / "c"), cfg_off,
+            create_train_state(jax.random.PRNGKey(1), cfg_off),
+        )
+        assert "guard" not in restored2
+
+
+class TestTrainerRecovery:
+    def test_nan_steps_skipped_end_to_end(self, tmp_path, capsys):
+        """A two-batch NaN burst mid-run: the run completes, the skip
+        count lands in the metrics, the loss keeps decreasing, and the
+        checkpoints contain only finite values."""
+        cfg = tiny_cfg(tmp_path, faults="nan@6-7")
+        state = train(cfg)
+        assert int(state["step"]) == 20
+        assert _params_finite(state)
+        lines = [json.loads(l) for l in open(cfg.metrics_path)]
+        step_lines = [l for l in lines if "skipped_steps" in l]
+        assert step_lines[-1]["skipped_steps"] == 2
+        assert step_lines[-1]["rollbacks"] == 0
+        assert np.isfinite(step_lines[-1]["loss"])
+        # checkpoints never contain non-finite values
+        target = create_train_state(jax.random.PRNGKey(0), cfg)
+        restored, _ = load_checkpoint(cfg.checkpoint_path, cfg, target)
+        assert _params_finite(restored)
+
+    def test_rollback_recovers_from_corrupt_params(self, tmp_path, capsys):
+        """State corruption (NaN'd param leaf) that skipping cannot cure:
+        after rollback_after consecutive bad steps the trainer restores
+        the in-HBM snapshot and the run completes with finite params."""
+        cfg = tiny_cfg(tmp_path, faults="corrupt_params@12")
+        state = train(cfg)
+        out = capsys.readouterr().out
+        assert "rolling back to iter 10" in out
+        assert int(state["step"]) == 20
+        assert _params_finite(state)
+        lines = [json.loads(l) for l in open(cfg.metrics_path)]
+        assert [l for l in lines if l.get("rollbacks") == 1]
+
+    def test_abort_after_rollback_budget_preserves_checkpoint(
+        self, tmp_path, capsys
+    ):
+        """Persistent badness: rollbacks replay into the same poison, the
+        budget exhausts, the run raises TrainingDivergedError, and the
+        finite-check rescue save leaves the previous good rescue
+        checkpoint byte-identical."""
+        clean = tiny_cfg(tmp_path, max_iters=6, eval_interval=5)
+        train(clean)  # writes a good last-checkpoint to protect
+        good = open(
+            os.path.join(clean.last_checkpoint_path, "state.msgpack"), "rb"
+        ).read()
+
+        faults.reset()
+        cfg = tiny_cfg(
+            tmp_path, faults="nan@0-999", anomaly_max_rollbacks=1,
+            metrics_path=str(tmp_path / "m2.jsonl"),
+        )
+        with pytest.raises(TrainingDivergedError, match="did not recover"):
+            train(cfg)
+        out = capsys.readouterr().out
+        assert "skipping last-checkpoint rescue save" in out
+        now = open(
+            os.path.join(cfg.last_checkpoint_path, "state.msgpack"), "rb"
+        ).read()
+        assert now == good
+
+    def test_injected_sigterm_takes_graceful_stop_path(self, tmp_path, capsys):
+        """sigterm@K rides the real signal handler: the run stops early,
+        writes the rescue checkpoint, and a resume completes it."""
+        cfg = tiny_cfg(tmp_path, faults="sigterm@7")
+        state = train(cfg)
+        stopped = int(state["step"])
+        assert stopped < 20
+        assert "SIGTERM received" in capsys.readouterr().out
+        assert os.path.isfile(
+            os.path.join(cfg.last_checkpoint_path, "state.msgpack")
+        )
+        faults.reset()
+        cfg2 = tiny_cfg(tmp_path, resume_from=cfg.last_checkpoint_path)
+        assert int(train(cfg2)["step"]) == 20
+
+    def test_injected_crash_skips_nothing_good(self, tmp_path):
+        """raise@K: the crash escapes train() (the supervisor's restart
+        trigger) AFTER the rescue save ran, so the crash point is
+        resumable."""
+        cfg = tiny_cfg(tmp_path, faults="raise@9")
+        with pytest.raises(faults.FaultInjected, match="iteration 9"):
+            train(cfg)
+        target = create_train_state(jax.random.PRNGKey(0), cfg)
+        restored, _ = load_checkpoint(cfg.last_checkpoint_path, cfg, target)
+        assert int(restored["step"]) == 9
+
+
+class TestCheckpointCorruption:
+    def _good_ckpt(self, tmp_path):
+        cfg = step_cfg()
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        path = str(tmp_path / "ckpt")
+        save_checkpoint(path, state, 1.0, cfg)
+        return cfg, state, path
+
+    def test_truncated_state_raises_one_clear_error(self, tmp_path):
+        cfg, state, path = self._good_ckpt(tmp_path)
+        sp = os.path.join(path, "state.msgpack")
+        data = open(sp, "rb").read()
+        open(sp, "wb").write(data[: len(data) // 3])
+        target = create_train_state(jax.random.PRNGKey(1), cfg)
+        with pytest.raises(CheckpointError, match="state.msgpack"):
+            load_checkpoint(path, cfg, target)
+
+    def test_garbage_meta_raises_one_clear_error(self, tmp_path):
+        cfg, state, path = self._good_ckpt(tmp_path)
+        open(os.path.join(path, "meta.json"), "w").write("{not json")
+        target = create_train_state(jax.random.PRNGKey(1), cfg)
+        with pytest.raises(CheckpointError, match="meta.json"):
+            load_checkpoint(path, cfg, target)
+
+    def test_load_params_for_inference_corrupt_meta(self, tmp_path):
+        from differential_transformer_replication_tpu.train.checkpoint import (
+            load_params_for_inference,
+        )
+
+        cfg, state, path = self._good_ckpt(tmp_path)
+        open(os.path.join(path, "meta.json"), "w").write('{"config": {}}')
+        with pytest.raises(CheckpointError, match="meta.json"):
+            load_params_for_inference(path)
+        open(os.path.join(path, "meta.json"), "w").write("\x00\x01garbage")
+        with pytest.raises(CheckpointError, match="meta.json"):
+            load_params_for_inference(path)
+
+    def test_missing_meta_raises_clear_error(self, tmp_path):
+        cfg, state, path = self._good_ckpt(tmp_path)
+        os.unlink(os.path.join(path, "meta.json"))
+        target = create_train_state(jax.random.PRNGKey(1), cfg)
+        with pytest.raises(CheckpointError, match="meta.json"):
+            load_checkpoint(path, cfg, target)
+
+    def test_failed_write_leaves_previous_checkpoint_intact(self, tmp_path):
+        """_atomic_write's whole point, failure-injected at the worst
+        moment (temp written, rename pending): the previous checkpoint
+        survives byte-for-byte and no temp litter remains."""
+        cfg, state, path = self._good_ckpt(tmp_path)
+        before = {
+            f: open(os.path.join(path, f), "rb").read()
+            for f in ("state.msgpack", "meta.json")
+        }
+        faults.arm("ckpt_write")
+        with pytest.raises(faults.FaultInjected):
+            save_checkpoint(path, state, 2.0, cfg)
+        for f, data in before.items():
+            assert open(os.path.join(path, f), "rb").read() == data
+        assert not [f for f in os.listdir(path) if f.endswith(".tmp")]
+        # and the next (un-injected) save succeeds
+        save_checkpoint(path, state, 2.0, cfg)
+        meta = json.load(open(os.path.join(path, "meta.json")))
+        assert meta["best_val_loss"] == 2.0
+
+
+def _load_supervisor_module():
+    spec = importlib.util.spec_from_file_location("train_supervisor", SUPERVISOR)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestSupervisorUnit:
+    def test_classify_exit(self):
+        sup = _load_supervisor_module()
+        assert sup.classify_exit(0) == "clean"
+        assert sup.classify_exit(-signal.SIGTERM) == "sigterm"
+        assert sup.classify_exit(143) == "sigterm"
+        assert sup.classify_exit(-signal.SIGKILL) == "sigkill"
+        assert sup.classify_exit(1) == "crash"
+        assert sup.classify_exit(-11) == "crash"  # segfault
+
+    def test_with_resume_replaces_existing_flag(self):
+        sup = _load_supervisor_module()
+        cmd = ["python", "train.py", "--resume-from", "old", "--seed", "1"]
+        out = sup.with_resume(cmd, "new")
+        assert out == ["python", "train.py", "--seed", "1",
+                       "--resume-from", "new"]
+        out2 = sup.with_resume(["t", "--resume-from=old"], "new")
+        assert out2 == ["t", "--resume-from", "new"]
+
+    def test_strip_flag_both_forms(self):
+        """--faults must not survive into relaunches (it would re-fire
+        the same kill every restart); both argv forms are stripped."""
+        sup = _load_supervisor_module()
+        assert sup._strip_flag(
+            ["t", "--faults", "sigkill@9", "--seed", "1"], "--faults"
+        ) == ["t", "--seed", "1"]
+        assert sup._strip_flag(["t", "--faults=raise@2"], "--faults") == ["t"]
+
+    def test_backoff_is_exponential_and_capped(self):
+        sup = _load_supervisor_module()
+        assert sup.backoff_s(0, 2.0, 120.0) == 2.0
+        assert sup.backoff_s(3, 2.0, 120.0) == 16.0
+        assert sup.backoff_s(10, 2.0, 120.0) == 120.0
+
+
+def _run_supervisor(tmp_path, child_args, *sup_args, timeout=60):
+    log = tmp_path / "restarts.json"
+    proc = subprocess.run(
+        [sys.executable, SUPERVISOR, "--backoff-base", "0.01",
+         "--restart-log", str(log), *sup_args, "--", *child_args],
+        capture_output=True, text=True, timeout=timeout,
+    )
+    records = (
+        [json.loads(l) for l in open(log)] if log.exists() else []
+    )
+    return proc, records
+
+
+class TestSupervisorProcess:
+    """Supervisor behavior against cheap non-jax children (quick)."""
+
+    def test_restarts_until_clean_exit(self, tmp_path):
+        """Child crashes twice, then succeeds: three launches, rc 0,
+        outcomes logged in order."""
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            "import os, sys\n"
+            f"p = {str(tmp_path / 'count')!r}\n"
+            "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+            "open(p, 'w').write(str(n + 1))\n"
+            "sys.exit(0 if n >= 2 else 3)\n"
+        )
+        proc, records = _run_supervisor(
+            tmp_path, [sys.executable, str(script)], "--max-restarts", "5"
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert [r["outcome"] for r in records] == ["crash", "crash", "clean"]
+        assert [r["attempt"] for r in records] == [0, 1, 2]
+
+    def test_restart_budget_exhausts(self, tmp_path):
+        proc, records = _run_supervisor(
+            tmp_path, [sys.executable, "-c", "import sys; sys.exit(3)"],
+            "--max-restarts", "1",
+        )
+        assert proc.returncode == 3
+        assert "budget exhausted" in proc.stderr
+        assert [r["outcome"] for r in records] == ["crash", "crash"]
+
+    def test_resume_flag_injected_when_checkpoint_exists(self, tmp_path):
+        """On restart the child is relaunched with --resume-from pointing
+        at the rescue checkpoint (only once it exists on disk)."""
+        ckpt = tmp_path / "last.ckpt"
+        ckpt.mkdir()
+        (ckpt / "state.msgpack").write_bytes(b"x")
+        script = tmp_path / "argv_logger.py"
+        script.write_text(
+            "import os, sys\n"
+            f"log = {str(tmp_path / 'argvs')!r}\n"
+            "open(log, 'a').write(' '.join(sys.argv[1:]) + '\\n')\n"
+            f"p = {str(tmp_path / 'count')!r}\n"
+            "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+            "open(p, 'w').write(str(n + 1))\n"
+            "sys.exit(0 if n >= 1 else 3)\n"
+        )
+        proc, records = _run_supervisor(
+            tmp_path,
+            [sys.executable, str(script), "--seed", "1",
+             "--faults", "sigkill@9"],
+            "--max-restarts", "2", "--resume-ckpt", str(ckpt),
+        )
+        assert proc.returncode == 0, proc.stderr
+        argvs = open(tmp_path / "argvs").read().splitlines()
+        assert "--resume-from" not in argvs[0]  # first launch verbatim
+        assert "--faults sigkill@9" in argvs[0]
+        assert f"--resume-from {ckpt}" in argvs[1]
+        # CLI fault specs are first-launch-only, like the env channel
+        assert "--faults" not in argvs[1]
+        assert records[1]["resumed_from"] == str(ckpt)
+
+    def test_sigterm_to_supervisor_forwards_and_stops(self, tmp_path):
+        """Preemption semantics: SIGTERM to the supervisor reaches the
+        child and ends the loop with no restart."""
+        log = tmp_path / "restarts.json"
+        proc = subprocess.Popen(
+            [sys.executable, SUPERVISOR, "--backoff-base", "0.01",
+             "--restart-log", str(log), "--max-restarts", "3", "--",
+             sys.executable, "-c", "import time; time.sleep(60)"],
+            stderr=subprocess.PIPE, text=True,
+        )
+        time.sleep(1.0)  # let the child start
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        assert rc == 128 + signal.SIGTERM
+        records = [json.loads(l) for l in open(log)]
+        assert len(records) == 1
+        assert records[0]["outcome"] == "sigterm"
+
+
+def _train_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop(faults.ENV_VAR, None)
+    return env
+
+
+def _train_cmd(tmp_path, *extra):
+    return [
+        sys.executable, TRAIN_PY, "--model", "diff",
+        "--dataset", "synthetic", "--num-train-samples", "200",
+        "--vocab-size", "256", "--n-embd", "32", "--n-head", "2",
+        "--n-layer", "2", "--block-size", "16",
+        "--compute-dtype", "float32", "--micro-batch-size", "4",
+        "--max-iters", "24", "--eval-interval", "8", "--eval-iters", "2",
+        "--learning-rate", "3e-3", "--warmup-iters", "5", "--seed", "7",
+        *extra,
+    ]
+
+
+def _run_chaos(tmp_path, name, *extra, supervised=False, fault=None,
+               resume_ckpt=None):
+    """One train.py run (optionally under the supervisor) in its own
+    checkpoint/metrics namespace but the SHARED tokenizer cache. Faults
+    ride the DTX_FAULTS env var — the supervisor strips it from the
+    child env on restarts, so an injected kill fires exactly once even
+    when the resumed run replays the same iteration."""
+    d = tmp_path / name
+    d.mkdir()
+    env = _train_env()
+    cmd = _train_cmd(
+        tmp_path,
+        "--tokenizer-dir", str(tmp_path / "tokenizer"),
+        "--checkpoint-path", str(d / "best.ckpt"),
+        "--last-checkpoint-path", str(d / "last.ckpt"),
+        "--metrics-path", str(d / "metrics.jsonl"),
+        *extra,
+    )
+    if fault:
+        env[faults.ENV_VAR] = fault
+    if supervised:
+        cmd = [
+            sys.executable, SUPERVISOR, "--backoff-base", "0.05",
+            "--max-restarts", "3", "--restart-log", str(d / "restarts.json"),
+            "--resume-ckpt", str(resume_ckpt or (d / "last.ckpt")), "--",
+        ] + cmd
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=600, env=env
+    )
+    return d, proc
+
+
+def _final_eval(metrics_path):
+    evals = [
+        json.loads(l) for l in open(metrics_path) if "val_loss" in l
+    ]
+    return evals[-1]
+
+
+@pytest.mark.slow
+def test_sigkill_resume_under_supervisor_matches_uninterrupted(tmp_path):
+    """THE chaos acceptance test: a run SIGKILLed mid-flight (no rescue
+    save possible) and relaunched by the supervisor from the last
+    on-disk checkpoint reaches the same final step with the SAME final
+    val loss as an uninterrupted run — the epoch-sampler fast-forward
+    and the sequential val batches make the comparison exact — and the
+    final rescue checkpoints are byte-identical."""
+    a, proc_a = _run_chaos(tmp_path, "uninterrupted")
+    assert proc_a.returncode == 0, proc_a.stderr[-2000:]
+
+    b, proc_b = _run_chaos(
+        tmp_path, "killed", supervised=True, fault="sigkill@18",
+        resume_ckpt=tmp_path / "killed" / "best.ckpt",
+    )
+    assert proc_b.returncode == 0, proc_b.stderr[-2000:]
+    records = [json.loads(l) for l in open(b / "restarts.json")]
+    assert [r["outcome"] for r in records] == ["sigkill", "clean"]
+    assert records[1]["resumed_from"] == str(b / "best.ckpt")
+
+    ea, eb = _final_eval(a / "metrics.jsonl"), _final_eval(b / "metrics.jsonl")
+    assert ea["iter"] == eb["iter"] == 24
+    assert ea["val_loss"] == pytest.approx(eb["val_loss"], abs=1e-9)
+    # the resumed run's final state is bit-identical to the clean run's
+    sa = open(a / "last.ckpt" / "state.msgpack", "rb").read()
+    sb = open(b / "last.ckpt" / "state.msgpack", "rb").read()
+    assert sa == sb
+
+
+@pytest.mark.slow
+def test_crash_resume_rides_rescue_checkpoint(tmp_path):
+    """A catchable crash (raise@K) writes the rescue checkpoint on the
+    way down; the supervisor resumes from it and the finished run
+    matches the uninterrupted one bit-for-bit."""
+    a, proc_a = _run_chaos(tmp_path, "clean_run")
+    assert proc_a.returncode == 0, proc_a.stderr[-2000:]
+
+    b, proc_b = _run_chaos(
+        tmp_path, "crashed", supervised=True, fault="raise@13",
+    )
+    assert proc_b.returncode == 0, proc_b.stderr[-2000:]
+    records = [json.loads(l) for l in open(b / "restarts.json")]
+    assert [r["outcome"] for r in records] == ["crash", "clean"]
+    # resumed from the rescue checkpoint at exactly the crash iteration
+    assert records[1]["resumed_from"] == str(b / "last.ckpt")
+    assert "Resumed from" in proc_b.stdout
+    sa = open(a / "last.ckpt" / "state.msgpack", "rb").read()
+    sb = open(b / "last.ckpt" / "state.msgpack", "rb").read()
+    assert sa == sb

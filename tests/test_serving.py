@@ -14,6 +14,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 import urllib.request
 from functools import lru_cache
 
@@ -31,6 +32,7 @@ from differential_transformer_replication_tpu.models import (
     init_model,
 )
 from differential_transformer_replication_tpu.serving import (
+    QueueFullError,
     SamplingParams,
     Scheduler,
     ServingClient,
@@ -303,6 +305,247 @@ class TestScheduler:
         s.plan()
         assert slot.request.request_id == 1
         assert s.max_concurrent == 1
+
+    def test_queue_bound_rejects_fast(self):
+        """max_queue_len: the (max+1)-th WAITING request is rejected
+        immediately — overload degrades into fast retryable errors, not
+        an unbounded queue."""
+        s = self._sched(num_slots=1, max_queue_len=2)
+        self._submit(s, [4, 4])
+        with pytest.raises(QueueFullError, match="admission queue full"):
+            self._submit(s, [4])
+        # draining the queue re-opens admission
+        s.plan()  # admits request 0 into the slot; queue drops to 1
+        self._submit(s, [4])
+        assert s.queue_len() == 2
+
+    def test_cancel_queued_and_slotted(self):
+        s = self._sched(num_slots=1, prefill_chunk=8, prefill_budget=8)
+        self._submit(s, [4, 4])
+        s.plan()  # req 0 -> slot, req 1 queued
+        assert s.cancel(1) is True  # dropped from the queue
+        assert s.queue_len() == 0
+        assert s.cancel(0) is True  # slot retired back to the pool
+        assert s.slots[0].state == FREE
+        assert s.cancel(99) is False  # unknown
+
+    def test_unbounded_by_default(self):
+        s = self._sched(num_slots=1)
+        self._submit(s, [4] * 50)
+        assert s.queue_len() == 50
+
+
+class _StubEngine:
+    """Never-finishing engine: requests pile up in a fake queue so the
+    runner-level admission bound and cancel plumbing are testable
+    without device work."""
+
+    def __init__(self, max_queue_len):
+        self.serving = ServingConfig(num_slots=1, max_queue_len=max_queue_len)
+        self.queue = []
+        self.stats = {"rejected": 0, "cancelled": 0}
+        self._next = 0
+
+    def queue_len(self):
+        return len(self.queue)
+
+    def has_work(self):
+        return bool(self.queue)
+
+    def submit(self, prompt, params=None):
+        if (
+            self.serving.max_queue_len
+            and len(self.queue) >= self.serving.max_queue_len
+        ):
+            self.stats["rejected"] += 1
+            raise QueueFullError("admission queue full")
+        rid = self._next
+        self._next += 1
+        self.queue.append(rid)
+        return rid
+
+    def cancel(self, rid):
+        if rid in self.queue:
+            self.queue.remove(rid)
+            self.stats["cancelled"] += 1
+            return True
+        return False
+
+    def step(self):
+        import time as _t
+
+        _t.sleep(0.005)  # never finishes anything; don't spin hot
+        return []
+
+
+class TestRunnerOverloadAndCancel:
+    def test_runner_rejects_when_queue_full(self):
+        from differential_transformer_replication_tpu.serving.server import (
+            EngineRunner,
+        )
+
+        runner = EngineRunner(_StubEngine(max_queue_len=2))
+        try:
+            handles = [runner.submit([1], max_new_tokens=4) for _ in range(2)]
+            # give the runner time to move them into the engine queue
+            deadline = time.time() + 5
+            while runner.engine.queue_len() < 2 and time.time() < deadline:
+                time.sleep(0.01)
+            with pytest.raises(QueueFullError):
+                runner.submit([1], max_new_tokens=4)
+            assert runner.engine.stats["rejected"] >= 1
+            # cancelling a queued request reopens admission
+            runner.cancel(handles[0])
+            deadline = time.time() + 5
+            while runner.engine.queue_len() > 1 and time.time() < deadline:
+                time.sleep(0.01)
+            runner.submit([1], max_new_tokens=4)
+        finally:
+            runner.engine.queue.clear()  # let close() drain
+            runner.close()
+
+    def test_timeout_cancels_before_engine_admission(self):
+        """A request cancelled while still in the hand-off deque never
+        reaches the engine at all."""
+        from differential_transformer_replication_tpu.serving.server import (
+            EngineRunner,
+        )
+
+        eng = _StubEngine(max_queue_len=0)
+        runner = EngineRunner(eng)
+        try:
+            blocker = runner.submit([1], max_new_tokens=4)
+            with pytest.raises(TimeoutError):
+                runner.generate([2], max_new_tokens=4, timeout=0.01)
+            # steady state either way: the timed-out request never hit
+            # the engine (dropped from the hand-off deque) or was
+            # cancelled out of its queue — only the blocker remains
+            deadline = time.time() + 10
+            while time.time() < deadline and eng.queue != [0]:
+                time.sleep(0.01)
+            assert eng.queue == [0] and blocker.rid == 0
+        finally:
+            eng.queue.clear()
+            runner.close()
+
+
+def test_engine_cancel_reclaims_slot_mid_decode():
+    """The slot-leak fix at the engine level: cancelling an ACTIVE
+    request frees its KV slot for the next admission instead of decoding
+    to completion for nobody."""
+    cfg, params = _setup("control")
+    eng = ServingEngine(
+        params, cfg, ServingConfig(num_slots=1, prefill_chunk=8,
+                                   prefill_budget=8),
+    )
+    a = eng.submit(_prompts([5], cfg.vocab_size, seed=9)[0],
+                   max_new_tokens=24, temperature=0.0)
+    b = eng.submit(_prompts([4], cfg.vocab_size, seed=10)[0],
+                   max_new_tokens=4, temperature=0.0)
+    for _ in range(3):  # a occupies the only slot and starts decoding
+        eng.step()
+    assert eng.scheduler.slots[0].request.request_id == a
+    assert eng.cancel(a) is True
+    assert eng.scheduler.slots[0].state == FREE
+    outs = eng.run()  # b admits into the freed slot and completes
+    assert [o.request_id for o in outs] == [b]
+    assert len(outs[0].tokens) == 4
+    assert eng.stats["cancelled"] == 1
+    assert eng.cancel(b) is False  # already finished
+    # the interrupted slot leaves no residue: a fresh request matches
+    # the reference decode bit-for-bit (ring-mask invariant)
+    p = _prompts([6], cfg.vocab_size, seed=11)[0]
+    out = eng.generate([p], max_new_tokens=6, temperature=0.0)[0]
+    assert out.tokens == _ref_greedy(params, cfg, p, 6)
+
+
+def test_client_timeout_cancels_and_slot_is_reused():
+    """End-to-end slot-leak regression: a client timeout cancels the
+    request in the engine (KV slot + queue entry reclaimed) and later
+    requests still complete on the single slot."""
+    cfg, params = _setup("control")
+    client = ServingClient(ServingEngine(
+        params, cfg, ServingConfig(num_slots=1, prefill_chunk=8,
+                                   prefill_budget=8),
+    ))
+    try:
+        with pytest.raises(TimeoutError):
+            # tiny timeout: compilation alone exceeds it
+            client.generate(_prompts([5], cfg.vocab_size, seed=12)[0],
+                            max_new_tokens=24, timeout=0.01)
+        p = _prompts([4], cfg.vocab_size, seed=13)[0]
+        out = client.generate(p, max_new_tokens=4, temperature=0.0,
+                              timeout=120)
+        assert out.tokens == _ref_greedy(params, cfg, p, 4)
+        deadline = time.time() + 30
+        while time.time() < deadline and client.runner.engine.has_work():
+            time.sleep(0.02)
+        stats = client.stats
+        assert stats["cancelled"] == 1
+        assert not client.runner.engine.has_work()  # nothing decodes for nobody
+    finally:
+        client.close()
+
+
+@pytest.mark.slow
+def test_http_503_when_admission_queue_full():
+    """Overload over HTTP: with a 1-slot pool and max_queue_len=1, a
+    burst of 3 concurrent /generate calls gets at least one 503 and the
+    accepted requests still complete; the server keeps serving after."""
+    cfg, params = _setup("control")
+    client = ServingClient(ServingEngine(
+        params, cfg,
+        ServingConfig(num_slots=1, prefill_chunk=8, prefill_budget=8,
+                      max_queue_len=1),
+    ))
+    httpd = serve(client, port=0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        codes = []
+        lock = threading.Lock()
+        # a true simultaneous burst: all three requests hit /generate
+        # within ~a millisecond, far faster than one 24-token decode can
+        # finish, so the 1-slot + 1-queue server MUST shed at least one
+        barrier = threading.Barrier(3)
+
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({
+                    "prompt_ids": _prompts([5], cfg.vocab_size, seed=14)[0],
+                    "max_new_tokens": 24, "temperature": 0.0,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            barrier.wait(timeout=30)
+            try:
+                with urllib.request.urlopen(req, timeout=300) as r:
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            with lock:
+                codes.append(code)
+
+        threads = [threading.Thread(target=post) for _ in range(3)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        assert codes.count(200) >= 1, codes
+        assert codes.count(503) >= 1, codes
+        # the server is still healthy after shedding load
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health", timeout=30
+        ) as r:
+            health = json.load(r)
+        assert health["ok"]
+        assert health["stats"]["rejected"] >= 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        client.close()
 
 
 @pytest.mark.slow

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Crash supervisor: keep a training run alive across crashes.
+
+The trainer recovers from bad BATCHES in-process (train/anomaly.py) and
+writes a resumable rescue checkpoint on catchable exits (trainer.py),
+but a hard crash — SIGKILL preemption, OOM kill, a segfaulting runtime —
+needs an outside process to relaunch it. This wrapper is that process:
+
+  python tools/train_supervisor.py --resume-ckpt runs/exp.last.ckpt \
+      --max-restarts 5 --restart-log runs/restarts.json -- \
+      python train.py --checkpoint-path runs/exp.ckpt ...
+
+Behavior:
+  - Runs the child command verbatim first. On an ABNORMAL exit it
+    relaunches with ``--resume-from <resume-ckpt>`` injected (replacing
+    any existing ``--resume-from``) when that checkpoint exists on disk,
+    after an exponential backoff (``backoff_base * 2^restart``, capped),
+    up to ``--max-restarts`` relaunches.
+  - Exit classification: rc 0 is a CLEAN exit (done — this includes the
+    trainer's SIGTERM graceful stop, which exits 0 after its rescue
+    save); death BY SIGTERM without the graceful handler is a
+    preemption — the supervisor stops by default (the scheduler is
+    taking the host; ``--restart-on-sigterm`` opts into relaunching);
+    anything else is a CRASH and is restarted.
+  - SIGTERM/SIGINT to the supervisor are forwarded to the child and end
+    the loop after the child exits (no restart).
+  - Every launch appends one JSON record to ``--restart-log``
+    (JSON-lines: time, attempt, argv, rc, outcome, duration, what it
+    resumed from), the audit trail for flaky-host forensics.
+  - Fault-injection specs (utils/faults.py) in the child's DTX_FAULTS
+    env are stripped on restarts unless ``--keep-faults``: the harness
+    injects a fault ONCE to test this very supervisor; replaying it on
+    the resumed run would kill every relaunch at the same step.
+
+No jax import here — the supervisor must stay alive when the runtime it
+babysits is the thing crashing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+FAULTS_ENV = "DTX_FAULTS"
+
+
+def classify_exit(rc: int) -> str:
+    """clean / sigterm / sigkill / crash from a subprocess returncode
+    (negative rc = death by that signal; 128+N covers shells that
+    re-report signal deaths as exit codes)."""
+    if rc == 0:
+        return "clean"
+    sig = -rc if rc < 0 else (rc - 128 if 128 < rc < 160 else None)
+    if sig == signal.SIGTERM:
+        return "sigterm"
+    if sig == signal.SIGKILL:
+        return "sigkill"
+    return "crash"
+
+
+def _strip_flag(cmd: List[str], flag: str) -> List[str]:
+    """Drop ``flag X`` / ``flag=X`` occurrences from an argv list."""
+    out = []
+    skip = False
+    for a in cmd:
+        if skip:
+            skip = False
+            continue
+        if a == flag:
+            skip = True
+            continue
+        if a.startswith(flag + "="):
+            continue
+        out.append(a)
+    return out
+
+
+def with_resume(cmd: List[str], ckpt: str) -> List[str]:
+    """Inject ``--resume-from <ckpt>``, replacing an existing flag (both
+    ``--resume-from X`` and ``--resume-from=X`` forms)."""
+    return _strip_flag(cmd, "--resume-from") + ["--resume-from", ckpt]
+
+
+def backoff_s(restart: int, base: float, cap: float) -> float:
+    return min(base * (2 ** restart), cap)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    p.add_argument("--resume-ckpt", default=None,
+                   help="checkpoint dir to resume from on restarts (point "
+                        "it at the run's last/rescue checkpoint); only "
+                        "injected when <dir>/state.msgpack exists")
+    p.add_argument("--max-restarts", type=int, default=5,
+                   help="restart budget; exhausted -> exit with the "
+                        "child's last returncode")
+    p.add_argument("--backoff-base", type=float, default=2.0,
+                   help="first-restart backoff seconds (doubles per "
+                        "restart)")
+    p.add_argument("--backoff-max", type=float, default=120.0,
+                   help="backoff cap in seconds")
+    p.add_argument("--restart-log", default=None,
+                   help="append one JSON record per launch to this file")
+    p.add_argument("--restart-on-sigterm", action="store_true",
+                   help="also restart after a SIGTERM death (default: a "
+                        "preemption means stop)")
+    p.add_argument("--keep-faults", action="store_true",
+                   help="keep DTX_FAULTS in the child env on restarts "
+                        "(default: first launch only)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="-- then the training command to supervise")
+    return p
+
+
+def _log(path: Optional[str], record: dict) -> None:
+    if not path:
+        return
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def supervise(args: argparse.Namespace) -> int:
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("train_supervisor: no command given (put it after --)",
+              file=sys.stderr)
+        return 2
+
+    child: dict = {"proc": None}
+    got_signal: dict = {"sig": None}
+
+    def forward(signum, frame):
+        del frame
+        got_signal["sig"] = signum
+        proc = child["proc"]
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signum)
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(s, forward)
+
+    restarts = 0
+    rc = 1
+    while True:
+        launch_cmd = cmd
+        resumed_from = None
+        env = None  # inherit
+        if restarts > 0:
+            ckpt = args.resume_ckpt
+            if ckpt and os.path.isfile(os.path.join(ckpt, "state.msgpack")):
+                launch_cmd = with_resume(cmd, ckpt)
+                resumed_from = ckpt
+            if not args.keep_faults:
+                # faults are first-launch-only through BOTH channels —
+                # a --faults flag left in argv would re-fire the same
+                # kill on every relaunch, exhausting the budget on the
+                # exact replay hazard the env-strip exists to prevent
+                launch_cmd = _strip_flag(launch_cmd, "--faults")
+                if FAULTS_ENV in os.environ:
+                    env = dict(os.environ)
+                    del env[FAULTS_ENV]
+        t0 = time.time()
+        child["proc"] = subprocess.Popen(launch_cmd, env=env)
+        rc = child["proc"].wait()
+        child["proc"] = None
+        outcome = classify_exit(rc)
+        _log(args.restart_log, {
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "attempt": restarts,
+            "argv": launch_cmd,
+            "rc": rc,
+            "outcome": outcome,
+            "duration_s": round(time.time() - t0, 3),
+            "resumed_from": resumed_from,
+        })
+        if outcome == "clean":
+            return 0
+        if got_signal["sig"] is not None:
+            print(f"train_supervisor: stopping (received signal "
+                  f"{got_signal['sig']}; child exited {rc})", file=sys.stderr)
+            return 128 + got_signal["sig"]
+        if outcome == "sigterm" and not args.restart_on_sigterm:
+            print("train_supervisor: child died by SIGTERM (preemption); "
+                  "not restarting (use --restart-on-sigterm to override)",
+                  file=sys.stderr)
+            return 128 + signal.SIGTERM
+        if restarts >= args.max_restarts:
+            print(f"train_supervisor: restart budget exhausted "
+                  f"({args.max_restarts}); last outcome {outcome} (rc {rc})",
+                  file=sys.stderr)
+            return rc if rc > 0 else 128 + (-rc)
+        delay = backoff_s(restarts, args.backoff_base, args.backoff_max)
+        print(f"train_supervisor: child {outcome} (rc {rc}); restart "
+              f"{restarts + 1}/{args.max_restarts} in {delay:.1f}s",
+              file=sys.stderr)
+        # interruptible backoff: a SIGTERM/SIGINT arriving here (child
+        # gone, nothing to forward to) must stop the supervisor, not be
+        # swallowed by a PEP 475-resumed sleep and followed by a fresh
+        # hours-long run the operator never gets to signal again
+        end = time.time() + delay
+        while time.time() < end and got_signal["sig"] is None:
+            time.sleep(min(0.1, max(0.0, end - time.time())))
+        if got_signal["sig"] is not None:
+            print(f"train_supervisor: stopping (received signal "
+                  f"{got_signal['sig']} during backoff)", file=sys.stderr)
+            return 128 + got_signal["sig"]
+        restarts += 1
+
+
+def main() -> None:
+    sys.exit(supervise(build_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

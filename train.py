@@ -49,6 +49,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dataset", default=t.dataset,
                    help="tinystories | synthetic | path to a text file")
     p.add_argument("--num-train-samples", type=int, default=t.num_train_samples)
+    p.add_argument("--tokenizer-dir", default=t.tokenizer_dir,
+                   help="tokenizer artifacts + token-stream cache dir")
     p.add_argument("--vocab-size", type=int, default=t.vocab_size)
     p.add_argument("--micro-batch-size", type=int, default=t.micro_batch_size)
     p.add_argument("--grad-acc-steps", type=int, default=t.grad_acc_steps)
@@ -72,6 +74,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "write-every-improvement; the best state is still "
                         "snapshotted on-device each improvement and "
                         "flushed at exit)")
+    p.add_argument("--anomaly-guard", action=argparse.BooleanOptionalAction,
+                   default=t.anomaly_guard,
+                   help="in-loop anomaly guard: skip non-finite/spiking "
+                        "updates under lax.cond, roll back to an in-HBM "
+                        "snapshot on persistent badness, abort cleanly "
+                        "when rollbacks stop helping (train/anomaly.py)")
+    p.add_argument("--anomaly-spike-factor", type=float,
+                   default=t.anomaly_spike_factor,
+                   help="skip when grad norm exceeds this multiple of the "
+                        "good-step EMA")
+    p.add_argument("--anomaly-warmup-steps", type=int,
+                   default=t.anomaly_warmup_steps,
+                   help="good steps before spike detection arms (the "
+                        "non-finite check is always on)")
+    p.add_argument("--anomaly-rollback-after", type=int,
+                   default=t.anomaly_rollback_after,
+                   help="consecutive bad steps before rolling back to the "
+                        "good-state snapshot")
+    p.add_argument("--anomaly-max-rollbacks", type=int,
+                   default=t.anomaly_max_rollbacks,
+                   help="rollbacks before the run aborts")
+    p.add_argument("--anomaly-snapshot-interval", type=int,
+                   default=t.anomaly_snapshot_interval,
+                   help="iterations between good-state snapshots (pins one "
+                        "extra train state in HBM)")
+    p.add_argument("--anomaly-check-interval", type=int,
+                   default=t.anomaly_check_interval,
+                   help="iterations between host polls of the guard streak "
+                        "(each poll syncs on the step result)")
+    p.add_argument("--faults", default=None,
+                   help="fault-injection spec for chaos testing, e.g. "
+                        "'sigkill@120,nan@50-52' (utils/faults.py; also "
+                        "via the DTX_FAULTS env var)")
     p.add_argument("--metrics-path", default=t.metrics_path)
     p.add_argument("--wandb", action="store_true", help="enable the wandb sink")
     p.add_argument(
@@ -118,6 +153,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                         sequence=args.sequence_parallel),
         dataset=args.dataset,
         num_train_samples=args.num_train_samples,
+        tokenizer_dir=args.tokenizer_dir,
         vocab_size=args.vocab_size,
         micro_batch_size=args.micro_batch_size,
         grad_acc_steps=args.grad_acc_steps,
@@ -133,6 +169,14 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         last_checkpoint_path=args.last_checkpoint_path or None,
         resume_from=args.resume_from,
         checkpoint_min_interval_s=args.checkpoint_min_interval_s,
+        anomaly_guard=args.anomaly_guard,
+        anomaly_spike_factor=args.anomaly_spike_factor,
+        anomaly_warmup_steps=args.anomaly_warmup_steps,
+        anomaly_rollback_after=args.anomaly_rollback_after,
+        anomaly_max_rollbacks=args.anomaly_max_rollbacks,
+        anomaly_snapshot_interval=args.anomaly_snapshot_interval,
+        anomaly_check_interval=args.anomaly_check_interval,
+        faults=args.faults,
         metrics_path=args.metrics_path,
         use_wandb=args.wandb,
         profile_dir=args.profile_dir,
