@@ -150,6 +150,30 @@ class ServingConfig:
     # the caller's latency — without limit. 0 = unbounded (the
     # pre-bound behavior).
     max_queue_len: int = 0
+    # Default server-side deadline (seconds from submission) applied to
+    # requests that do not carry their own. Expired requests are shed at
+    # admission and retired mid-decode (KV slot reclaimed) with a typed
+    # DeadlineExceededError instead of decoding for a caller that has
+    # already given up. 0 = no default deadline.
+    default_deadline_s: float = 0.0
+    # Graceful-drain budget: drain() stops admission (HTTP 503 +
+    # Retry-After), then waits this long for in-flight requests to
+    # finish before force-failing the stragglers and shutting down.
+    drain_timeout_s: float = 30.0
+    # Engine supervision (serving/server.py:EngineRunner): a crashed
+    # engine step fails its in-flight requests with EngineCrashError,
+    # rebuilds the slot pool from params, and resumes — up to this many
+    # restarts per runner lifetime, each preceded by an exponential
+    # backoff (restart_backoff_s * 2^n, capped at
+    # restart_backoff_max_s). Budget exhausted = the runner fails hard.
+    max_restarts: int = 3
+    restart_backoff_s: float = 0.5
+    restart_backoff_max_s: float = 30.0
+    # Watchdog: a decode iteration exceeding this wall-time budget marks
+    # the engine "degraded" on /health (it cannot be interrupted — the
+    # device call is synchronous — but operators/load-balancers can
+    # route around it). 0 = watchdog off.
+    step_time_budget_s: float = 0.0
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -157,6 +181,17 @@ class ServingConfig:
         if self.max_queue_len < 0:
             raise ValueError(
                 f"max_queue_len must be >= 0, got {self.max_queue_len}"
+            )
+        for name in ("default_deadline_s", "drain_timeout_s",
+                     "restart_backoff_s", "restart_backoff_max_s",
+                     "step_time_budget_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0, got {getattr(self, name)}"
+                )
+        if self.max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {self.max_restarts}"
             )
         if self.prefill_chunk < 1 or (
             self.prefill_chunk & (self.prefill_chunk - 1)

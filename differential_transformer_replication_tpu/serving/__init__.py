@@ -1,6 +1,7 @@
 """Continuous-batching inference serving (see serving/engine.py)."""
 
 from differential_transformer_replication_tpu.serving.engine import (
+    EngineCrashError,
     ServingEngine,
 )
 from differential_transformer_replication_tpu.serving.request import (
@@ -8,24 +9,37 @@ from differential_transformer_replication_tpu.serving.request import (
     RequestOutput,
     SamplingParams,
 )
+from differential_transformer_replication_tpu.serving.retry import (
+    backoff_delay,
+    call_with_retries,
+    http_post_json_with_retries,
+)
 from differential_transformer_replication_tpu.serving.scheduler import (
+    DeadlineExceededError,
     QueueFullError,
     Scheduler,
 )
 from differential_transformer_replication_tpu.serving.server import (
     EngineRunner,
     ServingClient,
+    ShuttingDownError,
     serve,
 )
 
 __all__ = [
     "ServingEngine",
+    "EngineCrashError",
     "Request",
     "RequestOutput",
     "SamplingParams",
     "Scheduler",
     "QueueFullError",
+    "DeadlineExceededError",
+    "ShuttingDownError",
     "EngineRunner",
     "ServingClient",
     "serve",
+    "backoff_delay",
+    "call_with_retries",
+    "http_post_json_with_retries",
 ]

@@ -73,9 +73,21 @@ from differential_transformer_replication_tpu.serving.request import (
 )
 from differential_transformer_replication_tpu.serving.scheduler import (
     ACTIVE,
+    FREE,
     Scheduler,
     Slot,
 )
+from differential_transformer_replication_tpu.utils import faults
+
+
+class EngineCrashError(RuntimeError):
+    """The engine failed mid-flight (device error, corrupt slot pool,
+    non-finite logits). Typed and RETRIABLE: the supervised runner
+    (serving/server.py) fails in-flight requests with this error,
+    rebuilds the slot pool from params, and serves on — a client that
+    retries (HTTP 503 + Retry-After) lands on the restarted engine."""
+
+    retriable = True
 
 
 @lru_cache(maxsize=None)
@@ -153,6 +165,15 @@ def _build_step_fns(cfg: ModelConfig, rope_len: int):
         fold_in(base, t). temperature/top_k are PER-ROW arrays;
         semantics match sample_token row-for-row (<=0 temp = greedy,
         top_k <= 0 = off, mask-below-kth-logit otherwise).
+
+        Also returns a per-row finiteness flag over the RAW logits
+        (before the intentional top-k -inf masking): a corrupt KV slot
+        or numerically diverged model yields NaN logits, and serving a
+        garbage argmax over them would be a silent wrong answer — the
+        engine turns a non-finite ACTIVE row into a typed
+        :class:`EngineCrashError` instead (inactive rows compute
+        garbage by design and are ignored host-side). The reduction
+        fuses into the sampling kernel; the extra transfer is (B,) bools.
         """
         keys = jax.vmap(jax.random.fold_in)(bases, counts)
         V = logits.shape[-1]
@@ -167,7 +188,8 @@ def _build_step_fns(cfg: ModelConfig, rope_len: int):
         drawn = jax.vmap(lambda k, lg: jax.random.categorical(k, lg))(
             keys, masked / safe_t
         )
-        return jnp.where(temperature <= 0, greedy, drawn).astype(jnp.int32)
+        tokens = jnp.where(temperature <= 0, greedy, drawn).astype(jnp.int32)
+        return tokens, jnp.isfinite(logits).all(axis=-1)
 
     # Donate the cache pool so XLA updates it in place instead of
     # allocating + copying a second full pool per chunk/step (the engine
@@ -203,6 +225,11 @@ class ServingEngine:
         self.scheduler = Scheduler(self.serving)
         self._next_id = 0
         self._base_keys: dict = {}  # request_id -> np (2,) uint32 PRNG base
+        # outputs produced by a step() that later RAISED: the finished/
+        # shed requests were already retired from the scheduler, so they
+        # would be unreachable after the crash (neither slot-holding nor
+        # queued) — the buffer keeps them deliverable (take_finished)
+        self._finished_prior: List[RequestOutput] = []
         self.stats = {
             "iterations": 0,
             "prefill_tokens": 0,
@@ -210,15 +237,22 @@ class ServingEngine:
             "completed": 0,
             "cancelled": 0,
             "rejected": 0,
+            "deadline_expired": 0,
+            "engine_restarts": 0,
         }
 
     # -- submission ---------------------------------------------------
 
     def submit(self, prompt: Sequence[int],
-               params: Optional[SamplingParams] = None, **kw) -> int:
+               params: Optional[SamplingParams] = None,
+               deadline: Optional[float] = None, **kw) -> int:
         """Queue one request; returns its request_id. ``kw`` are
         SamplingParams fields (max_new_tokens, temperature, top_k, seed,
-        eos_token_id). Raises ValueError when the request cannot fit the
+        eos_token_id). ``deadline`` is an ABSOLUTE ``time.perf_counter``
+        timestamp after which the engine stops working on the request
+        (shed at admission / retired mid-decode, ``finish_reason ==
+        "deadline"``); None applies ``ServingConfig.default_deadline_s``
+        when set. Raises ValueError when the request cannot fit the
         engine's static shapes (see module docstring on family limits).
         """
         rid = self._next_id
@@ -244,11 +278,14 @@ class ServingEngine:
                     f"max_seq_len ({self.max_total}); build the engine with "
                     "a larger ServingConfig.max_seq_len"
                 )
+        now = time.perf_counter()
+        if deadline is None and self.serving.default_deadline_s > 0:
+            deadline = now + self.serving.default_deadline_s
         # admission bound first (scheduler.submit raises QueueFullError
         # when the wait queue is at ServingConfig.max_queue_len) — a
         # rejected request must leave no key-chain entry behind
         try:
-            self.scheduler.submit(req, p, time.perf_counter())
+            self.scheduler.submit(req, p, now, deadline or 0.0)
         except Exception:
             self.stats["rejected"] += 1
             raise
@@ -281,11 +318,27 @@ class ServingEngine:
         return self.scheduler.queue_len()
 
     def step(self) -> List[RequestOutput]:
-        """Admit -> prefill (budgeted) -> batched decode. Returns the
-        requests that finished THIS iteration."""
+        """Deadline shed -> admit -> prefill (budgeted) -> batched
+        decode. Returns the requests that finished THIS iteration
+        (including ones retired with ``finish_reason == "deadline"``)."""
         if not self.scheduler.has_work():
-            return []
-        finished: List[RequestOutput] = []
+            out, self._finished_prior = self._finished_prior, []
+            return out
+        faults.serve_fire(self.stats["iterations"])
+        # build into the survives-an-exception buffer: a request that
+        # finishes (or is deadline-shed) early in this step and is
+        # already retired must still reach its caller when a LATER part
+        # of the same step crashes (see take_finished)
+        finished = self._finished_prior
+
+        # deadline enforcement, both placements, BEFORE device work:
+        # expired queue entries never get a slot, expired slots return
+        # their KV rows to the pool instead of decoding for nobody
+        now = time.perf_counter()
+        for req, prompt, t_submit, _dl in self.scheduler.shed_expired(now):
+            finished.append(self._expire_queued(req, prompt, t_submit, now))
+        for slot in self.scheduler.expired_slots(now):
+            finished.append(self._finish(slot, "deadline", now=now))
 
         for slot, start, size in self.scheduler.plan():
             tokens = jnp.asarray(slot.prompt[start:start + size][None])
@@ -298,8 +351,17 @@ class ServingEngine:
             if slot.filled == slot.prompt_len:
                 # prompt complete: the chunk's last-position logits give
                 # the first generated token (generate_cached's contract)
-                tok = self._sample_rows([slot], logits[None])[0]
-                self._emit(slot, int(tok), time.perf_counter(), finished)
+                tok, ok = self._sample_rows([slot], logits[None])
+                if not ok[0]:
+                    raise EngineCrashError(
+                        f"non-finite logits prefilling slot {slot.index} "
+                        f"(request {slot.request.request_id}): corrupt "
+                        "slot pool or numerically diverged params"
+                    )
+                self._emit(slot, int(tok[0]), time.perf_counter(), finished)
+
+        if faults.serve_corrupt_at(self.stats["iterations"]):
+            self._corrupt_one_slot()
 
         active = self.scheduler.active_slots()
         if active:
@@ -315,14 +377,33 @@ class ServingEngine:
                 self.params, jnp.asarray(tokens), jnp.asarray(pos),
                 jnp.asarray(mask), self.cache,
             )
-            sampled = self._sample_all_slots(logits)
+            sampled, ok = self._sample_all_slots(logits)
+            bad = [s for s in active if not ok[s.index]]
+            if bad:
+                raise EngineCrashError(
+                    f"non-finite logits decoding slot(s) "
+                    f"{[s.index for s in bad]} (request(s) "
+                    f"{[s.request.request_id for s in bad]}): corrupt "
+                    "slot pool or numerically diverged params"
+                )
             now = time.perf_counter()
             self.stats["decode_tokens"] += len(active)
             for s in active:
                 self._emit(s, int(sampled[s.index]), now, finished)
 
         self.stats["iterations"] += 1
+        self._finished_prior = []
         return finished
+
+    def take_finished(self) -> List[RequestOutput]:
+        """Outputs accumulated by a :meth:`step` that raised partway
+        through. Those requests were already retired (slot freed / shed
+        from the queue), so after a crash they are invisible to both
+        :meth:`reset_after_crash`'s lost-list and the preserved queue —
+        the supervisor (serving/server.py) must drain this buffer and
+        deliver them, or their callers would hang forever."""
+        out, self._finished_prior = self._finished_prior, []
+        return out
 
     def run(self) -> List[RequestOutput]:
         """Drain the queue; returns every output, in completion order."""
@@ -366,8 +447,9 @@ class ServingEngine:
 
     # -- internals ----------------------------------------------------
 
-    def _sample_rows(self, slots: List[Slot], logits) -> np.ndarray:
-        """Sample one token for each given slot from (n, V) logits."""
+    def _sample_rows(self, slots: List[Slot], logits):
+        """Sample one token for each given slot from (n, V) logits;
+        returns (tokens, finite-ok) per row."""
         bases = jnp.asarray(
             np.stack([
                 self._base_keys[s.request.request_id] for s in slots
@@ -382,13 +464,14 @@ class ServingEngine:
         topks = jnp.asarray(
             [(s.request.params.top_k or 0) for s in slots], jnp.int32
         )
-        return np.asarray(
-            self._sample_fn(bases, counts, logits, temps, topks)
-        )
+        toks, ok = self._sample_fn(bases, counts, logits, temps, topks)
+        return np.asarray(toks), np.asarray(ok)
 
-    def _sample_all_slots(self, logits) -> np.ndarray:
+    def _sample_all_slots(self, logits):
         """Full-pool variant with inert defaults on non-active rows, so
-        the decode-path sampler always sees the same (B, V) shape."""
+        the decode-path sampler always sees the same (B, V) shape.
+        Returns (tokens, finite-ok); only ACTIVE rows' flags mean
+        anything (inactive rows compute garbage by design)."""
         B = self.serving.num_slots
         bases = np.zeros((B, 2), np.uint32)
         counts = np.zeros((B,), np.int32)
@@ -400,12 +483,11 @@ class ServingEngine:
             counts[s.index] = len(s.generated)
             temps[s.index] = p.temperature
             topks[s.index] = p.top_k or 0
-        return np.asarray(
-            self._sample_fn(
-                jnp.asarray(bases), jnp.asarray(counts), logits,
-                jnp.asarray(temps), jnp.asarray(topks),
-            )
+        toks, ok = self._sample_fn(
+            jnp.asarray(bases), jnp.asarray(counts), logits,
+            jnp.asarray(temps), jnp.asarray(topks),
         )
+        return np.asarray(toks), np.asarray(ok)
 
     def _emit(self, slot: Slot, token: int, now: float,
               finished: List[RequestOutput]) -> None:
@@ -426,7 +508,8 @@ class ServingEngine:
                 self._finish(slot, "eos" if hit_eos else "length")
             )
 
-    def _finish(self, slot: Slot, reason: str) -> RequestOutput:
+    def _finish(self, slot: Slot, reason: str,
+                now: Optional[float] = None) -> RequestOutput:
         out = RequestOutput(
             request_id=slot.request.request_id,
             prompt=[int(t) for t in slot.prompt],
@@ -434,10 +517,91 @@ class ServingEngine:
             finish_reason=reason,
             submit_time=slot.submit_time,
             first_token_time=slot.first_token_time,
-            finish_time=slot.token_times[-1],
+            # a slot retired at its deadline may not have produced a
+            # single token yet (still prefilling)
+            finish_time=(
+                slot.token_times[-1] if slot.token_times
+                else (now if now is not None else time.perf_counter())
+            ),
             token_times=list(slot.token_times),
         )
         del self._base_keys[slot.request.request_id]
-        self.stats["completed"] += 1
+        if reason == "deadline":
+            self.stats["deadline_expired"] += 1
+        else:
+            self.stats["completed"] += 1
         self.scheduler.retire(slot)
         return out
+
+    def _expire_queued(self, request, prompt, submit_time: float,
+                       now: float) -> RequestOutput:
+        """A request whose deadline passed while it waited for a slot:
+        it never touches the device; the caller gets a typed error."""
+        self._base_keys.pop(request.request_id, None)
+        self.stats["deadline_expired"] += 1
+        return RequestOutput(
+            request_id=request.request_id,
+            prompt=[int(t) for t in prompt],
+            tokens=[],
+            finish_reason="deadline",
+            submit_time=submit_time,
+            first_token_time=0.0,
+            finish_time=now,
+            token_times=[],
+        )
+
+    def _corrupt_one_slot(self) -> None:
+        """Fault-injection helper (``serve_corrupt@N``): NaN-poison one
+        occupied slot's KV rows. Prefers an ACTIVE slot — the ring mask
+        derives visibility from position arithmetic, so poison in
+        not-yet-written positions would stay invisible; an active
+        slot's already-written keys are visible and the next decode
+        step's logits go NaN, tripping the finite-logits guard."""
+        target = next(
+            (s for s in self.scheduler.slots if s.state == ACTIVE), None
+        ) or next(
+            (s for s in self.scheduler.slots
+             if s.state != FREE and s.filled > 0), None
+        )
+        if target is None:
+            return
+        i = target.index
+        self.cache = [
+            {"k": c["k"].at[:, i].set(jnp.nan),
+             "v": c["v"].at[i].set(jnp.nan)}
+            for c in self.cache
+        ]
+
+    # -- crash recovery (serving/server.py supervision) ----------------
+
+    def reset_after_crash(self) -> List[int]:
+        """Rebuild device-side state after a failed :meth:`step`.
+
+        A crashed step leaves the engine untrusted: the jitted calls
+        donate the cache pool, so a failure mid-call may have
+        invalidated (or poisoned) it. Params are immutable jax arrays —
+        never donated, never written — so the pool is rebuilt from
+        scratch exactly as ``__init__`` built it, and the jitted
+        closures are reused from the module-level cache (a restart adds
+        ZERO recompiles; pinned by tests/test_serving_resilience.py).
+
+        Requests that held slots (in-flight) lost device state and are
+        FAILED — their request_ids are returned for the supervisor to
+        error out with :class:`EngineCrashError`. Requests still in the
+        wait queue never touched the device and are preserved verbatim
+        (same request_id, prompt, deadline, PRNG base), so they complete
+        normally after the restart. Stats survive;
+        ``stats["engine_restarts"]`` counts the rebuilds.
+        """
+        lost: List[int] = []
+        for slot in self.scheduler.slots:
+            if slot.state != FREE and slot.request is not None:
+                rid = slot.request.request_id
+                lost.append(rid)
+                self._base_keys.pop(rid, None)
+        preserved = list(self.scheduler.queue)
+        self.cache = init_cache(self.cfg, self.serving.num_slots)
+        self.scheduler = Scheduler(self.serving)
+        self.scheduler.queue.extend(preserved)
+        self.stats["engine_restarts"] += 1
+        return lost

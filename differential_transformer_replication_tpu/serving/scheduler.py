@@ -42,6 +42,23 @@ class QueueFullError(RuntimeError):
     graceful-overload contract — callers get an immediate, retryable
     error (HTTP 503 from the server) instead of an unbounded wait."""
 
+    retriable = True
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's server-side deadline expired before completion.
+
+    Raised to the CALLER only (serving/server.py delivers it, HTTP 504);
+    engine-side the request is shed at admission or retired mid-decode
+    so its KV slot goes back to the pool instead of decoding for a
+    client that has already given up. ``output`` carries the partial
+    :class:`RequestOutput` (``finish_reason == "deadline"``; tokens
+    generated before expiry, empty when shed at admission)."""
+
+    def __init__(self, message: str, output=None):
+        super().__init__(message)
+        self.output = output
+
 
 @dataclass
 class Slot:
@@ -55,6 +72,9 @@ class Slot:
     generated: List[int] = field(default_factory=list)
     admit_seq: int = -1  # admission order, for FCFS prefill within a step
     submit_time: float = 0.0
+    # absolute perf_counter() deadline; 0.0 = none. The engine retires
+    # the slot (reason "deadline") once now >= deadline, mid-decode.
+    deadline: float = 0.0
     first_token_time: float = 0.0
     token_times: List[float] = field(default_factory=list)
 
@@ -70,6 +90,7 @@ class Slot:
         self.generated = []
         self.admit_seq = -1
         self.submit_time = 0.0
+        self.deadline = 0.0
         self.first_token_time = 0.0
         self.token_times = []
 
@@ -86,7 +107,9 @@ class Scheduler:
     def __init__(self, serving: ServingConfig):
         self.serving = serving
         self.slots = [Slot(index=i) for i in range(serving.num_slots)]
-        self.queue: Deque[Tuple[Request, np.ndarray, float]] = deque()
+        # (request, cropped prompt, submit_time, deadline) — deadline is
+        # an absolute perf_counter() timestamp, 0.0 = none
+        self.queue: Deque[Tuple[Request, np.ndarray, float, float]] = deque()
         self._admit_seq = 0
         # invariant checked by tests: concurrent occupied slots never
         # exceed the pool
@@ -95,7 +118,7 @@ class Scheduler:
     # -- submission ---------------------------------------------------
 
     def submit(self, request: Request, prompt: np.ndarray,
-               submit_time: float) -> None:
+               submit_time: float, deadline: float = 0.0) -> None:
         """Enqueue an engine-validated (request, cropped prompt) pair.
         Raises :class:`QueueFullError` when the wait queue is at
         ``max_queue_len`` (0 = unbounded): overload must degrade into
@@ -108,15 +131,15 @@ class Scheduler:
                 f"{self.occupied()}/{len(self.slots)} slots busy); retry "
                 "later"
             )
-        self.queue.append((request, prompt, submit_time))
+        self.queue.append((request, prompt, submit_time, deadline))
 
     def cancel(self, request_id: int) -> bool:
         """Remove a request wherever it lives: still waiting (dropped
         from the queue) or holding a slot (the slot is retired, so its
         KV rows go back to the pool for the next admission). Returns
         whether the request was found."""
-        for i, (req, _prompt, _t) in enumerate(self.queue):
-            if req.request_id == request_id:
+        for i, entry in enumerate(self.queue):
+            if entry[0].request_id == request_id:
                 del self.queue[i]
                 return True
         for slot in self.slots:
@@ -142,6 +165,35 @@ class Scheduler:
     def occupied(self) -> int:
         return sum(1 for s in self.slots if s.state != FREE)
 
+    # -- deadlines ----------------------------------------------------
+
+    def shed_expired(self, now: float) -> List[
+        Tuple[Request, np.ndarray, float, float]
+    ]:
+        """Drop already-expired entries from the wait queue and return
+        them. Admission-time shedding: a request whose deadline passed
+        while it waited would burn prefill + decode iterations for a
+        caller that has already given up — it never gets a slot. The
+        engine converts the returned entries into ``finish_reason ==
+        "deadline"`` outputs (a typed error at the caller)."""
+        if not any(e[3] and now >= e[3] for e in self.queue):
+            return []
+        expired = [e for e in self.queue if e[3] and now >= e[3]]
+        self.queue = deque(
+            e for e in self.queue if not (e[3] and now >= e[3])
+        )
+        return expired
+
+    def expired_slots(self, now: float) -> List[Slot]:
+        """Occupied slots whose request's deadline has passed — the
+        engine retires these (KV rows back to the pool) instead of
+        decoding for nobody. Does not mutate; retirement is the
+        engine's move (it must emit the partial output first)."""
+        return [
+            s for s in self.slots
+            if s.state != FREE and s.deadline and now >= s.deadline
+        ]
+
     # -- the per-iteration decision -----------------------------------
 
     def plan(self) -> List[Tuple[Slot, int, int]]:
@@ -156,7 +208,7 @@ class Scheduler:
                 break
             if slot.state != FREE:
                 continue
-            request, prompt, t_submit = self.queue.popleft()
+            request, prompt, t_submit, deadline = self.queue.popleft()
             slot.state = PREFILL
             slot.request = request
             slot.prompt = prompt
@@ -164,6 +216,7 @@ class Scheduler:
             slot.generated = []
             slot.token_times = []
             slot.submit_time = t_submit
+            slot.deadline = deadline
             slot.admit_seq = self._admit_seq
             self._admit_seq += 1
         self.max_concurrent = max(self.max_concurrent, self.occupied())

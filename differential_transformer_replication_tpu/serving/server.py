@@ -7,7 +7,15 @@ Three layers, smallest useful surface each:
   concurrent callers enqueue requests through a lock, the loop moves
   them into the engine and steps until idle, then parks on a condition
   variable. This is the concurrency boundary — everything device-side
-  stays single-threaded.
+  stays single-threaded. It is also the SUPERVISOR (the serving-side
+  analog of tools/train_supervisor.py): a crashed engine step fails the
+  in-flight requests with a typed, retriable
+  :class:`~.engine.EngineCrashError`, rebuilds the slot pool from
+  params after a bounded exponential backoff, and keeps serving — wait-
+  queue entries survive the restart verbatim. A wall-time watchdog
+  flags iterations that exceed ``ServingConfig.step_time_budget_s``;
+  :meth:`EngineRunner.status` reports
+  ``healthy | degraded | restarting | draining | failed``.
 - :class:`ServingClient` — the programmatic client tests and the bench
   use: blocking ``generate()`` per caller thread, n callers = n
   concurrent streams batched by the engine. Runs fully in-process under
@@ -15,19 +23,25 @@ Three layers, smallest useful surface each:
 - :func:`serve` / ``python -m ...serving.server`` — a stdlib
   ``http.server`` JSON endpoint (no new dependencies): POST /generate
   with ``{"prompt_ids": [...]}`` (or ``{"prompt": "text"}`` when a
-  tokenizer dir is given), GET /health for engine stats. One engine,
-  many HTTP threads, continuous batching across them.
+  tokenizer dir is given), GET /health for engine state + stats, GET
+  /ready for load-balancer admission (503 + Retry-After while draining
+  or restarting). SIGTERM triggers a graceful drain: admission stops
+  (503 + Retry-After), in-flight requests finish within
+  ``ServingConfig.drain_timeout_s``, then the process exits.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import threading
+import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence
 
 from differential_transformer_replication_tpu.serving.engine import (
+    EngineCrashError,
     ServingEngine,
 )
 from differential_transformer_replication_tpu.serving.request import (
@@ -35,57 +49,137 @@ from differential_transformer_replication_tpu.serving.request import (
     SamplingParams,
 )
 from differential_transformer_replication_tpu.serving.scheduler import (
+    DeadlineExceededError,
     QueueFullError,
 )
+
+
+class ShuttingDownError(RuntimeError):
+    """Admission refused: the server is draining (or already stopped).
+    Retriable — against ANOTHER replica; HTTP maps it to 503 with a
+    Retry-After so load balancers take the instance out of rotation."""
+
+    retriable = True
 
 
 class _Pending:
     """One submitted request's handle across the thread boundary."""
 
-    __slots__ = ("prompt", "params", "done", "result", "error", "rid",
-                 "cancelled")
+    __slots__ = ("prompt", "params", "deadline", "done", "result",
+                 "error", "rid", "cancelled", "settled")
 
-    def __init__(self, prompt, params):
+    def __init__(self, prompt, params, deadline=None):
         self.prompt = prompt
         self.params = params
+        self.deadline = deadline  # absolute perf_counter ts, or None
         self.done = threading.Event()
         self.result: Optional[RequestOutput] = None
         self.error: Optional[BaseException] = None
         self.rid: Optional[int] = None  # set once the engine admits it
         self.cancelled = False
-
-    def fail(self, e: BaseException) -> None:
-        self.error = e
-        self.done.set()
+        self.settled = False  # exactly-once delivery (drain accounting)
 
 
 class EngineRunner:
-    """Owns the engine on a background thread; see module docstring."""
+    """Owns + supervises the engine on a background thread; see module
+    docstring. Supervision knobs come from the engine's
+    ``ServingConfig``: ``max_restarts`` / ``restart_backoff_s`` /
+    ``restart_backoff_max_s`` (crash recovery), ``step_time_budget_s``
+    (watchdog), ``drain_timeout_s`` (graceful drain)."""
 
     def __init__(self, engine: ServingEngine):
         self.engine = engine
+        serving = engine.serving
+        self.max_restarts = serving.max_restarts
+        self._backoff_base = serving.restart_backoff_s
+        self._backoff_max = serving.restart_backoff_max_s
+        self._step_budget = serving.step_time_budget_s
         self._cond = threading.Condition()
         self._incoming: deque = deque()  # _Pending not yet in the engine
         self._cancels: deque = deque()  # _Pending to cancel in the engine
         self._stop = False
+        self._abort = False  # drain budget blown: fail leftovers, exit
+        self._draining = False
+        self._failed = False  # restart budget exhausted
+        self._restarting = False
+        self._degraded = False  # last completed step blew the budget
+        self._open = 0  # unsettled pendings (drain accounting)
+        self.restarts = 0
+        self._step_started: Optional[float] = None
+        self.last_step_s: Optional[float] = None
         self._thread = threading.Thread(
             target=self._loop, name="serving-engine", daemon=True
         )
         self._thread.start()
 
+    # -- observability -------------------------------------------------
+
+    def status(self) -> str:
+        """``healthy | degraded | restarting | draining | failed`` —
+        what /health reports and /ready keys off. "degraded" covers
+        both a completed iteration that blew ``step_time_budget_s`` and
+        an iteration currently running past it (a hung device call
+        cannot be interrupted, but it CAN be reported while stuck)."""
+        now = time.perf_counter()
+        with self._cond:
+            if self._failed:
+                return "failed"
+            if self._draining or self._stop:
+                return "draining"
+            if self._restarting:
+                return "restarting"
+            started = self._step_started
+            overrunning = (
+                self._step_budget > 0 and started is not None
+                and now - started > self._step_budget
+            )
+            if self._degraded or overrunning:
+                return "degraded"
+            return "healthy"
+
+    def accepting(self) -> bool:
+        """The /ready contract: route traffic here? False while
+        draining/failed (submits are refused) AND while restarting
+        (submits are accepted — they queue behind the rebuild — but a
+        load balancer with other replicas should prefer them)."""
+        return self.status() in ("healthy", "degraded")
+
+    # -- submission ----------------------------------------------------
+
     def submit(self, prompt: Sequence[int],
-               params: Optional[SamplingParams] = None, **kw) -> _Pending:
+               params: Optional[SamplingParams] = None,
+               deadline_s: Optional[float] = None, **kw) -> _Pending:
         """Thread-safe enqueue; returns the request's :class:`_Pending`
         handle. Raises :class:`QueueFullError` IMMEDIATELY when the
         admission bound (ServingConfig.max_queue_len) is hit — counting
         both the engine's wait queue and requests still in this runner's
         hand-off deque — so overload degrades into fast rejections the
-        caller can act on."""
+        caller can act on; raises :class:`ShuttingDownError` while
+        draining/closed. ``deadline_s`` is a server-side budget in
+        seconds from now; the engine stops working on the request once
+        it expires (the caller gets :class:`DeadlineExceededError`).
+        Submissions during a supervised engine restart are accepted —
+        they queue and run once the rebuilt engine is up."""
         params = params or SamplingParams(**kw)
-        pending = _Pending(list(prompt), params)
+        deadline = (
+            time.perf_counter() + deadline_s
+            if deadline_s is not None else None
+        )
+        pending = _Pending(list(prompt), params, deadline)
         with self._cond:
-            if self._stop:
-                raise RuntimeError("EngineRunner is closed")
+            if self._failed:
+                err = EngineCrashError(
+                    f"engine restart budget exhausted "
+                    f"({self.max_restarts}); runner is dead"
+                )
+                # the class default says retriable, but THIS runner can
+                # never recover — retry clients must fail over, not wait
+                err.retriable = False
+                raise err
+            if self._draining or self._stop:
+                raise ShuttingDownError(
+                    "server is draining; retry against another replica"
+                )
             maxq = self.engine.serving.max_queue_len
             # cancelled-but-undrained pendings no longer occupy the wait
             # queue they are counted against — a burst of client
@@ -97,6 +191,7 @@ class EngineRunner:
                     f"admission queue full ({maxq} waiting); retry later"
                 )
             self._incoming.append(pending)
+            self._open += 1
             self._cond.notify()
         return pending
 
@@ -113,8 +208,9 @@ class EngineRunner:
 
     def generate(self, prompt: Sequence[int],
                  params: Optional[SamplingParams] = None,
-                 timeout: Optional[float] = None, **kw) -> RequestOutput:
-        pending = self.submit(prompt, params, **kw)
+                 timeout: Optional[float] = None,
+                 deadline_s: Optional[float] = None, **kw) -> RequestOutput:
+        pending = self.submit(prompt, params, deadline_s=deadline_s, **kw)
         if not pending.done.wait(timeout):
             # reclaim the engine-side resources before giving up — the
             # old behavior decoded to completion for nobody, pinning a
@@ -125,11 +221,177 @@ class EngineRunner:
             raise pending.error
         return pending.result
 
-    def close(self) -> None:
+    # -- shutdown ------------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop admission (new submits raise
+        :class:`ShuttingDownError` -> HTTP 503 + Retry-After), wait for
+        every accepted request to settle within the drain budget
+        (``ServingConfig.drain_timeout_s`` unless overridden), then
+        close the runner. Returns True when everything in flight
+        completed; False when the budget expired and the stragglers
+        were failed with :class:`ShuttingDownError`."""
+        budget = (
+            self.engine.serving.drain_timeout_s
+            if timeout is None else timeout
+        )
+        end = time.monotonic() + budget
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while (
+                (self._open > 0 or self._incoming
+                 or self.engine.has_work())
+                and self._thread.is_alive()
+            ):
+                left = end - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(min(left, 0.1))
+            drained = (
+                self._open == 0 and not self._incoming
+                and not self.engine.has_work()
+            )
+            if not drained:
+                # budget blown: the loop fails leftovers on its next
+                # pass and exits — nobody is left hanging
+                self._abort = True
+                self._cond.notify_all()
+        self.close()
+        return drained
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop the loop (after it finishes in-engine work) and join
+        the thread. Raises RuntimeError when the thread does not stop
+        within ``timeout`` — a stuck device call means engine state is
+        untrusted, and silently leaking the thread (the old behavior)
+        hid exactly the wedged-server condition operators must see."""
         with self._cond:
             self._stop = True
-            self._cond.notify()
-        self._thread.join(timeout=30)
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            with self._cond:
+                # a wedged engine is a FAILED runner, not a routine
+                # drain — /health must say so for as long as it answers
+                self._failed = True
+            raise RuntimeError(
+                f"serving-engine thread failed to stop within {timeout}s "
+                "(stuck in an engine step?); leaking the thread — engine "
+                "state is untrusted, do not reuse this runner"
+            )
+
+    # -- internals -----------------------------------------------------
+
+    def _settle(self, pending: _Pending, result=None, error=None) -> bool:
+        """Exactly-once delivery + drain accounting. Cancelled requests
+        are settled too (their caller already unwound; the bookkeeping
+        must not wait on them forever)."""
+        with self._cond:
+            if pending.settled:
+                return False
+            pending.settled = True
+            pending.result = result
+            pending.error = error
+            self._open -= 1
+            self._cond.notify_all()
+        pending.done.set()
+        return True
+
+    def _deliver(self, outs, waiters: dict) -> None:
+        """Settle finished engine outputs with their waiters (normal
+        completion or a typed deadline error)."""
+        for out in outs:
+            pending = waiters.pop(out.request_id, None)
+            if pending is None:
+                continue
+            if out.finish_reason == "deadline":
+                self._settle(pending, error=DeadlineExceededError(
+                    f"request {out.request_id} exceeded its "
+                    f"server-side deadline after {len(out.tokens)} "
+                    "generated tokens", output=out,
+                ))
+            else:
+                self._settle(pending, result=out)
+
+    def _handle_engine_crash(self, exc: BaseException, waiters: dict) -> bool:
+        """Supervised recovery from a failed engine step. Returns True
+        when the loop should continue on the rebuilt engine, False when
+        it must exit (restart budget exhausted, or the engine cannot be
+        rebuilt). Mirrors tools/train_supervisor.py: typed failure,
+        bounded exponential backoff, restart budget."""
+        if isinstance(exc, EngineCrashError):
+            crash = exc
+        else:
+            crash = EngineCrashError(f"engine step failed: {exc!r}")
+            crash.__cause__ = exc
+        # requests that finished EARLIER in the crashed step were
+        # already retired from the scheduler — deliver them now, or
+        # they are reachable from nowhere (not lost, not queued) and
+        # their callers hang, the exact failure this layer removes
+        take = getattr(self.engine, "take_finished", None)
+        if take is not None:
+            self._deliver(take(), waiters)
+        self.restarts += 1
+        rebuild = getattr(self.engine, "reset_after_crash", None)
+        fatal = rebuild is None or self.restarts > self.max_restarts
+        lost: List[int] = []
+        if not fatal:
+            with self._cond:
+                self._restarting = True
+            try:
+                # fresh slot pool from params; wait-queue entries
+                # survive verbatim (same rids -> same waiters)
+                lost = rebuild()
+            except Exception as e:  # cannot rebuild: give up
+                print(f"[serving] engine rebuild failed: {e!r}",
+                      file=sys.stderr)
+                fatal = True
+        if fatal:
+            crash.retriable = False  # no restart is coming
+            with self._cond:
+                self._failed = True
+                self._stop = True
+                incoming = list(self._incoming)
+                self._incoming.clear()
+                self._restarting = False
+            for p in list(waiters.values()):
+                self._settle(p, error=crash)
+            waiters.clear()
+            for p in incoming:
+                self._settle(p, error=crash)
+            print(
+                f"[serving] engine crashed ({exc!r}); restart budget "
+                f"exhausted ({self.max_restarts}) — runner failed",
+                file=sys.stderr,
+            )
+            return False
+        # in-flight requests lost device state: fail them typed; queued
+        # ones ride through the restart untouched
+        for rid in lost:
+            p = waiters.pop(rid, None)
+            if p is not None:
+                self._settle(p, error=crash)
+        delay = min(
+            self._backoff_base * (2 ** (self.restarts - 1)),
+            self._backoff_max,
+        )
+        print(
+            f"[serving] engine crashed ({exc!r}); slot pool rebuilt, "
+            f"restart {self.restarts}/{self.max_restarts}, resuming in "
+            f"{delay:.2f}s ({len(lost)} in-flight failed, "
+            f"{self.engine.queue_len()} queued preserved)",
+            file=sys.stderr,
+        )
+        end = time.monotonic() + delay
+        while time.monotonic() < end:
+            with self._cond:
+                if self._stop or self._abort:
+                    break
+            time.sleep(min(0.05, max(0.0, end - time.monotonic())))
+        with self._cond:
+            self._restarting = False
+        return True
 
     def _loop(self) -> None:
         waiters: dict = {}  # request_id -> _Pending
@@ -139,6 +401,7 @@ class EngineRunner:
                     not self._incoming
                     and not self._cancels
                     and not self.engine.has_work()
+                    and not self._abort
                 ):
                     if self._stop:
                         return
@@ -148,39 +411,70 @@ class EngineRunner:
                 cancels = list(self._cancels)
                 self._cancels.clear()
                 stopping = self._stop
+                aborting = self._abort
+            if aborting:
+                err = ShuttingDownError(
+                    "server shut down before completing this request "
+                    "(drain budget expired)"
+                )
+                for p in list(waiters.values()):
+                    self._settle(p, error=err)
+                for p in incoming:
+                    self._settle(p, error=err)
+                return
             for pending in cancels:
                 if pending.rid is not None:
                     if self.engine.cancel(pending.rid):
-                        waiters.pop(pending.rid, None)
-                # rid None: either still in `incoming` (skipped below) or
+                        w = waiters.pop(pending.rid, None)
+                        if w is not None:
+                            self._settle(
+                                w, error=TimeoutError("cancelled")
+                            )
+                # rid None: either still in `incoming` (settled below) or
                 # it finished before the cancel landed — nothing to undo
             for pending in incoming:
                 if pending.cancelled:
+                    self._settle(
+                        pending,
+                        error=TimeoutError("cancelled before admission"),
+                    )
                     continue
                 try:
-                    pending.rid = self.engine.submit(
-                        pending.prompt, params=pending.params
-                    )
+                    if pending.deadline is not None:
+                        pending.rid = self.engine.submit(
+                            pending.prompt, params=pending.params,
+                            deadline=pending.deadline,
+                        )
+                    else:
+                        pending.rid = self.engine.submit(
+                            pending.prompt, params=pending.params
+                        )
                     waiters[pending.rid] = pending
                 except Exception as e:  # invalid request: fail the caller
-                    pending.fail(e)
+                    self._settle(pending, error=e)
             try:
-                for out in self.engine.step():
-                    pending = waiters.pop(out.request_id)
-                    pending.result = out
-                    pending.done.set()
+                t0 = time.perf_counter()
+                self._step_started = t0
+                outs = self.engine.step()
+                dt = time.perf_counter() - t0
+                self._step_started = None
+                self.last_step_s = dt
+                if self._step_budget > 0:
+                    if dt > self._step_budget and not self._degraded:
+                        self._degraded = True
+                        print(
+                            f"[serving] watchdog: engine iteration took "
+                            f"{dt:.3f}s (budget {self._step_budget}s) — "
+                            "marking degraded", file=sys.stderr,
+                        )
+                    elif dt <= self._step_budget and self._degraded:
+                        self._degraded = False
             except Exception as e:
-                # a device-side failure (OOM, runtime error) must not
-                # strand callers on a dead thread: fail every waiter and
-                # refuse further work
-                for pending in waiters.values():
-                    pending.fail(e)
-                with self._cond:
-                    self._stop = True
-                    for pending in self._incoming:
-                        pending.fail(e)
-                    self._incoming.clear()
-                raise
+                self._step_started = None
+                if not self._handle_engine_crash(e, waiters):
+                    return
+                continue
+            self._deliver(outs, waiters)
             if stopping and not self.engine.has_work():
                 return
 
@@ -193,8 +487,11 @@ class ServingClient:
 
     def generate(self, prompt: Sequence[int],
                  params: Optional[SamplingParams] = None,
-                 timeout: Optional[float] = None, **kw) -> RequestOutput:
-        return self.runner.generate(prompt, params, timeout=timeout, **kw)
+                 timeout: Optional[float] = None,
+                 deadline_s: Optional[float] = None, **kw) -> RequestOutput:
+        return self.runner.generate(
+            prompt, params, timeout=timeout, deadline_s=deadline_s, **kw
+        )
 
     def generate_batch(self, prompts: Sequence[Sequence[int]],
                        params: Optional[Sequence[SamplingParams]] = None,
@@ -237,23 +534,60 @@ class ServingClient:
     def stats(self) -> dict:
         return dict(self.runner.engine.stats)
 
+    def status(self) -> str:
+        return self.runner.status()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown; see :meth:`EngineRunner.drain`."""
+        return self.runner.drain(timeout)
+
     def close(self) -> None:
         self.runner.close()
 
 
 def _make_handler(client: ServingClient, tokenizer=None):
     class Handler(BaseHTTPRequestHandler):
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers: Optional[dict] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
+        def _retry_after(self) -> dict:
+            # how long a well-behaved client should back off before
+            # retrying this replica; draining lasts up to the drain
+            # budget, everything else clears within ~a restart backoff
+            serving = client.runner.engine.serving
+            if client.runner.status() == "draining":
+                secs = max(1, int(serving.drain_timeout_s))
+            else:
+                secs = max(1, int(serving.restart_backoff_s))
+            return {"Retry-After": str(secs)}
+
         def do_GET(self):
             if self.path == "/health":
-                self._reply(200, {"ok": True, "stats": client.stats})
+                status = client.status()
+                self._reply(200, {
+                    "ok": status in ("healthy", "degraded"),
+                    "status": status,
+                    "restarts": client.runner.restarts,
+                    "last_step_s": client.runner.last_step_s,
+                    "stats": client.stats,
+                })
+            elif self.path == "/ready":
+                if client.runner.accepting():
+                    self._reply(200, {"ready": True,
+                                      "status": client.status()})
+                else:
+                    self._reply(
+                        503, {"ready": False, "status": client.status()},
+                        headers=self._retry_after(),
+                    )
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -283,23 +617,70 @@ def _make_handler(client: ServingClient, tokenizer=None):
                     seed=int(req.get("seed", 0)),
                     eos_token_id=None if eos is None else int(eos),
                 )
+                deadline_s = req.get("deadline_s")
                 out = client.generate(
                     [int(t) for t in prompt_ids], params,
                     timeout=float(req.get("timeout", 600.0)),
+                    deadline_s=(
+                        None if deadline_s is None else float(deadline_s)
+                    ),
                 )
             except (ValueError, TypeError, json.JSONDecodeError) as e:
-                self._reply(400, {"error": str(e)})
+                self._reply(400, {"error": str(e), "code": "bad_request"})
                 return
             except QueueFullError as e:
                 # overload: reject fast with the retryable status so
-                # load balancers/clients back off instead of piling on
-                self._reply(503, {"error": f"server overloaded: {e}"})
+                # load balancers/clients back off instead of piling on.
+                # Every error reply carries a machine-readable "code" —
+                # serving/retry.py gates retries on it and the bench
+                # classifies by it, so rewording the human text cannot
+                # silently change client behavior.
+                self._reply(
+                    503,
+                    {"error": f"server overloaded: {e}",
+                     "code": "queue_full"},
+                    headers=self._retry_after(),
+                )
+                return
+            except ShuttingDownError as e:
+                self._reply(503, {"error": str(e),
+                                  "code": "shutting_down"},
+                            headers=self._retry_after())
+                return
+            except EngineCrashError as e:
+                if getattr(e, "retriable", True):
+                    # the supervised restart is already underway — a
+                    # retry after the backoff lands on the rebuilt engine
+                    self._reply(
+                        503, {"error": f"engine crashed: {e}",
+                              "code": "engine_crash"},
+                        headers=self._retry_after(),
+                    )
+                else:
+                    # restart budget exhausted: this replica will NEVER
+                    # recover — no Retry-After, non-retriable code, so
+                    # clients fail over instead of burning their budget
+                    self._reply(503, {"error": str(e),
+                                      "code": "engine_failed"})
+                return
+            except DeadlineExceededError as e:
+                self._reply(504, {
+                    "error": str(e),
+                    "code": "deadline",
+                    "partial_tokens": (
+                        e.output.tokens if e.output is not None else []
+                    ),
+                })
                 return
             except TimeoutError:
-                self._reply(503, {"error": "generation timed out"})
+                # the request burned its FULL generation timeout — a
+                # retry would re-add that same load to a server at its
+                # slowest, so: no Retry-After, non-retriable code
+                self._reply(503, {"error": "generation timed out",
+                                  "code": "timeout"})
                 return
             except RuntimeError as e:  # runner closed / engine failure
-                self._reply(500, {"error": str(e)})
+                self._reply(500, {"error": str(e), "code": "internal"})
                 return
             payload = {
                 "request_id": out.request_id,
@@ -355,6 +736,27 @@ def main() -> None:
     p.add_argument("--max-queue-len", type=int, default=0,
                    help="reject (HTTP 503) submissions past this many "
                         "waiting requests; 0 = unbounded")
+    p.add_argument("--default-deadline", type=float, default=0.0,
+                   help="server-side deadline (seconds) applied to "
+                        "requests that do not send deadline_s; expired "
+                        "requests are shed instead of decoded (0 = none)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="graceful-drain budget on SIGTERM: stop "
+                        "admission, finish in-flight within this many "
+                        "seconds, then exit")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="supervised engine-restart budget; a crashed "
+                        "engine step rebuilds the slot pool up to this "
+                        "many times before the server fails hard")
+    p.add_argument("--restart-backoff", type=float, default=0.5,
+                   help="first-restart backoff seconds (doubles per "
+                        "restart, like tools/train_supervisor.py)")
+    p.add_argument("--restart-backoff-max", type=float, default=30.0,
+                   help="restart backoff cap in seconds")
+    p.add_argument("--step-time-budget", type=float, default=0.0,
+                   help="watchdog: mark the engine degraded on /health "
+                        "when one decode iteration exceeds this many "
+                        "seconds (0 = off)")
     args = p.parse_args()
 
     meta = None
@@ -395,9 +797,47 @@ def main() -> None:
         num_slots=args.num_slots, prefill_chunk=args.prefill_chunk,
         prefill_budget=args.prefill_budget, max_seq_len=args.max_seq_len,
         max_queue_len=args.max_queue_len,
+        default_deadline_s=args.default_deadline,
+        drain_timeout_s=args.drain_timeout,
+        max_restarts=args.max_restarts,
+        restart_backoff_s=args.restart_backoff,
+        restart_backoff_max_s=args.restart_backoff_max,
+        step_time_budget_s=args.step_time_budget,
     )
     client = ServingClient(ServingEngine(params, model_cfg, serving))
     httpd = serve(client, args.host, args.port, tokenizer)
+
+    import signal
+
+    drained = {"done": False}
+
+    def _graceful(signum, frame):
+        del frame
+        print(f"[serve] signal {signum}: draining (budget "
+              f"{serving.drain_timeout_s}s) — admission stopped",
+              file=sys.stderr)
+
+        def _drain_then_stop():
+            try:
+                ok = client.drain()
+                print(f"[serve] drain {'complete' if ok else 'TIMED OUT'}; "
+                      "shutting down", file=sys.stderr)
+            except Exception as e:
+                # close() refuses to bless a stuck engine thread; a
+                # second close from main() would just block 30s more on
+                # the same wedged thread
+                print(f"[serve] drain failed: {e!r}", file=sys.stderr)
+            finally:
+                # the HTTP loop must stop regardless, or SIGTERM leaves
+                # a zombie serving 503s forever
+                drained["done"] = True
+                httpd.shutdown()
+
+        # a thread, because httpd.shutdown() deadlocks when called from
+        # the serve_forever thread, and signal handlers must not block
+        threading.Thread(target=_drain_then_stop, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
     print(
         f"[serve] {model_cfg.model} model, {serving.num_slots} slots — "
         f"POST http://{args.host}:{args.port}/generate"
@@ -408,7 +848,8 @@ def main() -> None:
         pass
     finally:
         httpd.server_close()
-        client.close()
+        if not drained["done"]:
+            client.close()
 
 
 if __name__ == "__main__":

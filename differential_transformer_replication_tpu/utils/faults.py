@@ -31,6 +31,21 @@ call-point faults):
                         BEFORE the atomic rename — the crash point
                         ``_atomic_write`` exists to survive
 
+Serving fault points (``@N`` counts ENGINE iterations —
+``ServingEngine.stats["iterations"]`` — not training steps; exercised
+by tests/test_serving_resilience.py against the engine supervision in
+serving/server.py):
+
+  ``serve_raise@N``     raise :class:`FaultInjected` at the top of
+                        engine iteration N (a mid-batch engine crash)
+  ``serve_hang@N``      stall engine iteration N for
+                        ``DTX_SERVE_HANG_S`` seconds (default 2.0) —
+                        the step-time watchdog's trigger
+  ``serve_corrupt@N``   NaN-poison one occupied slot's KV rows before
+                        iteration N's decode; the engine's finite-logits
+                        guard turns this into a typed EngineCrashError
+                        that the supervised restart recovers from
+
 Armed from the ``DTX_FAULTS`` environment variable on first use (env
 crosses the supervisor's subprocess boundary) and/or programmatically
 via :func:`arm` (``TrainConfig.faults`` feeds this). One-shot kinds
@@ -44,11 +59,17 @@ from __future__ import annotations
 
 import os
 import signal
+import time
 from typing import Optional, Set
 
 ENV_VAR = "DTX_FAULTS"
+HANG_ENV_VAR = "DTX_SERVE_HANG_S"
 
-_STEP_KINDS = ("raise", "sigterm", "sigkill", "nan", "corrupt_params")
+_STEP_KINDS = (
+    "raise", "sigterm", "sigkill", "nan", "corrupt_params",
+    # serving kinds: steps are ENGINE iterations, not training steps
+    "serve_raise", "serve_hang", "serve_corrupt",
+)
 _POINT_KINDS = ("ckpt_write",)
 
 
@@ -132,6 +153,33 @@ def fire(step: int) -> None:
         os.kill(os.getpid(), signal.SIGTERM)
     if step in p["sigkill"]:
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def serve_fire(iteration: int) -> None:
+    """Crash-class serving faults for this ENGINE iteration; called at
+    the top of ``ServingEngine.step``. ``serve_raise`` is one-shot (a
+    supervised restart replaying the same iteration number must not
+    re-crash); ``serve_hang`` stalls the step long enough for the
+    wall-time watchdog to flag the engine degraded, then disarms."""
+    p = _get()
+    if iteration in p["serve_raise"]:
+        p["serve_raise"].discard(iteration)
+        raise FaultInjected(
+            f"injected engine crash at iteration {iteration}"
+        )
+    if iteration in p["serve_hang"]:
+        p["serve_hang"].discard(iteration)
+        time.sleep(float(os.environ.get(HANG_ENV_VAR, "2.0")))
+
+
+def serve_corrupt_at(iteration: int) -> bool:
+    """One-shot slot-corruption fault: when armed for this engine
+    iteration, the engine NaN-poisons one occupied slot's KV rows."""
+    p = _get()
+    if iteration in p["serve_corrupt"]:
+        p["serve_corrupt"].discard(iteration)
+        return True
+    return False
 
 
 def nan_armed() -> bool:

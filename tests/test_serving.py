@@ -385,6 +385,7 @@ class TestRunnerOverloadAndCancel:
         )
 
         runner = EngineRunner(_StubEngine(max_queue_len=2))
+        last = None
         try:
             handles = [runner.submit([1], max_new_tokens=4) for _ in range(2)]
             # give the runner time to move them into the engine queue
@@ -399,8 +400,16 @@ class TestRunnerOverloadAndCancel:
             deadline = time.time() + 5
             while runner.engine.queue_len() > 1 and time.time() < deadline:
                 time.sleep(0.01)
-            runner.submit([1], max_new_tokens=4)
+            last = runner.submit([1], max_new_tokens=4)
         finally:
+            # wait for the hand-off deque to flush before clearing the
+            # stub queue, or the last submit re-populates it after the
+            # clear and close() (which drains) times out on the
+            # never-finishing stub
+            deadline = time.time() + 10
+            while last is not None and last.rid is None \
+                    and time.time() < deadline:
+                time.sleep(0.01)
             runner.engine.queue.clear()  # let close() drain
             runner.close()
 
@@ -629,3 +638,12 @@ def test_serve_bench_smoke():
     for section in ("ttft_ms", "itl_ms"):
         assert line[section]["p50"] is not None
         assert line[section]["p95"] >= line[section]["p50"]
+    # error breakdown (serving resilience PR): failures are reported by
+    # type instead of silently folded into the latency stats
+    assert line["failed"] == 0
+    assert line["retries"] == 0
+    assert set(line["errors"]) == {
+        "queue_full", "engine_crash", "deadline", "timeout",
+        "shutting_down", "other",
+    }
+    assert all(v == 0 for v in line["errors"].values())

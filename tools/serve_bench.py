@@ -5,9 +5,17 @@ serving/).
 ``--clients`` worker threads each run a closed loop — submit one
 request, wait for completion, submit the next — through the in-process
 ``ServingClient``, so concurrency equals the client count and the
-engine's iteration-level scheduler batches across them. Prompt lengths
-are drawn uniformly from [--min-prompt, --max-prompt] with a fixed seed,
-so runs are comparable.
+engine's iteration-level scheduler batches across them. With ``--http``
+the same closed loop runs over the stdlib HTTP server on an ephemeral
+port instead. Prompt lengths are drawn uniformly from
+[--min-prompt, --max-prompt] with a fixed seed, so runs are comparable.
+
+Either way each worker retries RETRIABLE failures (queue-full 503s,
+engine crashes mid-restart) with jittered exponential backoff honoring
+the server's Retry-After (serving/retry.py), and the bench reports an
+``errors`` breakdown — queue_full / engine_crash / deadline / timeout
+counts plus total retries — instead of silently folding failures into
+the latency stats.
 
 Prints ONE JSON line (like bench.py) with requests/sec, output
 tokens/sec, and p50/p95 time-to-first-token + inter-token latency, e.g.::
@@ -68,6 +76,17 @@ def main() -> None:
     p.add_argument("--new-tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--http", action="store_true",
+                   help="drive the load through the stdlib HTTP server "
+                        "(ephemeral port) instead of in-process calls")
+    p.add_argument("--max-retries", type=int, default=3,
+                   help="per-request retry budget for retriable "
+                        "failures (503 / engine crash)")
+    p.add_argument("--max-queue-len", type=int, default=0,
+                   help="engine admission bound; 0 = unbounded")
+    p.add_argument("--deadline", type=float, default=0.0,
+                   help="server-side per-request deadline in seconds; "
+                        "0 = none")
     p.add_argument("--out", default=None,
                    help="also append the JSON line to this file")
     args = p.parse_args()
@@ -87,8 +106,15 @@ def main() -> None:
         ServingConfig,
     )
     from differential_transformer_replication_tpu.serving import (
+        DeadlineExceededError,
+        EngineCrashError,
+        QueueFullError,
         ServingClient,
         ServingEngine,
+        ShuttingDownError,
+        call_with_retries,
+        http_post_json_with_retries,
+        serve,
     )
 
     if args.checkpoint:
@@ -113,6 +139,8 @@ def main() -> None:
     serving = ServingConfig(
         num_slots=args.num_slots, prefill_chunk=args.prefill_chunk,
         prefill_budget=args.prefill_budget,
+        max_queue_len=args.max_queue_len,
+        default_deadline_s=args.deadline,
         # let RoPE families roll past block_size so a full-window prompt
         # plus new_tokens always fits (the diff family ignores this and
         # stays hard-capped at block_size)
@@ -120,6 +148,13 @@ def main() -> None:
     )
     engine = ServingEngine(params, model_cfg, serving)
     client = ServingClient(engine)
+
+    httpd = None
+    url = None
+    if args.http:
+        httpd = serve(client, port=0)  # ephemeral port
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/generate"
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
 
     rng = np.random.default_rng(args.seed)
     max_prompt = min(
@@ -149,47 +184,144 @@ def main() -> None:
         temperature=args.temperature, seed=0, timeout=600,
     )
 
-    outputs = []
+    # per-request record: (output_tokens, ttft_ms, itls_ms); failures
+    # land in `errors` by type instead of vanishing from the stats
+    completed = []
+    errors = {"queue_full": 0, "engine_crash": 0, "deadline": 0,
+              "timeout": 0, "shutting_down": 0, "other": 0}
+    retries_total = [0]
     lock = threading.Lock()
     next_idx = [0]
 
-    def worker():
+    import random as _random
+
+    def _record_error(exc):
+        if isinstance(exc, QueueFullError):
+            errors["queue_full"] += 1
+        elif isinstance(exc, EngineCrashError):
+            errors["engine_crash"] += 1
+        elif isinstance(exc, DeadlineExceededError):
+            errors["deadline"] += 1
+        elif isinstance(exc, ShuttingDownError):
+            errors["shutting_down"] += 1
+        elif isinstance(exc, TimeoutError):
+            errors["timeout"] += 1
+        else:
+            errors["other"] += 1
+
+    def _record_http_503(body):
+        # the server types its 503s with a machine-readable "code"
+        # (serving/server.py handler) — never parse the human text
+        code = (body or {}).get("code", "")
+        if code == "shutting_down":
+            errors["shutting_down"] += 1
+        elif code in ("engine_crash", "engine_failed"):
+            errors["engine_crash"] += 1
+        elif code == "timeout":
+            errors["timeout"] += 1
+        elif code == "queue_full":
+            errors["queue_full"] += 1
+        else:
+            errors["other"] += 1
+
+    def worker(wid):
+        rng_w = _random.Random(args.seed * 1000 + wid)
         while True:
             with lock:
                 i = next_idx[0]
                 if i >= len(prompts):
                     return
                 next_idx[0] += 1
-            out = client.generate(
-                prompts[i], max_new_tokens=args.new_tokens,
-                temperature=args.temperature, seed=args.seed + i,
-                timeout=600,
-            )
-            with lock:
-                outputs.append(out)
+            if args.http:
+                try:
+                    status, body, retries = http_post_json_with_retries(
+                        url, {
+                            "prompt_ids": prompts[i],
+                            "max_new_tokens": args.new_tokens,
+                            "temperature": args.temperature,
+                            "seed": args.seed + i,
+                            "timeout": 600,
+                        },
+                        timeout=600, max_retries=args.max_retries,
+                        rng=rng_w,
+                    )
+                except OSError as e:  # transport dead past retry budget
+                    with lock:
+                        errors["other"] += 1
+                        retries_total[0] += getattr(
+                            e, "retry_attempts", 0)
+                    continue
+                with lock:
+                    retries_total[0] += retries
+                    if status == 200:
+                        # the HTTP payload carries TTFT but not the
+                        # per-token timestamps ITL needs
+                        completed.append(
+                            (len(body["tokens"]), body["ttft_ms"], [])
+                        )
+                    elif status == 503:
+                        _record_http_503(body)
+                    elif status == 504:
+                        errors["deadline"] += 1
+                    else:
+                        errors["other"] += 1
+            else:
+                try:
+                    out, retries = call_with_retries(
+                        lambda: client.generate(
+                            prompts[i], max_new_tokens=args.new_tokens,
+                            temperature=args.temperature,
+                            seed=args.seed + i, timeout=600,
+                        ),
+                        max_retries=args.max_retries,
+                        retriable=(QueueFullError, EngineCrashError),
+                        rng=rng_w,
+                    )
+                except Exception as e:
+                    with lock:
+                        _record_error(e)
+                        # attempts burned by an ultimately-failed
+                        # request still count as retries
+                        retries_total[0] += getattr(
+                            e, "retry_attempts", 0)
+                    continue
+                with lock:
+                    retries_total[0] += retries
+                    completed.append((
+                        len(out.tokens), out.ttft * 1e3,
+                        [itl * 1e3 for itl in out.itls],
+                    ))
 
     t0 = time.perf_counter()
     threads = [
-        threading.Thread(target=worker) for _ in range(args.clients)
+        threading.Thread(target=worker, args=(w,))
+        for w in range(args.clients)
     ]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+    if httpd is not None:
+        httpd.shutdown()
+        httpd.server_close()
     client.close()
 
-    out_tokens = sum(len(o.tokens) for o in outputs)
-    ttfts_ms = [o.ttft * 1e3 for o in outputs]
-    itls_ms = [itl * 1e3 for o in outputs for itl in o.itls]
+    out_tokens = sum(n for n, _, _ in completed)
+    ttfts_ms = [t for _, t, _ in completed]
+    itls_ms = [itl for _, _, itls in completed for itl in itls]
+    n_failed = sum(errors.values())
     line = {
         "metric": "serving_output_tokens_per_sec",
         "value": round(out_tokens / wall, 1),
         "unit": "tokens/sec",
-        "requests_per_sec": round(len(outputs) / wall, 3),
+        "requests_per_sec": round(len(completed) / wall, 3),
         "ttft_ms": _percentiles(ttfts_ms),
         "itl_ms": _percentiles(itls_ms),
-        "n_requests": len(outputs),
+        "n_requests": len(completed),
+        "errors": errors,
+        "retries": retries_total[0],
+        "failed": n_failed,
         "output_tokens": out_tokens,
         "wall_s": round(wall, 3),
         "model": model_cfg.model,
@@ -199,6 +331,7 @@ def main() -> None:
         "prefill_budget": serving.prefill_budget,
         "new_tokens": args.new_tokens,
         "prompt_len_range": [min_prompt, max_prompt],
+        "http": bool(args.http),
         "smoke": bool(args.smoke),
     }
     print(json.dumps(line))
@@ -207,12 +340,18 @@ def main() -> None:
             f.write(json.dumps(line) + "\n")
     print(
         f"[serve_bench] {model_cfg.model} slots={serving.num_slots} "
-        f"clients={args.clients} reqs={len(outputs)} wall={wall:.2f}s "
+        f"clients={args.clients} reqs={len(completed)} "
+        f"failed={n_failed} retries={retries_total[0]} wall={wall:.2f}s "
         f"out_tok/s={out_tokens / wall:.1f} "
         f"engine_stats={engine.stats} compiles={engine.compile_stats()}",
         file=sys.stderr,
     )
-    assert len(outputs) == args.requests, "some requests did not complete"
+    assert len(completed) + n_failed == args.requests, \
+        "some requests neither completed nor failed"
+    # without injected faults or an admission bound nothing should fail;
+    # a bounded queue may legitimately shed under closed-loop overload
+    if not args.max_queue_len and not args.deadline:
+        assert n_failed == 0, f"unexpected failures: {errors}"
 
 
 if __name__ == "__main__":
