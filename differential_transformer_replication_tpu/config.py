@@ -295,6 +295,18 @@ class TrainConfig:
     # into this directory (TensorBoard/Perfetto viewable); None = off.
     profile_dir: Optional[str] = None
 
+    # Observability (obs/; no reference analog).
+    # Prometheus sidecar: serve the trainer's metrics registry at
+    # http://0.0.0.0:<port>/metrics from a daemon thread (obs/http.py)
+    # so a scraper can watch a live run. 0 = off. Multi-process runs
+    # bind it on process 0 only.
+    metrics_port: int = 0
+    # Host-side span trace (obs/spans.py): write Chrome-trace-event JSON
+    # of the train loop (data_wait / dispatch / block spans per step;
+    # open in Perfetto) to this path. Complements profile_dir, which
+    # captures the DEVICE-side XLA timeline. None = off.
+    trace_path: Optional[str] = None
+
     # Logging (train.py:90-93)
     log_interval: int = 10
     wandb_project: str = "diff-transformer"

@@ -1,0 +1,51 @@
+"""Unified telemetry layer shared by training and serving.
+
+Three host-side pieces, each dependency-free (stdlib only):
+
+- :mod:`obs.registry` — a thread-safe metrics registry
+  (Counter/Gauge/Histogram with label support) plus a Prometheus
+  text-exposition writer. The serving server exposes it at
+  ``GET /metrics``; the trainer can serve it from a sidecar port
+  (``--metrics-port``).
+- :mod:`obs.spans` — a span tracer emitting Chrome-trace-event JSON
+  (open in Perfetto / ``chrome://tracing``) for the HOST side of a step:
+  data-wait vs. dispatch vs. blocking in the trainer, schedule/prefill/
+  decode/sample/emit in the serving engine. Complements the DEVICE-side
+  ``utils/profiling.py`` windows (XLA op timeline).
+- :mod:`obs.http` — a minimal stdlib HTTP exporter serving a registry's
+  exposition (the training sidecar; the serving server wires the same
+  rendering into its own handler).
+
+:mod:`obs.introspect` adds the paper-level window: a jitted-cheap
+summary op extracting per-layer effective lambda (the Differential
+Transformer's central learnable quantity) and per-layer-group param
+norms from a train state, logged into ``metrics.jsonl`` every eval
+interval (``tools/lambda_report.py`` renders the paper's
+lambda-evolution figure from any run's log).
+"""
+
+from differential_transformer_replication_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS_S,
+    Registry,
+)
+from differential_transformer_replication_tpu.obs.spans import (
+    NOOP_TRACER,
+    SpanTracer,
+)
+from differential_transformer_replication_tpu.obs.http import (
+    start_metrics_server,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LATENCY_BUCKETS_S",
+    "Registry",
+    "SpanTracer",
+    "NOOP_TRACER",
+    "start_metrics_server",
+]

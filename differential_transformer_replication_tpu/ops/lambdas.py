@@ -78,6 +78,31 @@ def ndiff_lambdas(
     return jnp.mean(e - prev + lambda_init, axis=-1)
 
 
+def effective_diff_lambda(attn_params: dict, layer_idx: int) -> jnp.ndarray:
+    """Scalar effective lambda of one diff-attention layer: the mean
+    over heads of :func:`diff_lambda` — the quantity the paper's
+    lambda-evolution figure tracks per layer (Ye et al., 2024, Fig. 8:
+    lambda starts at the init schedule and drifts as the lambda_q/k
+    vectors learn). ``layer_idx`` is 1-based, like the schedule."""
+    lam = diff_lambda(
+        attn_params["lambda_q"][0], attn_params["lambda_k"][0],
+        attn_params["lambda_q"][1], attn_params["lambda_k"][1],
+        lambda_init_schedule(layer_idx),
+    )  # (H,)
+    return jnp.mean(lam)
+
+
+def effective_ndiff_lambdas(attn_params: dict, layer_idx: int) -> jnp.ndarray:
+    """(n_terms,) effective lambdas of one ndiff layer: the mean over
+    heads of :func:`ndiff_lambdas` per term (term 0 has no subtraction;
+    see module docstring quirks)."""
+    lams = ndiff_lambdas(
+        attn_params["lambda_q"], attn_params["lambda_k"],
+        lambda_init_schedule(layer_idx),
+    )  # (n_terms, H)
+    return jnp.mean(lams, axis=-1)
+
+
 def ndiff_signs(n_terms: int) -> jnp.ndarray:
     """Alternating combination signs (Ndiff_transformer.py:119-123): the
     first map enters with ``+lambda_0`` (NOT coefficient 1 — this is why

@@ -57,6 +57,13 @@ def make_sharded_train_step(cfg: TrainConfig, mesh: Mesh, state_template: dict):
     def step(state: dict, batch: dict, rng=None):
         return jitted(state, batch, rng)
 
+    # surface the jit cache size through the wrapper so the trainer's
+    # compile-event counter (obs layer) works on sharded runs too;
+    # _cache_size is a private jit attribute — absent on some jax
+    # versions, and a missing METRIC must never break training setup
+    cache_size = getattr(jitted, "_cache_size", None)
+    if cache_size is not None:
+        step._cache_size = cache_size
     return step
 
 

@@ -40,6 +40,9 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence
 
+from differential_transformer_replication_tpu.obs.registry import (
+    CONTENT_TYPE as METRICS_CONTENT_TYPE,
+)
 from differential_transformer_replication_tpu.serving.engine import (
     EngineCrashError,
     ServingEngine,
@@ -60,6 +63,18 @@ class ShuttingDownError(RuntimeError):
     Retry-After so load balancers take the instance out of rotation."""
 
     retriable = True
+
+
+def _inc_stat(stats, key: str) -> None:
+    """Bump one engine stat from outside the engine thread. Real engines
+    carry a StatsMap whose ``inc`` is atomic (obs/registry.py); test
+    doubles with plain dicts fall back to ``+=`` (their callers hold the
+    runner lock, so the read-modify-write cannot tear)."""
+    inc = getattr(stats, "inc", None)
+    if inc is not None:
+        inc(key)
+    else:
+        stats[key] += 1
 
 
 class _Pending:
@@ -144,6 +159,17 @@ class EngineRunner:
         load balancer with other replicas should prefer them)."""
         return self.status() in ("healthy", "degraded")
 
+    def stats_snapshot(self) -> dict:
+        """Point-in-time engine stats for /health. Taken under the
+        runner lock AND through StatsMap.snapshot (per-counter locks),
+        so a snapshot never reads a counter mid-update from the engine
+        thread — the old ``dict(engine.stats)`` shallow copy could.
+        Plain-dict test doubles degrade to a locked dict() copy."""
+        with self._cond:
+            stats = self.engine.stats
+            snap = getattr(stats, "snapshot", None)
+            return snap() if snap is not None else dict(stats)
+
     # -- submission ----------------------------------------------------
 
     def submit(self, prompt: Sequence[int],
@@ -186,7 +212,7 @@ class EngineRunner:
             # timeouts must not cause spurious 503s for the next caller
             waiting = sum(1 for p in self._incoming if not p.cancelled)
             if maxq and waiting + self.engine.queue_len() >= maxq:
-                self.engine.stats["rejected"] += 1
+                _inc_stat(self.engine.stats, "rejected")
                 raise QueueFullError(
                     f"admission queue full ({maxq} waiting); retry later"
                 )
@@ -532,7 +558,14 @@ class ServingClient:
 
     @property
     def stats(self) -> dict:
-        return dict(self.runner.engine.stats)
+        return self.runner.stats_snapshot()
+
+    @property
+    def registry(self):
+        """The engine's metrics registry (obs/registry.py) — what the
+        HTTP server renders at GET /metrics; None on engines built
+        without one (test doubles)."""
+        return getattr(self.runner.engine, "registry", None)
 
     def status(self) -> str:
         return self.runner.status()
@@ -570,7 +603,18 @@ def _make_handler(client: ServingClient, tokenizer=None):
             return {"Retry-After": str(secs)}
 
         def do_GET(self):
-            if self.path == "/health":
+            if self.path == "/metrics":
+                registry = client.registry
+                if registry is None:
+                    self._reply(404, {"error": "no metrics registry"})
+                    return
+                body = registry.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", METRICS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/health":
                 status = client.status()
                 self._reply(200, {
                     "ok": status in ("healthy", "degraded"),
@@ -757,6 +801,10 @@ def main() -> None:
                    help="watchdog: mark the engine degraded on /health "
                         "when one decode iteration exceeds this many "
                         "seconds (0 = off)")
+    p.add_argument("--trace-path", default=None,
+                   help="write a Chrome-trace-event JSON of engine "
+                        "iterations (schedule/prefill/decode/sample/emit "
+                        "spans; open in Perfetto) to this path")
     args = p.parse_args()
 
     meta = None
@@ -804,7 +852,16 @@ def main() -> None:
         restart_backoff_max_s=args.restart_backoff_max,
         step_time_budget_s=args.step_time_budget,
     )
-    client = ServingClient(ServingEngine(params, model_cfg, serving))
+    tracer = None
+    if args.trace_path:
+        from differential_transformer_replication_tpu.obs.spans import (
+            SpanTracer,
+        )
+
+        tracer = SpanTracer(args.trace_path, process_name="serving-engine")
+    client = ServingClient(
+        ServingEngine(params, model_cfg, serving, tracer=tracer)
+    )
     httpd = serve(client, args.host, args.port, tokenizer)
 
     import signal
@@ -840,7 +897,8 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _graceful)
     print(
         f"[serve] {model_cfg.model} model, {serving.num_slots} slots — "
-        f"POST http://{args.host}:{args.port}/generate"
+        f"POST http://{args.host}:{args.port}/generate, metrics at "
+        f"GET http://{args.host}:{args.port}/metrics"
     )
     try:
         httpd.serve_forever()
@@ -850,6 +908,9 @@ def main() -> None:
         httpd.server_close()
         if not drained["done"]:
             client.close()
+        if tracer is not None:
+            tracer.close()
+            print(f"[serve] span trace written to {args.trace_path}")
 
 
 if __name__ == "__main__":

@@ -10,13 +10,27 @@ stdout prints in the same format, the same metric names and cadence
   - wandb (optional, only if installed and enabled — the reference hard
     -requires it, train.py:15,151).
 
-``gpu_memory`` keeps the reference's key name for drop-in dashboard
-compatibility but reports the accelerator's (TPU) allocated bytes in MB.
+Beyond the reference surface:
+  - every record carries ``ts`` (unix wall-clock seconds) so records
+    are joinable across restarts and supervisor relaunches,
+  - each logger writes a one-time ``run_header`` record (config hash,
+    jax version, device kind, process count) identifying the process
+    that produced the records that follow it — a resumed/relaunched run
+    appends a new header, so ``tools/metrics_report.py`` can segment
+    the stream by incarnation,
+  - ``gpu_memory`` keeps the reference's key name for drop-in dashboard
+    compatibility but reports the accelerator's allocated bytes in MB —
+    and is OMITTED (not logged as a misleading 0.0) on platforms
+    without memory stats (CPU),
+  - :meth:`MetricLogger.log_record` appends arbitrary typed records
+    (the obs/introspect.py lambda summaries ride this).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import time
 from typing import Optional
 
 import jax
@@ -24,14 +38,26 @@ import jax
 from differential_transformer_replication_tpu.config import TrainConfig
 
 
-def device_memory_mb() -> float:
+def device_memory_mb() -> Optional[float]:
     """Allocated device memory in MB (the reference logs
-    torch.cuda.memory_allocated/1024**2, train.py:293)."""
+    torch.cuda.memory_allocated/1024**2, train.py:293), or None when the
+    platform exposes no memory stats (CPU, some simulators) — callers
+    must OMIT the metric rather than log a misleading zero."""
     try:
         stats = jax.local_devices()[0].memory_stats()
-        return stats.get("bytes_in_use", 0) / 1024**2
     except Exception:
-        return 0.0
+        return None
+    if not stats or "bytes_in_use" not in stats:
+        return None
+    return stats["bytes_in_use"] / 1024**2
+
+
+def config_hash(cfg: TrainConfig) -> str:
+    """Stable short hash of the full recipe — the run identity key in
+    ``run_header`` records (two streams with the same hash are the same
+    experiment, whatever host/restart produced them)."""
+    blob = json.dumps(cfg.to_dict(), sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
 
 
 class MetricLogger:
@@ -46,6 +72,7 @@ class MetricLogger:
             return
         if cfg.metrics_path:
             self._jsonl = open(cfg.metrics_path, "a", buffering=1)
+            self._write_run_header()
         if cfg.use_wandb:
             try:
                 import wandb
@@ -59,6 +86,30 @@ class MetricLogger:
             except Exception as e:
                 print(f"[metrics] wandb unavailable ({type(e).__name__}); continuing without")
 
+    def _write_run_header(self) -> None:
+        """One identity record per logger lifetime (i.e. per process
+        incarnation): joins records across supervisor relaunches. JSONL
+        only — wandb carries the config natively via init."""
+        try:
+            device_kind = jax.local_devices()[0].device_kind
+        except Exception:
+            device_kind = "unknown"
+        header = {
+            "record": "run_header",
+            "ts": round(time.time(), 3),
+            "config_hash": config_hash(self.cfg),
+            "jax_version": jax.__version__,
+            "device_kind": device_kind,
+            "device_count": jax.device_count(),
+            "process_count": jax.process_count(),
+            "model": self.cfg.resolved_model().model,
+        }
+        self._jsonl.write(json.dumps(header) + "\n")
+
+    # sentinel: "the caller did not sample memory — query it here";
+    # distinct from None, which means "sampled and unavailable"
+    _QUERY_MEMORY = object()
+
     def log_step(
         self,
         iter_num: int,
@@ -66,12 +117,17 @@ class MetricLogger:
         lr: float,
         tokens_per_sec: Optional[float] = None,
         extra: Optional[dict] = None,
+        gpu_memory_mb=_QUERY_MEMORY,
     ) -> None:
         """Per-log_interval metrics (train.py:286-294), plus the natively
         measured tokens/sec the reference never recorded (SURVEY.md
         section 5.1; BASELINE.json north-star metric). ``extra`` carries
-        run-health counters (anomaly-guard skipped_steps/rollbacks,
-        trainer.py) into the same record."""
+        run-health fields — anomaly-guard skipped_steps/rollbacks, the
+        obs layer's step_time_ms/data_wait_frac/compile_events
+        (train/trainer.py) — into the same record. ``gpu_memory_mb``
+        lets a caller that already sampled :func:`device_memory_mb`
+        (the trainer does, for its watermark gauge) pass the SAME value
+        instead of paying a second memory_stats query per log."""
         if not self._primary:
             return
         print(f"iter {iter_num}: loss {loss:.4f}, lr {lr:.2e}")  # train.py:288
@@ -79,8 +135,13 @@ class MetricLogger:
             "iter": iter_num,
             "loss": loss,
             "learning_rate": lr,
-            "gpu_memory": device_memory_mb(),
         }
+        mem = (
+            device_memory_mb()
+            if gpu_memory_mb is MetricLogger._QUERY_MEMORY else gpu_memory_mb
+        )
+        if mem is not None:  # omitted, never a fake 0.0
+            payload["gpu_memory"] = mem
         if tokens_per_sec is not None:
             payload["tokens_per_sec"] = round(tokens_per_sec, 1)
         if extra:
@@ -96,7 +157,16 @@ class MetricLogger:
         )  # train.py:299
         self._emit({"iter": iter_num, "train_loss": train_loss, "val_loss": val_loss})
 
+    def log_record(self, payload: dict) -> None:
+        """Append one arbitrary record (e.g. ``{"record":
+        "introspection", ...}`` from obs/introspect.py). JSONL + wandb,
+        primary process only, ``ts`` added like every other record."""
+        if not self._primary:
+            return
+        self._emit(dict(payload))
+
     def _emit(self, payload: dict) -> None:
+        payload.setdefault("ts", round(time.time(), 3))
         if self._jsonl is not None:
             self._jsonl.write(json.dumps(payload) + "\n")
         if self._wandb is not None:

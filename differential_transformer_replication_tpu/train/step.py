@@ -124,10 +124,23 @@ def make_step_fn(cfg: TrainConfig, mesh=None):
             loss = loss_sum / n_micro
 
         grad_norm = optax.global_norm(grads)
+        # per-layer-group gradient norms ((L+2,): embed, blocks, head) —
+        # the observability layer logs them next to the per-layer lambdas
+        # every eval interval (obs/introspect.py). A handful of reduces
+        # over already-materialized grads; the vector stays on device
+        # unless the trainer actually fetches it.
+        from differential_transformer_replication_tpu.obs.introspect import (
+            group_norms,
+        )
+
+        gg = group_norms(grads)
         metrics = {
             "loss": loss,
             "learning_rate": schedule(state["step"]),
             "grad_norm": grad_norm,
+            "grad_norm_groups": jnp.concatenate([
+                gg["embed"][None], gg["blocks"], gg["head"][None]
+            ]),
         }
 
         def do_update():

@@ -30,7 +30,20 @@ from differential_transformer_replication_tpu.train.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
-from differential_transformer_replication_tpu.train.metrics import MetricLogger
+from differential_transformer_replication_tpu.obs import (
+    NOOP_TRACER,
+    Registry,
+    SpanTracer,
+    start_metrics_server,
+)
+from differential_transformer_replication_tpu.obs.introspect import (
+    lambda_record,
+    make_param_summary,
+)
+from differential_transformer_replication_tpu.train.metrics import (
+    MetricLogger,
+    device_memory_mb,
+)
 from differential_transformer_replication_tpu.utils import ProfilerWindow, Throughput
 from differential_transformer_replication_tpu.utils import faults
 from differential_transformer_replication_tpu.train.step import (
@@ -250,6 +263,55 @@ def train(cfg: TrainConfig) -> dict:
             )
 
     logger = MetricLogger(cfg)
+
+    # -- observability (obs/): registry + sidecar + host span tracer --
+    # The registry always exists (instrumentation is unconditional and
+    # cheap — a few lock-guarded float updates per iteration); the
+    # sidecar exporter and the Chrome span trace are opt-in knobs.
+    registry = Registry()
+    obs_step_hist = registry.histogram(
+        "train_step_seconds",
+        "Wall time of one train-loop iteration, host-observed "
+        "(data wait + dispatch + any blocking).",
+    )
+    obs_data_hist = registry.histogram(
+        "train_data_wait_seconds",
+        "Host time assembling the next batch before dispatch.",
+    )
+    obs_stall_gauge = registry.gauge(
+        "train_data_stall_ratio",
+        "Fraction of recent loop wall time spent waiting on data.",
+    )
+    obs_mem_gauge = registry.gauge(
+        "train_device_memory_peak_mb",
+        "High-water mark of allocated device memory (MB).",
+    )
+    obs_compile_counter = registry.counter(
+        "train_compile_events_total",
+        "Compilation-cache entries of the jitted train step "
+        "(steady state must stay at 1 — a growing count means "
+        "something retraces).",
+    )
+    obs_iter_counter = registry.counter(
+        "train_iterations_total", "Optimizer steps completed."
+    )
+    obs_anomaly_counter = registry.counter(
+        "train_anomaly_events_total",
+        "Anomaly-guard interventions (train/anomaly.py).",
+        labelnames=("kind",),
+    )
+    tracer = (
+        SpanTracer(cfg.trace_path, process_name="trainer")
+        if cfg.trace_path and is_primary() else NOOP_TRACER
+    )
+    metrics_server = None
+    if cfg.metrics_port > 0 and is_primary():
+        metrics_server = start_metrics_server(registry, cfg.metrics_port)
+        print(
+            f"[obs] Prometheus sidecar: "
+            f"http://0.0.0.0:{metrics_server.server_address[1]}/metrics"
+        )
+
     if cfg.mesh.pipeline > 1:
         # Pipeline-parallel path: GPipe schedule over the pipeline axis
         # (parallel/pipeline.py); eval runs through the same pipeline.
@@ -423,6 +485,29 @@ def train(cfg: TrainConfig) -> dict:
     model_cfg = cfg.resolved_model()
     use_dropout = model_cfg.dropout > 0.0
 
+    # Paper-level introspection (obs/introspect.py): jitted per-layer
+    # lambda + param-norm summary fetched every eval interval, so the
+    # lambda-evolution figure is reproducible from metrics.jsonl
+    # (tools/lambda_report.py). The pipeline path stacks params per
+    # stage — a layout the summary does not speak — so it is skipped
+    # there, like the anomaly guard.
+    param_summary = (
+        make_param_summary(model_cfg) if cfg.mesh.pipeline <= 1 else None
+    )
+
+    def _compile_entries():
+        """Compile-cache size of the jitted step (None when the step
+        wrapper does not expose one): steady state must hold at 1; a
+        growing count is the retrace pathology the zero-recompile pins
+        (tests/test_obs.py) guard against."""
+        cache_size = getattr(train_step, "_cache_size", None)
+        if cache_size is None:
+            return None
+        try:
+            return int(cache_size())
+        except Exception:
+            return None
+
     # Anomaly guard (train/anomaly.py): the jitted step skips bad
     # updates on-device; the host side here keeps a periodic good-state
     # snapshot, rolls back to it when badness persists, and aborts when
@@ -510,6 +595,15 @@ def train(cfg: TrainConfig) -> dict:
     # HBM (device-0-shard-sized on sharded runs).
     good_snapshot = snapshot_state(state) if guard_on else None
     snapshot_iter = iter_num
+    # per-log-interval telemetry accumulators (flushed into each
+    # log_step record's extra fields and the registry gauges)
+    obs_acc_step = obs_acc_data = 0.0
+    obs_acc_n = 0
+    # last observed in-state skip total: the Prometheus counter must
+    # only ever move by POSITIVE deltas (a rollback rewinds the guard
+    # state — and with it metrics["skipped"] — but an exported counter
+    # that decreases reads as a process restart to rate()/increase())
+    obs_prev_skipped = 0
     try:
         while iter_num < cfg.max_iters:
             if _agreed_stop(iter_num):
@@ -524,7 +618,10 @@ def train(cfg: TrainConfig) -> dict:
                 leaves, treedef = jax.tree_util.tree_flatten(state["params"])
                 leaves[0] = leaves[0] * jnp.float32(jnp.nan)
                 state["params"] = jax.tree_util.tree_unflatten(treedef, leaves)
-            batch = draw_batch()
+            t_iter = time.perf_counter()
+            with tracer.span("data_wait", iter=iter_num):
+                batch = draw_batch()
+            data_wait = time.perf_counter() - t_iter
             if nan_fault_armed:
                 # present in EVERY batch while armed, so the compiled
                 # step's input structure never changes (train/step.py)
@@ -533,7 +630,8 @@ def train(cfg: TrainConfig) -> dict:
                     (cfg.grad_acc_steps,), scale, np.float32
                 )
             rng = jax.random.fold_in(dropout_key, iter_num) if use_dropout else None
-            state, metrics = train_step(state, batch, rng)
+            with tracer.span("dispatch", iter=iter_num):
+                state, metrics = train_step(state, batch, rng)
             iter_num += 1
             profiler.step(iter_num, sync=metrics["loss"])
             tokens_seen += cfg.micro_batch_size * cfg.grad_acc_steps * model_cfg.block_size
@@ -544,7 +642,8 @@ def train(cfg: TrainConfig) -> dict:
                 # .py), so rollback/abort decisions agree with no
                 # collective. This blocks on the step's completion —
                 # anomaly_check_interval amortizes that pipeline bubble.
-                streak = int(jax.device_get(metrics["bad_streak"]))
+                with tracer.span("block", what="anomaly_streak"):
+                    streak = int(jax.device_get(metrics["bad_streak"]))
                 if streak == 0:
                     if iter_num - snapshot_iter >= cfg.anomaly_snapshot_interval:
                         good_snapshot = snapshot_state(state)
@@ -580,27 +679,83 @@ def train(cfg: TrainConfig) -> dict:
                         perm.epoch, perm.cursor = divmod(consumed, len(train_ds))
                     continue
 
+            # host-observed iteration accounting: wall time of the whole
+            # loop body (dispatch-pipelined, so this is NOT device step
+            # time — it is what the user waits for) and the data-wait
+            # share of it. A rolled-back iteration skips this (its work
+            # was discarded with the state).
+            step_wall = time.perf_counter() - t_iter
+            obs_step_hist.observe(step_wall)
+            obs_data_hist.observe(data_wait)
+            obs_iter_counter.inc()
+            obs_acc_step += step_wall
+            obs_acc_data += data_wait
+            obs_acc_n += 1
+
             if iter_num % cfg.log_interval == 0:
-                extra = None
-                if guard_on:
-                    extra = {
-                        "skipped_steps": int(metrics["skipped"]),
-                        "rollbacks": rollbacks,
-                    }
+                extra = {}
+                with tracer.span("block", what="log_metrics"):
+                    loss_f = float(metrics["loss"])
+                    lr_f = float(metrics["learning_rate"])
+                    if guard_on:
+                        skipped = int(metrics["skipped"])
+                        extra["skipped_steps"] = skipped
+                        extra["rollbacks"] = rollbacks
+                        if skipped > obs_prev_skipped:
+                            obs_anomaly_counter.inc(
+                                skipped - obs_prev_skipped, kind="skip"
+                            )
+                        # after a rollback the in-state total rewinds;
+                        # re-base so replayed skips count as new events
+                        obs_prev_skipped = skipped
+                        # host-side `rollbacks` is monotone by
+                        # construction, so set() cannot decrease it
+                        obs_anomaly_counter.set(rollbacks, kind="rollback")
+                n = max(obs_acc_n, 1)
+                extra["step_time_ms"] = round(1e3 * obs_acc_step / n, 3)
+                extra["data_wait_frac"] = round(
+                    obs_acc_data / max(obs_acc_step, 1e-9), 4
+                )
+                obs_stall_gauge.set(extra["data_wait_frac"])
+                compiles = _compile_entries()
+                if compiles is not None:
+                    obs_compile_counter.set(compiles)
+                    extra["compile_events"] = compiles
+                mem = device_memory_mb()  # one query: gauge + record
+                if mem is not None:
+                    obs_mem_gauge.set_max(mem)
+                obs_acc_step = obs_acc_data = 0.0
+                obs_acc_n = 0
                 logger.log_step(
-                    iter_num,
-                    float(metrics["loss"]),
-                    float(metrics["learning_rate"]),
+                    iter_num, loss_f, lr_f,
                     tokens_per_sec=throughput.update(tokens_seen),
-                    extra=extra,
+                    extra=extra, gpu_memory_mb=mem,
                 )
 
             if iter_num % cfg.eval_interval == 0:
-                losses = estimate_loss(
-                    eval_many, state["params"], train_ds, val_ds, cfg, eval_rng,
-                    materialize=_materialize,
-                )
+                with tracer.span("eval", iter=iter_num):
+                    losses = estimate_loss(
+                        eval_many, state["params"], train_ds, val_ds, cfg,
+                        eval_rng, materialize=_materialize,
+                    )
                 logger.log_eval(iter_num, losses["train"], losses["val"])
+                if param_summary is not None:
+                    # the lambda-evolution + per-group-norm record (see
+                    # obs/introspect.py): control contributes norms only,
+                    # diff one lambda per layer, ndiff one per term per
+                    # layer — the acceptance contract
+                    with tracer.span("block", what="introspection"):
+                        summ = jax.device_get(param_summary(state["params"]))
+                        gnorm = (
+                            None if metrics is None
+                            else jax.device_get(
+                                metrics.get("grad_norm_groups")
+                            )
+                        )
+                    logger.log_record({
+                        "record": "introspection", "iter": iter_num,
+                        **lambda_record(summ, model_cfg, grad_norms=gnorm),
+                    })
                 if losses["val"] < best_val_loss:  # train.py:307-317
                     best_val_loss = losses["val"]
                     if is_primary():
@@ -655,7 +810,18 @@ def train(cfg: TrainConfig) -> dict:
         # these closes must not derail the rescue logic below, and above
         # all must not derail it ASYMMETRICALLY across ranks (a flush
         # error on one host only), so they are contained here
-        for closer in (profiler.close, logger.finish):
+        def _stop_metrics_server():
+            if metrics_server is not None:
+                metrics_server.shutdown()
+                metrics_server.server_close()
+
+        def _close_tracer():
+            tracer.close()
+            if tracer.path:
+                print(f"[obs] span trace written to {tracer.path}")
+
+        for closer in (profiler.close, logger.finish, _close_tracer,
+                       _stop_metrics_server):
             try:
                 closer()
             except Exception as e:  # noqa: BLE001

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Render the lambda-evolution figure from a run's metrics.jsonl.
+
+The Differential Transformer paper tracks the per-layer effective
+lambda — the learned weight on the subtracted attention map — as it
+drifts from its ``0.8 - 0.6*exp(-0.3*(l-1))`` init schedule over
+training. The trainer logs exactly that every eval interval
+(``{"record": "introspection", "iter": N, "lambda_l<k>[...]": v}``
+records, obs/introspect.py), so the figure is reproducible from ANY
+run's metrics.jsonl::
+
+    python tools/lambda_report.py metrics.jsonl --out lambda_evolution.png
+
+Diff runs plot one curve per layer; ndiff runs one per (layer, term);
+control runs carry no lambdas (the tool says so and exits 0 — absence
+is the expected answer there, not an error). With matplotlib missing
+(or ``--ascii``) the series print as a text table instead, so the tool
+works on bare metal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from collections import defaultdict
+
+_LAMBDA_KEY = re.compile(r"^lambda_l(\d+)(?:_t(\d+))?$")
+
+
+def load_series(path: str):
+    """{(layer, term|None): [(iter, value), ...]} plus the init values
+    {(layer, term|None): lambda_init}; term is None for diff runs."""
+    series = defaultdict(list)
+    inits = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a killed run
+            if rec.get("record") != "introspection":
+                continue
+            it = rec.get("iter", 0)
+            for key, val in rec.items():
+                m = _LAMBDA_KEY.match(key)
+                if not m:
+                    continue
+                layer = int(m.group(1))
+                term = int(m.group(2)) if m.group(2) is not None else None
+                series[(layer, term)].append((it, float(val)))
+                init = rec.get(f"lambda_init_l{layer}")
+                if init is not None:
+                    inits[(layer, term)] = float(init)
+    return dict(series), inits
+
+
+def _label(layer: int, term) -> str:
+    return f"L{layer}" if term is None else f"L{layer} t{term}"
+
+
+def render_ascii(series, inits, width: int = 64) -> str:
+    lines = ["lambda evolution (rows: layer[/term]; columns: eval points)"]
+    for key in sorted(series):
+        pts = sorted(series[key])
+        vals = [v for _, v in pts]
+        init = inits.get(key)
+        head = f"{_label(*key):>8s} init={init:.4f}" if init is not None \
+            else f"{_label(*key):>8s}"
+        shown = vals[-12:]
+        lines.append(
+            head + " | " + " ".join(f"{v:.4f}" for v in shown)
+            + (f"  (last iter {pts[-1][0]})" if pts else "")
+        )
+    return "\n".join(lines)
+
+
+def render_png(series, inits, out: str) -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for key in sorted(series):
+        pts = sorted(series[key])
+        xs = [i for i, _ in pts]
+        ys = [v for _, v in pts]
+        (line,) = ax.plot(xs, ys, marker="o", markersize=2.5,
+                          linewidth=1.2, label=_label(*key))
+        init = inits.get(key)
+        if init is not None:
+            ax.axhline(init, color=line.get_color(), linestyle=":",
+                       linewidth=0.7, alpha=0.5)
+    ax.set_xlabel("iteration")
+    ax.set_ylabel("effective λ (head mean)")
+    ax.set_title("λ evolution (dotted: init schedule)")
+    ax.legend(fontsize=7, ncols=2)
+    fig.tight_layout()
+    fig.savefig(out, dpi=150)
+    print(f"wrote {out}")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("metrics", help="path to a run's metrics.jsonl")
+    p.add_argument("--out", default=None,
+                   help="output PNG path (default: <metrics>.lambda.png)")
+    p.add_argument("--ascii", action="store_true",
+                   help="print a text table instead of writing a PNG")
+    args = p.parse_args()
+
+    series, inits = load_series(args.metrics)
+    if not series:
+        print(
+            "no lambda records found — a control-family run logs none "
+            "(no differential attention), or the run predates the "
+            "introspection records (obs/introspect.py)"
+        )
+        return 0
+    if args.ascii:
+        print(render_ascii(series, inits))
+        return 0
+    try:
+        render_png(series, inits, args.out or f"{args.metrics}.lambda.png")
+    except ImportError:
+        print("matplotlib unavailable; falling back to --ascii output\n")
+        print(render_ascii(series, inits))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Summarize a run's metrics.jsonl; optionally gate on it (``--check``).
+
+Reads the trainer's JSONL stream (train/metrics.py) and prints ONE JSON
+summary line — loss trajectory, step-time percentiles, data-stall
+fraction, anomaly-guard totals, throughput — so a post-run script (or a
+human) gets the health of a run without scraping stdout::
+
+    python tools/metrics_report.py metrics.jsonl
+    python tools/metrics_report.py metrics.jsonl --check \
+        --max-stall-frac 0.5 --require-loss-decrease
+
+``--check`` exits non-zero (listing every violated gate on stderr) when
+the run looks unhealthy: non-finite losses, loss not decreasing, too
+much data stall, too many guard skips/rollbacks. Restart-aware: the
+stream may contain multiple ``run_header`` records (supervisor
+relaunches append); the summary covers the whole stream and reports the
+incarnation count.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+
+def _percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, max(0, int(round(q / 100 * (len(xs) - 1)))))
+    return xs[idx]
+
+
+def load(path: str) -> dict:
+    headers, steps, evals, intro = [], [], [], []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a killed run
+            kind = rec.get("record")
+            if kind == "run_header":
+                headers.append(rec)
+            elif kind == "introspection":
+                intro.append(rec)
+            elif "val_loss" in rec:
+                evals.append(rec)
+            elif "loss" in rec:
+                steps.append(rec)
+    return {"headers": headers, "steps": steps, "evals": evals,
+            "intro": intro}
+
+
+def summarize(recs: dict) -> dict:
+    steps, evals = recs["steps"], recs["evals"]
+    losses = [r["loss"] for r in steps]
+    step_ms = [r["step_time_ms"] for r in steps if "step_time_ms" in r]
+    stall = [r["data_wait_frac"] for r in steps if "data_wait_frac" in r]
+    tps = [r["tokens_per_sec"] for r in steps if "tokens_per_sec" in r]
+    out = {
+        "run_headers": len(recs["headers"]),
+        "config_hashes": sorted(
+            {h.get("config_hash") for h in recs["headers"]} - {None}
+        ),
+        "step_records": len(steps),
+        "eval_records": len(evals),
+        "introspection_records": len(recs["intro"]),
+    }
+    if losses:
+        out["loss_first"] = losses[0]
+        out["loss_last"] = losses[-1]
+        out["loss_min"] = min(losses)
+        out["loss_all_finite"] = all(math.isfinite(v) for v in losses)
+    if evals:
+        out["val_loss_last"] = evals[-1]["val_loss"]
+        out["val_loss_best"] = min(r["val_loss"] for r in evals)
+    if step_ms:
+        out["step_time_ms_p50"] = _percentile(step_ms, 50)
+        out["step_time_ms_p95"] = _percentile(step_ms, 95)
+        out["step_time_ms_p99"] = _percentile(step_ms, 99)
+    if stall:
+        out["data_stall_frac_mean"] = round(sum(stall) / len(stall), 4)
+    if tps:
+        out["tokens_per_sec_mean"] = round(sum(tps) / len(tps), 1)
+    skips = [r["skipped_steps"] for r in steps if "skipped_steps" in r]
+    rolls = [r["rollbacks"] for r in steps if "rollbacks" in r]
+    if skips:
+        out["skipped_steps_total"] = skips[-1]  # cumulative counter
+    if rolls:
+        out["rollbacks_total"] = rolls[-1]
+    compiles = [r["compile_events"] for r in steps if "compile_events" in r]
+    if compiles:
+        out["compile_events_last"] = compiles[-1]
+    return out
+
+
+def check(summary: dict, args) -> list:
+    """Gate violations; empty = healthy."""
+    bad = []
+    if summary["step_records"] == 0:
+        bad.append("no step records found")
+        return bad
+    if not summary.get("loss_all_finite", True):
+        bad.append("non-finite loss values in the stream")
+    if args.require_loss_decrease and summary.get("loss_last", 0) >= \
+            summary.get("loss_first", 0):
+        bad.append(
+            f"loss did not decrease ({summary.get('loss_first')} -> "
+            f"{summary.get('loss_last')})"
+        )
+    stall = summary.get("data_stall_frac_mean")
+    if stall is not None and stall > args.max_stall_frac:
+        bad.append(
+            f"data stall fraction {stall} > {args.max_stall_frac} "
+            "(input pipeline is starving the device)"
+        )
+    if summary.get("skipped_steps_total", 0) > args.max_skipped:
+        bad.append(
+            f"{summary['skipped_steps_total']} anomaly-guard skips > "
+            f"{args.max_skipped}"
+        )
+    if summary.get("rollbacks_total", 0) > args.max_rollbacks:
+        bad.append(
+            f"{summary['rollbacks_total']} rollbacks > {args.max_rollbacks}"
+        )
+    if args.max_compile_events and summary.get(
+        "compile_events_last", 0
+    ) > args.max_compile_events:
+        bad.append(
+            f"{summary['compile_events_last']} train-step compile "
+            f"entries > {args.max_compile_events} (retrace pathology)"
+        )
+    return bad
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("metrics", help="path to a run's metrics.jsonl")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when any health gate fails")
+    p.add_argument("--require-loss-decrease", action="store_true",
+                   help="gate: last logged loss must be below the first")
+    p.add_argument("--max-stall-frac", type=float, default=0.9,
+                   help="gate: mean data_wait_frac ceiling")
+    p.add_argument("--max-skipped", type=int, default=0,
+                   help="gate: anomaly-guard skipped-step budget")
+    p.add_argument("--max-rollbacks", type=int, default=0,
+                   help="gate: anomaly-guard rollback budget")
+    p.add_argument("--max-compile-events", type=int, default=0,
+                   help="gate: train-step compile-cache ceiling "
+                        "(0 = gate off; steady state is 1)")
+    args = p.parse_args()
+
+    summary = summarize(load(args.metrics))
+    print(json.dumps(summary))
+    if args.check:
+        bad = check(summary, args)
+        for b in bad:
+            print(f"CHECK FAILED: {b}", file=sys.stderr)
+        return 1 if bad else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "'sigkill@120,nan@50-52' (utils/faults.py; also "
                         "via the DTX_FAULTS env var)")
     p.add_argument("--metrics-path", default=t.metrics_path)
+    p.add_argument("--metrics-port", type=int, default=t.metrics_port,
+                   help="serve the trainer's Prometheus registry at "
+                        "http://0.0.0.0:PORT/metrics from a sidecar "
+                        "thread (obs/http.py); 0 = off")
+    p.add_argument("--trace-path", default=t.trace_path,
+                   help="write a Chrome-trace-event JSON of the train "
+                        "loop's host spans (data_wait/dispatch/block; "
+                        "open in Perfetto) to this path")
     p.add_argument("--wandb", action="store_true", help="enable the wandb sink")
     p.add_argument(
         "--profile-dir", default=None,
@@ -178,6 +186,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         anomaly_check_interval=args.anomaly_check_interval,
         faults=args.faults,
         metrics_path=args.metrics_path,
+        metrics_port=args.metrics_port,
+        trace_path=args.trace_path,
         use_wandb=args.wandb,
         profile_dir=args.profile_dir,
     )
