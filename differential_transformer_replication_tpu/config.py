@@ -336,6 +336,32 @@ class TrainConfig:
     # tunneled chip: a recipe-scale state write costs ~3 min).
     checkpoint_min_interval_s: float = 0.0
 
+    # Durable rotating step checkpoints (train/ckpt_writer.py). Every
+    # ckpt_interval iterations the trainer snapshots the full train
+    # state into `<ckpt_dir>/step-NNNNNNNN/`, certified by a per-file
+    # SHA-256 manifest written last (its presence = the save completed;
+    # loads re-verify digests, so corruption is never silently
+    # resumed). 0 = off (best/last checkpoints still written and still
+    # manifest-certified).
+    ckpt_interval: int = 0
+    # Root of the step-checkpoint tree. "auto" derives
+    # `<checkpoint_path stem>.steps` so concurrent runs in one
+    # directory never share a rotation tree.
+    ckpt_dir: str = "auto"
+    # Write step checkpoints from a background writer thread: the train
+    # loop blocks only for the device->host snapshot; serialization,
+    # file I/O, certification and retention GC run off-loop. If a save
+    # is still in flight at the next interval the loop blocks until it
+    # drains (back-pressure; the blocked time is the ckpt_blocked
+    # histogram in obs/). False = write inline (the loop stalls for the
+    # full save).
+    ckpt_async: bool = True
+    # Retention: keep the newest N verified step checkpoints...
+    ckpt_keep_last: int = 3
+    # ...plus every checkpoint whose step is a multiple of this,
+    # forever (0 = none) — the cheap long-horizon audit trail.
+    ckpt_keep_every: int = 0
+
     # Fault tolerance (train/anomaly.py; no reference analog). The
     # anomaly guard computes a per-step ``bad`` flag (non-finite
     # loss/grad-norm, or grad-norm above spike_factor x a running EMA of
@@ -381,6 +407,17 @@ class TrainConfig:
 
         root, ext = os.path.splitext(self.checkpoint_path)
         return f"{root}.last{ext or '.ckpt'}"
+
+    def resolved_ckpt_dir(self) -> str:
+        """Root of the rotating step-checkpoint tree
+        (train/ckpt_writer.py); "auto" keys it off checkpoint_path like
+        the rescue checkpoint, so runs never share a rotation tree."""
+        if self.ckpt_dir != "auto":
+            return self.ckpt_dir
+        import os
+
+        root, _ = os.path.splitext(self.checkpoint_path)
+        return f"{root}.steps"
 
     seed: int = 1337  # train.py:329-330
 

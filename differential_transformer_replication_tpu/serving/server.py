@@ -805,6 +805,11 @@ def main() -> None:
                    help="write a Chrome-trace-event JSON of engine "
                         "iterations (schedule/prefill/decode/sample/emit "
                         "spans; open in Perfetto) to this path")
+    p.add_argument("--no-verify-checkpoint", action="store_true",
+                   help="skip integrity-manifest verification of "
+                        "--checkpoint (needed for pre-manifest "
+                        "checkpoints; or certify them once with "
+                        "tools/ckpt_doctor.py --adopt-legacy)")
     args = p.parse_args()
 
     meta = None
@@ -813,7 +818,9 @@ def main() -> None:
             load_params_for_inference,
         )
 
-        params, model_cfg, meta = load_params_for_inference(args.checkpoint)
+        params, model_cfg, meta = load_params_for_inference(
+            args.checkpoint, verify=not args.no_verify_checkpoint
+        )
     else:
         from differential_transformer_replication_tpu.models import init_model
 

@@ -14,11 +14,15 @@ from differential_transformer_replication_tpu.train.step import (
     make_train_step,
 )
 from differential_transformer_replication_tpu.train.checkpoint import (
+    AsyncCheckpointWriter,
     CheckpointError,
     from_pretrained,
     load_checkpoint,
+    resolve_resume_auto,
     save_checkpoint,
     save_pretrained,
+    save_step_checkpoint,
+    verify_checkpoint,
 )
 from differential_transformer_replication_tpu.train.metrics import MetricLogger
 from differential_transformer_replication_tpu.train.trainer import (
@@ -38,8 +42,12 @@ __all__ = [
     "make_eval_step",
     "make_multi_train_step",
     "make_train_step",
+    "AsyncCheckpointWriter",
     "save_checkpoint",
+    "save_step_checkpoint",
     "load_checkpoint",
+    "resolve_resume_auto",
+    "verify_checkpoint",
     "save_pretrained",
     "from_pretrained",
     "MetricLogger",

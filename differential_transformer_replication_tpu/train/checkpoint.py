@@ -11,7 +11,11 @@ Two formats, generalizing the reference's pair (SURVEY.md section 3.5):
    the N-diff-only implementation (Ndiff_transformer.py:243-265).
 
 Serialization is flax msgpack (pytree-shaped, framework-native) in a
-checkpoint directory: ``state.msgpack`` + ``meta.json``.
+checkpoint directory: ``state.msgpack`` + ``meta.json`` +
+``manifest.json`` (per-file SHA-256 integrity manifest, written LAST —
+its presence certifies the checkpoint; train/ckpt_writer.py holds the
+durability machinery: atomic fsynced writes, verification, ``step-*``
+rotation with retention GC, and the async writer thread).
 """
 
 from __future__ import annotations
@@ -19,7 +23,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 from flax import serialization
@@ -27,13 +31,36 @@ from flax import serialization
 from differential_transformer_replication_tpu.config import ModelConfig, TrainConfig
 from differential_transformer_replication_tpu.models import common, init_model
 from differential_transformer_replication_tpu.utils import faults
+from differential_transformer_replication_tpu.train.ckpt_writer import (
+    AsyncCheckpointWriter,
+    CheckpointError,
+    atomic_write,
+    gc_step_checkpoints,
+    list_step_checkpoints,
+    read_manifest,
+    step_dir_name,
+    verify_checkpoint,
+    write_manifest,
+)
 
+__all__ = [
+    "AsyncCheckpointWriter",
+    "CheckpointError",
+    "canonicalize_state",
+    "from_pretrained",
+    "load_checkpoint",
+    "load_params_for_inference",
+    "read_meta",
+    "resolve_resume_auto",
+    "save_checkpoint",
+    "save_pretrained",
+    "save_step_checkpoint",
+    "verify_checkpoint",
+]
 
-class CheckpointError(RuntimeError):
-    """A checkpoint on disk cannot be read: truncated/corrupt file or a
-    layout from an incompatible run. Always names the offending path —
-    the actionable signal (delete or re-point) a deep msgpack/KeyError
-    traceback buries."""
+# legacy alias: the atomic write grew directory fsyncs and fault points
+# and moved to ckpt_writer.py, where the jax-free tools can reach it
+_atomic_write = atomic_write
 
 
 def _map_blocks(tree, fn):
@@ -105,14 +132,29 @@ def save_checkpoint(
     state = gather_to_host(state)
     if not is_primary():
         return
-    # the anomaly-guard scalars (train/anomaly.py) are run-local health
-    # state, not model state: strip them so the on-disk format is
-    # identical with the guard on or off, and old checkpoints keep
-    # loading (load_checkpoint re-seeds a fresh guard from the target)
+    state = _host_checkpoint_state(state, cfg)
+    _write_checkpoint_dir(
+        path, state, _checkpoint_meta(state, best_val_loss, cfg,
+                                      tokenizer_fingerprint)
+    )
+
+
+def _host_checkpoint_state(state: dict, cfg: TrainConfig) -> dict:
+    """Host-gathered state -> the canonical on-disk pytree: the
+    anomaly-guard scalars (train/anomaly.py) are run-local health state,
+    not model state — stripped so the format is identical with the
+    guard on or off (load_checkpoint re-seeds a fresh guard from the
+    target) — and pipeline stage-stacked layouts are canonicalized."""
     state = {k: v for k, v in state.items() if k != "guard"}
-    os.makedirs(path, exist_ok=True)
     if _is_stacked(state):
         state = canonicalize_state(state, cfg.resolved_model().n_layer)
+    return state
+
+
+def _checkpoint_meta(
+    state: dict, best_val_loss: float, cfg: TrainConfig,
+    tokenizer_fingerprint: Optional[str],
+) -> dict:
     meta = {
         "best_val_loss": float(best_val_loss),
         "iter_num": int(state["step"]),
@@ -122,44 +164,153 @@ def save_checkpoint(
         # lets downstream tools (sample.py, tools/attn_probe.py) verify
         # tokenizer CONTENT, not just vocab size (data/tokenizer.py)
         meta["tokenizer_fingerprint"] = tokenizer_fingerprint
-    # Write-then-rename so a crash mid-save (preemption) never destroys the
-    # previous good checkpoint.
-    _atomic_write(os.path.join(path, "state.msgpack"), serialization.to_bytes(state))
-    _atomic_write(
+    return meta
+
+
+def _write_checkpoint_dir(path: str, state: dict, meta: dict) -> None:
+    """Serialize + write one certified checkpoint directory. Every file
+    lands atomically (write temp, fsync, rename, fsync dir —
+    ckpt_writer.atomic_write) so a crash mid-save never destroys a
+    previous good checkpoint; the integrity manifest goes LAST so an
+    interrupted save leaves an UNcertified dir that verification-aware
+    readers (load_checkpoint, latest resolution, --resume-from auto)
+    skip. Runs on the async writer thread for periodic step
+    checkpoints, inline for best/last saves."""
+    os.makedirs(path, exist_ok=True)
+    atomic_write(
+        os.path.join(path, "state.msgpack"), serialization.to_bytes(state)
+    )
+    atomic_write(
         os.path.join(path, "meta.json"), json.dumps(meta, indent=1).encode()
+    )
+    write_manifest(
+        path, step=meta["iter_num"], config_hash=_config_hash(meta)
     )
 
 
-def _atomic_write(dest: str, data: bytes) -> None:
-    tmp = dest + ".tmp"
-    try:
-        with open(tmp, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        # injection point for the chaos tests (utils/faults.py
-        # "ckpt_write"): a crash HERE — temp fully written, rename not
-        # yet done — is exactly the window this function must survive;
-        # the previous ``dest`` stays intact
-        faults.check("ckpt_write")
-        os.replace(tmp, dest)
-    except BaseException:
+def _config_hash(meta: dict) -> Optional[str]:
+    """Same recipe hash as train/metrics.py:config_hash (the meta's
+    ``config`` IS cfg.to_dict()), recorded in the manifest so two
+    checkpoint trees are attributable to the same experiment without
+    deserializing anything."""
+    cfg = meta.get("config")
+    if not isinstance(cfg, dict):
+        return None
+    import hashlib
+
+    blob = json.dumps(cfg, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def save_step_checkpoint(
+    root: str,
+    state: dict,
+    best_val_loss: float,
+    cfg: TrainConfig,
+    tokenizer_fingerprint: Optional[str] = None,
+    writer: Optional[AsyncCheckpointWriter] = None,
+    keep_last: int = 3,
+    keep_every: int = 0,
+) -> float:
+    """One rotating periodic checkpoint: ``<root>/step-NNNNNNNN``,
+    certified by its manifest, followed by retention GC (keep the
+    newest ``keep_last`` verified + every ``keep_every``-th step).
+
+    Multi-process safe like :func:`save_checkpoint`: EVERY process must
+    call it (the host gather is a collective); only the primary touches
+    the filesystem. With a ``writer`` the caller thread pays only the
+    device->host snapshot (the gather) — serialization, file I/O,
+    certification and GC run on the writer thread, and the return value
+    is the back-pressure wall time spent waiting for a still-in-flight
+    previous save (0.0 when idle, or always in sync mode)."""
+    from differential_transformer_replication_tpu.parallel.multihost import (
+        gather_to_host,
+        is_primary,
+    )
+
+    state = gather_to_host(state)  # collective; host-resident numpy out
+    if not is_primary():
+        return 0.0
+    state = _host_checkpoint_state(state, cfg)
+    path = os.path.join(root, step_dir_name(int(state["step"])))
+    meta = _checkpoint_meta(state, best_val_loss, cfg, tokenizer_fingerprint)
+
+    def job() -> None:
+        # chaos stall point (utils/faults.py "ckpt_hang"): a slow disk.
+        # Runs INSIDE the job so an async save stalls on the writer
+        # thread — the loop must keep stepping and the next submit must
+        # exercise back-pressure (tests/test_ckpt.py)
+        faults.stall("ckpt_hang")
+        _write_checkpoint_dir(path, state, meta)
+        gc_step_checkpoints(root, keep_last=keep_last, keep_every=keep_every)
+
+    if writer is None:
+        job()
+        return 0.0
+    return writer.submit(job)
+
+
+def resolve_resume_auto(
+    cfg: TrainConfig,
+) -> Tuple[Optional[str], List[Tuple[str, str]]]:
+    """``--resume-from auto``: the newest checkpoint (by recorded step)
+    that PASSES manifest verification, among the run's rotating
+    ``step-*`` tree, its rescue last-checkpoint and its best
+    checkpoint — falling back to older ones, so a crash mid-save can
+    never wedge the restart loop. Returns ``(path_or_None, skipped)``
+    where ``skipped`` lists ``(path, reason)`` for every candidate that
+    failed a check before the winner was found (fed to the
+    ``ckpt_verify_failures`` counter); candidates older than the
+    winner are not audited."""
+    candidates = [p for _, p in list_step_checkpoints(cfg.resolved_ckpt_dir())]
+    for path in (cfg.resolved_last_checkpoint_path(), cfg.checkpoint_path):
+        if path and os.path.isdir(path):
+            candidates.append(path)
+    # order by recorded step from a CHEAP manifest read (no hashing),
+    # then verify digests newest-first and stop at the first pass — a
+    # large keep_every audit trail must not turn every restart into a
+    # full-tree re-hash. Stable sort: at equal steps the step-dir wins
+    # over last/best (candidate insertion order).
+    ordered: List[Tuple[int, int, str]] = []
+    skipped: List[Tuple[str, str]] = []
+    for i, path in enumerate(candidates):
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+            step = int(read_manifest(path).get("step", -1))
+        except CheckpointError as e:
+            skipped.append((path, str(e)))
+            continue
+        ordered.append((step, -i, path))
+    for _, _, path in sorted(ordered, reverse=True):
+        try:
+            verify_checkpoint(path)
+            return path, skipped
+        except CheckpointError as e:
+            skipped.append((path, str(e)))
+    return None, skipped
 
 
-def load_checkpoint(path: str, cfg: TrainConfig, target_state: dict) -> Tuple[dict, float]:
+def load_checkpoint(
+    path: str, cfg: TrainConfig, target_state: dict, verify: bool = True,
+) -> Tuple[dict, float]:
     """Restore (state, best_val_loss). ``target_state`` supplies the pytree
     structure (create_train_state output). A stage-stacked target (pipeline
     run) is transparently loaded from the canonical on-disk layout and
-    re-stacked, so checkpoints move freely across parallelism topologies."""
+    re-stacked, so checkpoints move freely across parallelism topologies.
+
+    ``verify`` (default on) re-hashes every file against the integrity
+    manifest before deserializing: a corrupted or partially-written
+    checkpoint raises a :class:`CheckpointError` naming the file and
+    the expected/actual digest instead of being silently loaded (a
+    bit-flipped optimizer moment trains — wrongly — without it). A
+    manifest-less legacy checkpoint also raises; pass ``verify=False``
+    to load one anyway (or stamp it with ``tools/ckpt_doctor.py
+    --adopt-legacy``)."""
     if not os.path.isfile(os.path.join(path, "state.msgpack")):
         raise FileNotFoundError(
             f"no checkpoint at {path!r} (expected {path}/state.msgpack)"
         )
+    if verify:
+        verify_checkpoint(path)
     # checkpoints never carry the anomaly-guard scalars (save_checkpoint
     # strips them); a guarded target gets a fresh guard re-attached so
     # the EMA/streak re-warm after resume
@@ -217,7 +368,9 @@ def read_meta(path: str) -> dict:
         ) from e
 
 
-def load_params_for_inference(path: str) -> Tuple[dict, ModelConfig, dict]:
+def load_params_for_inference(
+    path: str, verify: bool = True,
+) -> Tuple[dict, ModelConfig, dict]:
     """Load a TRAINING checkpoint dir (meta.json + state.msgpack) for
     inference-only use: returns (params, resolved ModelConfig, meta).
 
@@ -226,7 +379,13 @@ def load_params_for_inference(path: str) -> Tuple[dict, ModelConfig, dict]:
     server, tools/serve_bench.py) in one place; ``meta`` is the raw
     meta.json dict so callers can check ``tokenizer_fingerprint``
     (data/tokenizer.py:check_tokenizer_matches). For ``save_pretrained``
-    dirs use :func:`from_pretrained` instead."""
+    dirs use :func:`from_pretrained` instead.
+
+    ``verify`` has :func:`load_checkpoint` semantics: digest-check the
+    integrity manifest before serving the weights (corrupt weights in
+    production are worse than a startup error); ``verify=False`` is
+    the escape hatch for pre-manifest checkpoints (or certify them
+    once with ``tools/ckpt_doctor.py --adopt-legacy``)."""
     from differential_transformer_replication_tpu.train.step import (
         create_train_state,
     )
@@ -253,7 +412,7 @@ def load_params_for_inference(path: str) -> Tuple[dict, ModelConfig, dict]:
     target = jax.eval_shape(
         lambda: create_train_state(jax.random.PRNGKey(0), cfg)
     )
-    state, _ = load_checkpoint(path, cfg, target)
+    state, _ = load_checkpoint(path, cfg, target, verify=verify)
     return state["params"], cfg.resolved_model(), meta
 
 

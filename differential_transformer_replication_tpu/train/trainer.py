@@ -27,8 +27,11 @@ from differential_transformer_replication_tpu.train.anomaly import (
     snapshot_state,
 )
 from differential_transformer_replication_tpu.train.checkpoint import (
+    AsyncCheckpointWriter,
     load_checkpoint,
+    resolve_resume_auto,
     save_checkpoint,
+    save_step_checkpoint,
 )
 from differential_transformer_replication_tpu.obs import (
     NOOP_TRACER,
@@ -226,6 +229,28 @@ def train(cfg: TrainConfig) -> dict:
     )
 
     tok_fp = tokenizer_fingerprint(tokenizer)
+    ckpt_auto_skipped = 0
+    # auto-resolution digest-verifies its winner moments before the
+    # load; skip the redundant second full-file hash there (explicit
+    # --resume-from paths still verify at load)
+    resume_verify = True
+    if cfg.resume_from == "auto":
+        # Verified resume: newest checkpoint that passes manifest
+        # verification, falling back to older ones — a crash mid-save
+        # (uncertified dir) or a bit-rotted file can never wedge the
+        # restart loop (train/checkpoint.py:resolve_resume_auto).
+        resolved, skipped = resolve_resume_auto(cfg)
+        ckpt_auto_skipped = len(skipped)
+        if is_primary():
+            for p, why in skipped:
+                print(f"[ckpt] skipping unverified checkpoint {p}: {why}")
+            if resolved is None:
+                print("[ckpt] --resume-from auto: no verified checkpoint "
+                      "found; starting fresh")
+            else:
+                print(f"[ckpt] --resume-from auto: resuming from {resolved}")
+        cfg = cfg.replace(resume_from=resolved)
+        resume_verify = resolved is None
     if cfg.resume_from:
         # Resume must continue on the SAME token stream: if the cache
         # entry was lost and the corpus re-resolved to different content,
@@ -300,6 +325,32 @@ def train(cfg: TrainConfig) -> dict:
         "Anomaly-guard interventions (train/anomaly.py).",
         labelnames=("kind",),
     )
+    obs_ckpt_save_hist = registry.histogram(
+        "ckpt_save_seconds",
+        "Wall time of one checkpoint save job (serialize + write + "
+        "certify + GC), wherever it ran (writer thread or inline).",
+    )
+    obs_ckpt_blocked_hist = registry.histogram(
+        "ckpt_blocked_seconds",
+        "Train-loop wall time blocked on checkpointing per periodic "
+        "snapshot: back-pressure waiting for a still-in-flight async "
+        "save (steady state ~0; growing = the disk cannot keep up "
+        "with ckpt_interval).",
+    )
+    obs_ckpt_verify_failures = registry.counter(
+        "ckpt_verify_failures_total",
+        "Checkpoints that failed integrity verification (digest "
+        "mismatch, truncation, missing manifest) and were skipped "
+        "during resume resolution.",
+    )
+    obs_ckpt_save_failures = registry.counter(
+        "ckpt_save_failures_total",
+        "Periodic step-checkpoint saves that failed (the run continues "
+        "but is less protected; a growing count means the checkpoint "
+        "storage is broken).",
+    )
+    if ckpt_auto_skipped:
+        obs_ckpt_verify_failures.inc(ckpt_auto_skipped)
     tracer = (
         SpanTracer(cfg.trace_path, process_name="trainer")
         if cfg.trace_path and is_primary() else NOOP_TRACER
@@ -329,7 +380,7 @@ def train(cfg: TrainConfig) -> dict:
         best_val_loss = float("inf")
         if cfg.resume_from:
             host_state = gather_to_host(state)
-            host_state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, host_state)
+            host_state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, host_state, verify=resume_verify)
             sh = pipeline_state_sharding(host_state, mesh)
             state = jax.tree_util.tree_map(jax.device_put, host_state, sh)
             print(f"Resumed from {cfg.resume_from} at iter {int(jax.device_get(state['step']))}")
@@ -365,7 +416,7 @@ def train(cfg: TrainConfig) -> dict:
             # accepting a global sharding when every process holds the
             # same full host value (which load_checkpoint guarantees)
             host_state = gather_to_host(state)
-            host_state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, host_state)
+            host_state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, host_state, verify=resume_verify)
             state = shard_state(host_state, mesh)
             print(f"Resumed from {cfg.resume_from} at iter {int(jax.device_get(state['step']))}")
         train_step = make_sharded_train_step(cfg, mesh, state)
@@ -374,7 +425,7 @@ def train(cfg: TrainConfig) -> dict:
         state = create_train_state(jax.random.PRNGKey(cfg.seed), cfg)
         best_val_loss = float("inf")
         if cfg.resume_from:
-            state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, state)
+            state, best_val_loss = load_checkpoint(cfg.resume_from, cfg, state, verify=resume_verify)
             print(f"Resumed from {cfg.resume_from} at iter {int(state['step'])}")
         train_step = make_train_step(cfg)
     if cfg.mesh.pipeline <= 1:
@@ -525,6 +576,29 @@ def train(cfg: TrainConfig) -> dict:
               "path; disabled")
     rollbacks = 0
 
+    # Durable rotating step checkpoints (train/ckpt_writer.py): every
+    # ckpt_interval iterations the state is snapshotted to host and a
+    # certified `step-NNNNNNNN` dir is written + GC'd — from a
+    # background writer thread when ckpt_async (the loop then blocks
+    # only for the device->host snapshot, with back-pressure if the
+    # previous save is still in flight). The writer exists on the
+    # primary only; other ranks just participate in the snapshot's
+    # collective gather.
+    ckpt_root = cfg.resolved_ckpt_dir()
+    ckpt_writer = None
+    ckpt_last_save_s = None  # sync-path mirror of writer.last_save_s
+    if cfg.ckpt_interval > 0:
+        if cfg.ckpt_keep_last < 1:
+            raise ValueError(
+                "ckpt_keep_last must be >= 1 when ckpt_interval > 0, "
+                f"got {cfg.ckpt_keep_last}"
+            )
+        if cfg.ckpt_async and is_primary():
+            ckpt_writer = AsyncCheckpointWriter(
+                save_hist=obs_ckpt_save_hist,
+                blocked_hist=obs_ckpt_blocked_hist,
+            )
+
     print("Starting training...")
     t0 = time.time()
     tokens_seen = 0
@@ -599,6 +673,7 @@ def train(cfg: TrainConfig) -> dict:
     # log_step record's extra fields and the registry gauges)
     obs_acc_step = obs_acc_data = 0.0
     obs_acc_n = 0
+    ckpt_acc_blocked = 0.0  # back-pressure seconds since the last log
     # last observed in-state skip total: the Prometheus counter must
     # only ever move by POSITIVE deltas (a rollback rewinds the guard
     # state — and with it metrics["skipped"] — but an exported counter
@@ -692,6 +767,33 @@ def train(cfg: TrainConfig) -> dict:
             obs_acc_data += data_wait
             obs_acc_n += 1
 
+            if cfg.ckpt_interval > 0 and iter_num % cfg.ckpt_interval == 0:
+                # periodic certified step checkpoint: the snapshot
+                # (collective gather -> host numpy) happens here on the
+                # loop; serialization/IO/GC run on the writer thread
+                # when async. A failed save must not kill a healthy
+                # run — it is counted and printed instead.
+                with tracer.span("ckpt_snapshot", iter=iter_num):
+                    t_ck = time.perf_counter()
+                    try:
+                        blocked = save_step_checkpoint(
+                            ckpt_root, state, best_val_loss, cfg,
+                            tokenizer_fingerprint=tok_fp,
+                            writer=ckpt_writer,
+                            keep_last=cfg.ckpt_keep_last,
+                            keep_every=cfg.ckpt_keep_every,
+                        )
+                        ckpt_acc_blocked += blocked
+                        if ckpt_writer is None and is_primary():
+                            # sync path: the whole save ran inline here
+                            ckpt_last_save_s = time.perf_counter() - t_ck
+                            obs_ckpt_save_hist.observe(ckpt_last_save_s)
+                    except Exception as e:  # noqa: BLE001
+                        obs_ckpt_save_failures.inc()
+                        if is_primary():
+                            print(f"[ckpt] step-checkpoint save failed "
+                                  f"at iter {iter_num} (continuing): {e!r}")
+
             if iter_num % cfg.log_interval == 0:
                 extra = {}
                 with tracer.span("block", what="log_metrics"):
@@ -717,6 +819,21 @@ def train(cfg: TrainConfig) -> dict:
                     obs_acc_data / max(obs_acc_step, 1e-9), 4
                 )
                 obs_stall_gauge.set(extra["data_wait_frac"])
+                if cfg.ckpt_interval > 0:
+                    # checkpoint health rides the same records: blocked
+                    # time since the last log (back-pressure; ~0 when
+                    # the disk keeps up) and the last completed save's
+                    # duration, wherever it ran
+                    extra["ckpt_blocked_ms"] = round(
+                        1e3 * ckpt_acc_blocked, 3
+                    )
+                    last_save_s = (
+                        ckpt_writer.last_save_s
+                        if ckpt_writer is not None else ckpt_last_save_s
+                    )
+                    if last_save_s is not None:
+                        extra["ckpt_save_ms"] = round(1e3 * last_save_s, 3)
+                    ckpt_acc_blocked = 0.0
                 compiles = _compile_entries()
                 if compiles is not None:
                     obs_compile_counter.set(compiles)
@@ -820,8 +937,16 @@ def train(cfg: TrainConfig) -> dict:
             if tracer.path:
                 print(f"[obs] span trace written to {tracer.path}")
 
-        for closer in (profiler.close, logger.finish, _close_tracer,
-                       _stop_metrics_server):
+        def _drain_ckpt_writer():
+            # drain the async writer BEFORE the rescue save below: an
+            # in-flight step snapshot finishes (and certifies) rather
+            # than being abandoned half-written; a job error stored in
+            # the writer surfaces here and is printed, not raised
+            if ckpt_writer is not None:
+                ckpt_writer.close(timeout=600.0)
+
+        for closer in (_drain_ckpt_writer, profiler.close, logger.finish,
+                       _close_tracer, _stop_metrics_server):
             try:
                 closer()
             except Exception as e:  # noqa: BLE001

@@ -29,7 +29,25 @@ call-point faults):
                         fail the next (or the Nth upcoming) checkpoint
                         file write, AFTER the temp file is written but
                         BEFORE the atomic rename — the crash point
-                        ``_atomic_write`` exists to survive
+                        ``atomic_write`` exists to survive
+  ``ckpt_fsync``        fail a checkpoint file write AFTER the rename
+                        but BEFORE the parent-directory fsync — the
+                        window where a power cut can roll the rename
+                        back (train/ckpt_writer.py:atomic_write)
+  ``ckpt_manifest``     fail a checkpoint save just before the
+                        manifest write: leaves a complete but
+                        UNcertified directory that latest-resolution
+                        and resume must skip
+  ``ckpt_gc``           fail retention GC between a checkpoint's
+                        de-certification (manifest removed) and its
+                        data deletion — the crash-safe-delete-ordering
+                        window (train/ckpt_writer.py)
+  ``ckpt_hang`` / ``ckpt_hang@N``
+                        stall the Nth upcoming async checkpoint save
+                        for ``DTX_CKPT_HANG_S`` seconds (default 2.0)
+                        inside the writer THREAD — proves the train
+                        loop keeps stepping while checkpoint I/O drags
+                        and exercises submit() back-pressure
 
 Serving fault points (``@N`` counts ENGINE iterations —
 ``ServingEngine.stats["iterations"]`` — not training steps; exercised
@@ -64,13 +82,18 @@ from typing import Optional, Set
 
 ENV_VAR = "DTX_FAULTS"
 HANG_ENV_VAR = "DTX_SERVE_HANG_S"
+CKPT_HANG_ENV_VAR = "DTX_CKPT_HANG_S"
 
 _STEP_KINDS = (
     "raise", "sigterm", "sigkill", "nan", "corrupt_params",
     # serving kinds: steps are ENGINE iterations, not training steps
     "serve_raise", "serve_hang", "serve_corrupt",
 )
-_POINT_KINDS = ("ckpt_write",)
+_POINT_KINDS = (
+    "ckpt_write", "ckpt_fsync", "ckpt_manifest", "ckpt_gc",
+    # stall-class point: fires through stall() (sleeps), not check()
+    "ckpt_hang",
+)
 
 
 class FaultInjected(RuntimeError):
@@ -210,3 +233,16 @@ def check(point: str) -> None:
     if points[point] <= 0:
         del points[point]
         raise FaultInjected(f"injected failure at {point}")
+
+
+def stall(point: str) -> None:
+    """Stall-class call-point fault (e.g. ``ckpt_hang``): the armed call
+    SLEEPS for ``DTX_CKPT_HANG_S`` seconds instead of raising — a slow
+    disk, not a broken one. Same ``@N`` call-counting as :func:`check`."""
+    points = _get()["points"]
+    if point not in points:
+        return
+    points[point] -= 1
+    if points[point] <= 0:
+        del points[point]
+        time.sleep(float(os.environ.get(CKPT_HANG_ENV_VAR, "2.0")))

@@ -36,6 +36,10 @@ def main() -> None:
                    help="sampling temperature; 0 = greedy (reference: 1.0)")
     p.add_argument("--top-k", type=int, default=None,
                    help="keep only the k highest logits (reference: off)")
+    p.add_argument("--no-verify-checkpoint", action="store_true",
+                   help="skip integrity-manifest verification (needed "
+                        "for pre-manifest checkpoints; or certify them "
+                        "once with tools/ckpt_doctor.py --adopt-legacy)")
     args = p.parse_args()
 
     from differential_transformer_replication_tpu.data.tokenizer import (
@@ -54,7 +58,9 @@ def main() -> None:
     if os.path.exists(os.path.join(args.checkpoint, "params.msgpack")):
         params, model_cfg = from_pretrained(args.checkpoint)
     else:
-        params, model_cfg, meta = load_params_for_inference(args.checkpoint)
+        params, model_cfg, meta = load_params_for_inference(
+            args.checkpoint, verify=not args.no_verify_checkpoint
+        )
         fp = meta.get("tokenizer_fingerprint")
 
     from differential_transformer_replication_tpu.data.tokenizer import (

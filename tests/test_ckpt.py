@@ -1,0 +1,622 @@
+"""Durable-checkpoint tests: integrity manifests, atomic+fsynced
+writes, `step-*` rotation with retention GC, the async writer thread,
+verified resume (`--resume-from auto` + supervisor), ckpt_doctor, and
+the SIGKILL-mid-async-save chaos test.
+
+Tiering: unit and single-run tests are quick (tier-1); the chaos test
+spawns real train.py subprocesses under the supervisor and is ``slow``.
+The async-writer concurrency test and the trainer compile-count pin are
+the acceptance checks that checkpoint I/O never blocks (or retraces)
+the train loop.
+"""
+
+import hashlib
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import pytest
+
+from differential_transformer_replication_tpu.config import ModelConfig, TrainConfig
+from differential_transformer_replication_tpu.train import (
+    AsyncCheckpointWriter,
+    CheckpointError,
+    create_train_state,
+    load_checkpoint,
+    resolve_resume_auto,
+    save_checkpoint,
+    save_step_checkpoint,
+    train,
+    verify_checkpoint,
+)
+from differential_transformer_replication_tpu.train import ckpt_writer as cw
+from differential_transformer_replication_tpu.utils import faults
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+SUPERVISOR = os.path.join(TOOLS, "train_supervisor.py")
+DOCTOR = os.path.join(TOOLS, "ckpt_doctor.py")
+TRAIN_PY = os.path.join(os.path.dirname(__file__), "..", "train.py")
+
+TINY_MODEL = dict(vocab_size=256, n_embd=32, n_head=2, n_layer=2,
+                  block_size=16, dropout=0.0, compute_dtype="float32")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def tiny_cfg(tmp_path, **kw):
+    defaults = dict(
+        vocab_size=256,
+        dataset="synthetic",
+        num_train_samples=200,
+        micro_batch_size=4,
+        grad_acc_steps=1,
+        max_iters=20,
+        eval_interval=10,
+        eval_iters=2,
+        log_interval=5,
+        learning_rate=3e-3,
+        min_lr=3e-4,
+        warmup_iters=5,
+        control_head_multiplier=1,
+        tokenizer_dir=str(tmp_path / "tokenizer"),
+        checkpoint_path=str(tmp_path / "ckpt"),
+        last_checkpoint_path=str(tmp_path / "last_ckpt"),
+        metrics_path=str(tmp_path / "metrics.jsonl"),
+        seed=7,
+    )
+    return TrainConfig(
+        model=ModelConfig(model=kw.pop("model", "diff"), **TINY_MODEL),
+        **{**defaults, **kw},
+    )
+
+
+def step_cfg(**kw):
+    return TrainConfig(
+        model=ModelConfig(model="control", **{**TINY_MODEL, "vocab_size": 31}),
+        vocab_size=31, learning_rate=1e-2, warmup_iters=2, max_iters=100,
+        control_head_multiplier=1, **kw,
+    )
+
+
+def _flip_byte(path, offset=None):
+    data = bytearray(open(path, "rb").read())
+    i = (len(data) // 2) if offset is None else offset
+    data[i] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+
+
+def _mk_raw_ckpt(root, step, certify=True, payload=b"fake-state-bytes"):
+    """A minimal (non-flax) certified checkpoint dir — enough for the
+    manifest/GC/resolution machinery, which never deserializes."""
+    path = os.path.join(root, cw.step_dir_name(step))
+    os.makedirs(path, exist_ok=True)
+    cw.atomic_write(os.path.join(path, "state.msgpack"), payload + b"%d" % step)
+    cw.atomic_write(
+        os.path.join(path, "meta.json"),
+        json.dumps({"iter_num": step, "best_val_loss": 1.0}).encode(),
+    )
+    if certify:
+        cw.write_manifest(path, step=step)
+    return path
+
+
+class TestAtomicWrite:
+    def test_new_durability_fault_points_parse(self):
+        faults.arm("ckpt_fsync,ckpt_manifest@2,ckpt_gc,ckpt_hang@3")
+        assert faults.armed()
+
+    def test_ckpt_write_fault_keeps_old_content(self, tmp_path):
+        dest = str(tmp_path / "f")
+        cw.atomic_write(dest, b"old")
+        faults.arm("ckpt_write")
+        with pytest.raises(faults.FaultInjected):
+            cw.atomic_write(dest, b"new")
+        assert open(dest, "rb").read() == b"old"
+        assert not os.path.exists(dest + ".tmp")
+
+    def test_ckpt_fsync_fault_fires_after_rename(self, tmp_path):
+        """ckpt_fsync models a crash AFTER the rename but BEFORE the
+        directory fsync: the new content is in place (rename done) but
+        its durability is uncertain — which is exactly why the manifest
+        (written after, with its own fsyncs) is the certification."""
+        dest = str(tmp_path / "f")
+        cw.atomic_write(dest, b"old")
+        faults.arm("ckpt_fsync")
+        with pytest.raises(faults.FaultInjected):
+            cw.atomic_write(dest, b"new")
+        assert open(dest, "rb").read() == b"new"
+        assert not os.path.exists(dest + ".tmp")
+
+
+class TestManifest:
+    def _good_ckpt(self, tmp_path):
+        cfg = step_cfg()
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        path = str(tmp_path / "ckpt")
+        save_checkpoint(path, state, 1.0, cfg)
+        return cfg, state, path
+
+    def test_roundtrip_and_digests(self, tmp_path):
+        cfg, state, path = self._good_ckpt(tmp_path)
+        manifest = verify_checkpoint(path)
+        assert set(manifest["files"]) == {"state.msgpack", "meta.json"}
+        assert manifest["step"] == 0
+        assert manifest["config_hash"]
+        sp = os.path.join(path, "state.msgpack")
+        rec = manifest["files"]["state.msgpack"]
+        data = open(sp, "rb").read()
+        assert rec["bytes"] == len(data)
+        assert rec["sha256"] == hashlib.sha256(data).hexdigest()
+        restored, best = load_checkpoint(
+            path, cfg, create_train_state(jax.random.PRNGKey(1), cfg)
+        )
+        assert best == pytest.approx(1.0)
+
+    def test_one_flipped_byte_raises_named_error(self, tmp_path):
+        """THE integrity contract: a single bit-rotted byte in
+        state.msgpack is caught BEFORE deserialization, naming the file
+        and both digests."""
+        cfg, state, path = self._good_ckpt(tmp_path)
+        _flip_byte(os.path.join(path, "state.msgpack"))
+        target = create_train_state(jax.random.PRNGKey(1), cfg)
+        with pytest.raises(CheckpointError, match="state.msgpack") as ei:
+            load_checkpoint(path, cfg, target)
+        assert "expected sha256" in str(ei.value)
+        assert not cw.is_verified(path)
+
+    def test_truncated_file_names_sizes(self, tmp_path):
+        cfg, state, path = self._good_ckpt(tmp_path)
+        mp = os.path.join(path, "meta.json")
+        data = open(mp, "rb").read()
+        open(mp, "wb").write(data[: len(data) // 2])
+        with pytest.raises(CheckpointError, match="meta.json"):
+            verify_checkpoint(path)
+
+    def test_missing_manifest_raises_and_escape_hatch(self, tmp_path):
+        """A manifest-less dir is never silently loaded (the save was
+        interrupted before certification, or predates manifests);
+        verify=False is the explicit legacy escape hatch."""
+        cfg, state, path = self._good_ckpt(tmp_path)
+        os.unlink(os.path.join(path, cw.MANIFEST_NAME))
+        target = create_train_state(jax.random.PRNGKey(1), cfg)
+        with pytest.raises(CheckpointError, match="manifest"):
+            load_checkpoint(path, cfg, target)
+        restored, best = load_checkpoint(path, cfg, target, verify=False)
+        assert best == pytest.approx(1.0)
+
+    def test_truncated_manifest_is_unverified(self, tmp_path):
+        cfg, state, path = self._good_ckpt(tmp_path)
+        mp = os.path.join(path, cw.MANIFEST_NAME)
+        open(mp, "wb").write(open(mp, "rb").read()[:20])
+        assert not cw.is_verified(path)
+        with pytest.raises(CheckpointError, match="manifest"):
+            load_checkpoint(
+                path, cfg, create_train_state(jax.random.PRNGKey(1), cfg)
+            )
+
+    def test_manifest_fault_leaves_uncertified_dir(self, tmp_path):
+        """ckpt_manifest fires just before certification: the save
+        fails, the dir holds complete data files but NO manifest, and
+        every verification-aware reader skips it."""
+        cfg = step_cfg()
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        root = str(tmp_path / "steps")
+        faults.arm("ckpt_manifest")
+        with pytest.raises(faults.FaultInjected):
+            save_step_checkpoint(root, state, 1.0, cfg)
+        [(_, path)] = cw.list_step_checkpoints(root)
+        assert os.path.isfile(os.path.join(path, "state.msgpack"))
+        assert not os.path.exists(os.path.join(path, cw.MANIFEST_NAME))
+        resolved, skipped = cw.latest_verified_checkpoint(root)
+        assert resolved is None
+        assert [p for p, _ in skipped] == [path]
+        # the next (un-injected) save of the same step certifies it
+        save_step_checkpoint(root, state, 1.0, cfg)
+        assert cw.is_verified(path)
+
+
+class TestRotationGC:
+    def test_keep_last_plus_keep_every(self, tmp_path):
+        root = str(tmp_path / "steps")
+        for s in (5, 10, 15, 20, 25, 30):
+            _mk_raw_ckpt(root, s)
+        kept, deleted = cw.gc_step_checkpoints(root, keep_last=2, keep_every=10)
+        steps = sorted(s for s, _ in cw.list_step_checkpoints(root))
+        assert steps == [10, 20, 25, 30]  # newest 2 + every 10th
+        assert all(cw.is_verified(p) for _, p in cw.list_step_checkpoints(root))
+        assert len(deleted) == 2
+
+    def test_unverified_dirs_are_garbage_collected(self, tmp_path):
+        root = str(tmp_path / "steps")
+        _mk_raw_ckpt(root, 10)
+        torn = _mk_raw_ckpt(root, 20, certify=False)  # crashed save
+        kept, deleted = cw.gc_step_checkpoints(root, keep_last=3)
+        assert torn in deleted
+        assert [s for s, _ in cw.list_step_checkpoints(root)] == [10]
+
+    def test_latest_resolution_falls_back_over_corruption(self, tmp_path):
+        root = str(tmp_path / "steps")
+        good = _mk_raw_ckpt(root, 10)
+        bad = _mk_raw_ckpt(root, 20)
+        _flip_byte(os.path.join(bad, "state.msgpack"))
+        resolved, skipped = cw.latest_verified_checkpoint(root)
+        assert resolved == good
+        assert [p for p, _ in skipped] == [bad]
+
+    def test_gc_crash_leaves_uncertified_never_torn_certified(self, tmp_path):
+        """Crash-safe delete ordering: ckpt_gc fires AFTER the victim's
+        manifest is removed but BEFORE its data goes. The survivor set
+        must contain no certified-but-partial dir — the victim is
+        merely uncertified (skipped by every reader) and the next GC
+        finishes the job."""
+        root = str(tmp_path / "steps")
+        _mk_raw_ckpt(root, 10)
+        _mk_raw_ckpt(root, 20)
+        _mk_raw_ckpt(root, 30)
+        faults.arm("ckpt_gc")
+        with pytest.raises(faults.FaultInjected):
+            cw.gc_step_checkpoints(root, keep_last=1)
+        victim = os.path.join(root, cw.step_dir_name(10))
+        assert os.path.isdir(victim)
+        assert not os.path.exists(os.path.join(victim, cw.MANIFEST_NAME))
+        assert not cw.is_verified(victim)
+        resolved, _ = cw.latest_verified_checkpoint(root)
+        assert resolved == os.path.join(root, cw.step_dir_name(30))
+        # un-injected GC completes the interrupted retention pass
+        cw.gc_step_checkpoints(root, keep_last=1)
+        assert [s for s, _ in cw.list_step_checkpoints(root)] == [30]
+
+
+class TestAsyncWriter:
+    def test_submit_is_nonblocking_while_job_runs(self):
+        """The async contract: submit() hands the job to the writer
+        thread and returns immediately; only a SECOND submit while the
+        first is still in flight blocks (back-pressure), and the
+        blocked time is reported."""
+        w = AsyncCheckpointWriter()
+        gate = threading.Event()
+        ran = []
+        t0 = time.perf_counter()
+        blocked1 = w.submit(lambda: (gate.wait(10), ran.append(1)))
+        submit_cost = time.perf_counter() - t0
+        assert blocked1 < 0.05  # idle writer: no back-pressure
+        assert submit_cost < 0.5  # returned while the job is running
+        assert not ran  # the job really is on the other thread
+        threading.Timer(0.3, gate.set).start()
+        blocked2 = w.submit(lambda: ran.append(2))
+        assert blocked2 >= 0.2  # back-pressure until job 1 drained
+        w.close()
+        assert ran == [1, 2]
+        assert w.saves_completed == 2
+        assert w.last_save_s is not None
+
+    def test_job_error_surfaces_without_dropping_next_job(self):
+        """A transient failure loses exactly the save that failed: the
+        error surfaces on the NEXT submit, but only after that submit's
+        (healthy) job is enqueued — one bad save never costs two."""
+        w = AsyncCheckpointWriter()
+        ran = []
+        w.submit(lambda: (_ for _ in ()).throw(ValueError("disk on fire")))
+        time.sleep(0.1)
+        with pytest.raises(ValueError, match="disk on fire"):
+            w.submit(lambda: ran.append(1))
+        w.close()
+        assert ran == [1]  # the follow-up snapshot still landed
+        # failed jobs never pollute the save telemetry
+        assert w.saves_completed == 1
+
+    def test_close_drains_pending_job(self, tmp_path):
+        w = AsyncCheckpointWriter()
+        marker = str(tmp_path / "done")
+        w.submit(lambda: (time.sleep(0.2), open(marker, "w").write("x")))
+        w.close()
+        assert os.path.exists(marker)
+
+    def test_histograms_observe(self):
+        from differential_transformer_replication_tpu.obs import Registry
+
+        reg = Registry()
+        w = AsyncCheckpointWriter(
+            save_hist=reg.histogram("ckpt_save_seconds"),
+            blocked_hist=reg.histogram("ckpt_blocked_seconds"),
+        )
+        w.submit(lambda: None)
+        w.close()
+        assert reg.histogram("ckpt_save_seconds").snapshot()["count"] == 1
+        assert reg.histogram("ckpt_blocked_seconds").snapshot()["count"] == 1
+
+
+class TestResolveAuto:
+    def test_picks_newest_verified_across_sources(self, tmp_path):
+        cfg = step_cfg(
+            checkpoint_path=str(tmp_path / "best.ckpt"),
+            ckpt_dir=str(tmp_path / "steps"),
+        )
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        save_checkpoint(cfg.checkpoint_path, state, 1.0, cfg)  # step 0
+        root = cfg.resolved_ckpt_dir()
+        good = _mk_raw_ckpt(root, 10)
+        bad = _mk_raw_ckpt(root, 20)
+        _flip_byte(os.path.join(bad, "state.msgpack"))
+        resolved, skipped = resolve_resume_auto(cfg)
+        assert resolved == good
+        assert [p for p, _ in skipped] == [bad]
+
+    def test_no_candidates_resolves_none(self, tmp_path):
+        cfg = step_cfg(
+            checkpoint_path=str(tmp_path / "nope.ckpt"),
+            ckpt_dir=str(tmp_path / "steps"),
+        )
+        resolved, skipped = resolve_resume_auto(cfg)
+        assert resolved is None and skipped == []
+
+
+class TestTrainerIntegration:
+    def test_async_step_checkpoints_verified_with_compile_pin(self, tmp_path):
+        """Acceptance: periodic async checkpoints land certified, the
+        rotation honors keep_last/keep_every, ckpt telemetry rides the
+        metrics records, and the instrumented+checkpointed loop still
+        compiles its step exactly ONCE (snapshotting must not
+        retrace)."""
+        cfg = tiny_cfg(tmp_path, ckpt_interval=5, ckpt_keep_last=2,
+                       ckpt_keep_every=10)
+        state = train(cfg)
+        assert int(state["step"]) == 20
+        root = cfg.resolved_ckpt_dir()
+        entries = cw.list_step_checkpoints(root)
+        assert [s for s, _ in entries] == [10, 15, 20]
+        assert all(cw.is_verified(p) for _, p in entries)
+        # best/last checkpoints are certified too
+        assert cw.is_verified(cfg.checkpoint_path)
+        assert cw.is_verified(cfg.last_checkpoint_path)
+        recs = [json.loads(l) for l in open(cfg.metrics_path)]
+        steps = [r for r in recs if "ckpt_blocked_ms" in r]
+        assert steps, "ckpt telemetry missing from metrics.jsonl"
+        assert any("ckpt_save_ms" in r for r in steps)
+        pins = [r["compile_events"] for r in recs if "compile_events" in r]
+        assert pins and pins[-1] == 1
+
+    def test_writer_stall_back_pressure_loop_keeps_stepping(
+        self, tmp_path, monkeypatch
+    ):
+        """ckpt_hang stalls the FIRST async save on the writer thread:
+        the run completes (the loop stepped right through the stall),
+        the next interval's submit reports back-pressure (a save was
+        genuinely still in flight — impossible with inline writes), and
+        every checkpoint still certifies."""
+        monkeypatch.setenv(faults.CKPT_HANG_ENV_VAR, "1.0")
+        cfg = tiny_cfg(tmp_path, faults="ckpt_hang@1", ckpt_interval=4,
+                       max_iters=12, log_interval=1, eval_interval=50)
+        t0 = time.perf_counter()
+        state = train(cfg)
+        assert int(state["step"]) == 12
+        entries = cw.list_step_checkpoints(cfg.resolved_ckpt_dir())
+        assert [s for s, _ in entries] == [4, 8, 12]  # keep_last=3 default
+        assert all(cw.is_verified(p) for _, p in entries)
+        recs = [json.loads(l) for l in open(cfg.metrics_path)]
+        blocked = sum(r.get("ckpt_blocked_ms", 0.0) for r in recs)
+        assert blocked > 0.0  # the save at 8 waited on the stalled save at 4
+
+    def test_resume_auto_skips_corrupt_and_falls_back(self, tmp_path, capsys):
+        """--resume-from auto end to end: with the newest checkpoints
+        corrupted (torn rescue save, bit-rotted newest step dir), the
+        trainer resumes from the newest one that verifies instead of
+        crashing or silently loading garbage."""
+        cfg = tiny_cfg(tmp_path, ckpt_interval=5, ckpt_keep_last=4)
+        train(cfg)
+        root = cfg.resolved_ckpt_dir()
+        # corrupt everything at step 20: the rescue last-ckpt, the best
+        # ckpt (also step 20 here), and the newest step dir
+        _flip_byte(os.path.join(cfg.last_checkpoint_path, "state.msgpack"))
+        _flip_byte(os.path.join(cfg.checkpoint_path, "state.msgpack"))
+        _flip_byte(
+            os.path.join(root, cw.step_dir_name(20), "state.msgpack")
+        )
+        cfg2 = cfg.replace(max_iters=25, resume_from="auto")
+        state = train(cfg2)
+        out = capsys.readouterr().out
+        assert "skipping unverified checkpoint" in out
+        assert f"resuming from {os.path.join(root, cw.step_dir_name(15))}" in out
+        assert int(state["step"]) == 25
+
+    def test_resume_auto_fresh_start_when_nothing_exists(self, tmp_path, capsys):
+        cfg = tiny_cfg(tmp_path, max_iters=6, eval_interval=50,
+                       resume_from="auto")
+        state = train(cfg)
+        assert "no verified checkpoint found; starting fresh" in \
+            capsys.readouterr().out
+        assert int(state["step"]) == 6
+
+
+def _load_supervisor_module():
+    spec = importlib.util.spec_from_file_location("train_supervisor", SUPERVISOR)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestSupervisorVerifiedResume:
+    def test_tree_resolves_newest_verified(self, tmp_path):
+        sup = _load_supervisor_module()
+        root = str(tmp_path / "steps")
+        good = _mk_raw_ckpt(root, 10)
+        bad = _mk_raw_ckpt(root, 20)
+        _flip_byte(os.path.join(bad, "state.msgpack"))
+        assert sup.resolve_resume_ckpt(root) == good
+
+    def test_single_dir_verified_or_skipped(self, tmp_path):
+        sup = _load_supervisor_module()
+        path = _mk_raw_ckpt(str(tmp_path), 5)
+        assert sup.resolve_resume_ckpt(path) == path
+        _flip_byte(os.path.join(path, "state.msgpack"))
+        assert sup.resolve_resume_ckpt(path) is None
+
+    def test_legacy_dir_without_manifest_not_injected(self, tmp_path):
+        """A manifest-less dir must NOT be injected: the trainer's
+        verified load would reject it on every relaunch, wedging the
+        restart loop on a CheckpointError (certify legacy dirs once
+        with ckpt_doctor --adopt-legacy instead)."""
+        sup = _load_supervisor_module()
+        path = str(tmp_path / "legacy.ckpt")
+        os.makedirs(path)
+        open(os.path.join(path, "state.msgpack"), "wb").write(b"x")
+        assert sup.resolve_resume_ckpt(path) is None
+        assert sup.resolve_resume_ckpt(str(tmp_path / "missing")) is None
+        assert sup.resolve_resume_ckpt(None) is None
+        # adopted via the doctor, the same dir becomes injectable
+        cw.write_manifest(path, step=0)
+        assert sup.resolve_resume_ckpt(path) == path
+
+
+class TestCkptDoctor:
+    def _run(self, *args):
+        proc = subprocess.run(
+            [sys.executable, DOCTOR, *args],
+            capture_output=True, text=True, timeout=60,
+        )
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        return proc, summary
+
+    def _tree(self, tmp_path):
+        root = str(tmp_path / "steps")
+        _mk_raw_ckpt(root, 10)
+        bad = _mk_raw_ckpt(root, 20)
+        _flip_byte(os.path.join(bad, "state.msgpack"))
+        legacy = os.path.join(str(tmp_path), "legacy.ckpt")
+        os.makedirs(legacy)
+        open(os.path.join(legacy, "state.msgpack"), "wb").write(b"s")
+        open(os.path.join(legacy, "meta.json"), "w").write(
+            '{"iter_num": 3, "best_val_loss": 1.0}'
+        )
+        return root, bad, legacy
+
+    def test_list_verify_and_check_gate(self, tmp_path):
+        root, bad, legacy = self._tree(tmp_path)
+        proc, summary = self._run(root, legacy, "--check")
+        assert proc.returncode == 1
+        assert summary["checkpoints"] == 3
+        assert summary["verified"] == 1
+        assert summary["corrupt"] == 1
+        assert summary["legacy"] == 1
+        assert summary["newest_verified_step"] == 10
+        assert "CHECK FAILED" in proc.stderr
+
+    def test_repair_and_adopt_make_check_pass(self, tmp_path):
+        root, bad, legacy = self._tree(tmp_path)
+        proc, summary = self._run(
+            root, legacy, "--repair", "--adopt-legacy", "--check"
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert summary["repaired"] == [bad]
+        assert summary["adopted"] == [legacy]
+        assert summary["corrupt"] == 0
+        assert not os.path.exists(bad)
+        assert cw.is_verified(legacy)
+        # adopted manifest records the meta's step
+        assert cw.read_manifest(legacy)["step"] == 3
+
+    def test_walks_nested_step_trees(self, tmp_path):
+        """`ckpt_doctor.py runs/` must find checkpoints nested under
+        run subdirectories (`runs/exp.steps/step-*`), not just
+        immediate children."""
+        run = tmp_path / "runs"
+        _mk_raw_ckpt(str(run / "exp.steps"), 10)
+        bad = _mk_raw_ckpt(str(run / "other" / "exp2.steps"), 20)
+        _flip_byte(os.path.join(bad, "state.msgpack"))
+        proc, summary = self._run(str(run))
+        assert summary["checkpoints"] == 2
+        assert summary["verified"] == 1
+        assert summary["corrupt"] == 1
+        assert summary["newest_verified_step"] == 10
+
+
+def _train_env(**extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop(faults.ENV_VAR, None)
+    env.update(extra)
+    return env
+
+
+def _run_chaos(tmp_path, name, *extra, supervised=False, env_extra=None):
+    """One train.py run with rotating async checkpoints + --resume-from
+    auto (optionally supervised). Faults ride DTX_FAULTS, which the
+    supervisor strips on restarts."""
+    d = tmp_path / name
+    d.mkdir()
+    env = _train_env(**(env_extra or {}))
+    cmd = [
+        sys.executable, TRAIN_PY, "--model", "diff",
+        "--dataset", "synthetic", "--num-train-samples", "200",
+        "--vocab-size", "256", "--n-embd", "32", "--n-head", "2",
+        "--n-layer", "2", "--block-size", "16",
+        "--compute-dtype", "float32", "--micro-batch-size", "4",
+        "--max-iters", "24", "--eval-interval", "8", "--eval-iters", "2",
+        "--learning-rate", "3e-3", "--warmup-iters", "5", "--seed", "7",
+        "--tokenizer-dir", str(tmp_path / "tokenizer"),
+        "--checkpoint-path", str(d / "best.ckpt"),
+        "--last-checkpoint-path", str(d / "last.ckpt"),
+        "--metrics-path", str(d / "metrics.jsonl"),
+        "--ckpt-interval", "6", "--ckpt-keep-last", "10",
+        "--resume-from", "auto",
+        *extra,
+    ]
+    if supervised:
+        cmd = [
+            sys.executable, SUPERVISOR, "--backoff-base", "0.05",
+            "--max-restarts", "3",
+            "--restart-log", str(d / "restarts.json"), "--",
+        ] + cmd
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=600, env=env
+    )
+    return d, proc
+
+
+@pytest.mark.slow
+def test_sigkill_during_async_save_resumes_verified_and_bit_identical(tmp_path):
+    """THE durability chaos test: a run is SIGKILLed while an async
+    step-checkpoint save is STALLED in flight (ckpt_hang on the writer
+    thread), leaving that save torn/uncertified. The supervisor
+    restarts it, `--resume-from auto` resolves the newest checkpoint
+    that passes manifest verification (falling back past the torn one),
+    and the finished run is bit-identical to an uninterrupted run. The
+    torn dir is garbage-collected by a later save's retention pass."""
+    a, proc_a = _run_chaos(tmp_path, "uninterrupted")
+    assert proc_a.returncode == 0, proc_a.stderr[-2000:]
+
+    b, proc_b = _run_chaos(
+        tmp_path, "killed", supervised=True,
+        env_extra={
+            # save @12 stalls 5s on the writer; iters 13-14 keep
+            # stepping; the SIGKILL at 14 lands mid-save
+            faults.ENV_VAR: "ckpt_hang@2,sigkill@14",
+            faults.CKPT_HANG_ENV_VAR: "5.0",
+        },
+    )
+    assert proc_b.returncode == 0, proc_b.stderr[-2000:]
+    records = [json.loads(l) for l in open(b / "restarts.json")]
+    assert [r["outcome"] for r in records] == ["sigkill", "clean"]
+    assert "--resume-from auto: resuming from" in proc_b.stdout
+
+    # bit-identical final state vs the uninterrupted run
+    sa = open(a / "last.ckpt" / "state.msgpack", "rb").read()
+    sb = open(b / "last.ckpt" / "state.msgpack", "rb").read()
+    assert sa == sb
+    # every surviving checkpoint certifies; the torn step-12 save never
+    # became loadable and was GC'd by a later retention pass
+    for d in (a, b):
+        entries = cw.list_step_checkpoints(str(d / "best.steps"))
+        assert all(cw.is_verified(p) for _, p in entries)
+        assert 24 in [s for s, _ in entries]
+    assert cw.is_verified(str(b / "last.ckpt"))

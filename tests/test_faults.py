@@ -474,6 +474,15 @@ class TestSupervisorProcess:
         ckpt = tmp_path / "last.ckpt"
         ckpt.mkdir()
         (ckpt / "state.msgpack").write_bytes(b"x")
+        # the supervisor only injects checkpoints that pass integrity
+        # verification (train/ckpt_writer.py manifests)
+        import hashlib
+
+        (ckpt / "manifest.json").write_text(json.dumps({
+            "format": 1, "step": 0, "files": {"state.msgpack": {
+                "sha256": hashlib.sha256(b"x").hexdigest(), "bytes": 1,
+            }},
+        }))
         script = tmp_path / "argv_logger.py"
         script.write_text(
             "import os, sys\n"

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""List / verify / repair a checkpoint directory tree.
+
+Walks checkpoint dirs — a single checkpoint, or a run directory holding
+rotating ``step-*`` checkpoints plus ``best``/``last`` dirs — re-hashes
+every file against its integrity manifest (train/ckpt_writer.py), and
+prints one status line per checkpoint followed by ONE JSON summary line
+(like tools/metrics_report.py), so a cron job or CI step can gate on
+checkpoint health::
+
+    python tools/ckpt_doctor.py runs/exp.steps
+    python tools/ckpt_doctor.py runs/ --check           # CI gate
+    python tools/ckpt_doctor.py runs/exp.steps --repair # prune corrupt
+    python tools/ckpt_doctor.py old_run.ckpt --adopt-legacy
+
+Statuses: ``verified`` (manifest present, all digests match),
+``corrupt`` (manifest present but a file is missing/truncated/flipped),
+``legacy`` (pre-manifest checkpoint: state.msgpack + meta.json, no
+manifest), ``incomplete`` (files but no certifiable checkpoint — e.g. a
+save killed before the manifest write).
+
+``--repair`` deletes corrupt and incomplete ``step-*`` dirs with the
+crash-safe manifest-first ordering; non-rotation dirs (best/last) are
+never deleted — they are reported for the operator. ``--adopt-legacy``
+stamps a manifest onto legacy dirs (certifying their CURRENT bytes, so
+later bit-rot is caught even though past history is unknowable).
+``--check`` exits non-zero when corruption remains or no verified
+checkpoint exists.
+
+Stdlib-only: train/ckpt_writer.py is loaded by file path, so this runs
+(fast) on machines without jax — a storage node, a CI runner.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+_CKPT_WRITER_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..",
+    "differential_transformer_replication_tpu", "train", "ckpt_writer.py",
+)
+
+
+def load_ckpt_module(path: str = _CKPT_WRITER_PATH):
+    spec = importlib.util.spec_from_file_location("_doctor_ckpt_writer", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for name in os.listdir(path):
+        fp = os.path.join(path, name)
+        if os.path.isfile(fp):
+            total += os.path.getsize(fp)
+    return total
+
+
+def _looks_like_checkpoint(path: str, ckpt) -> bool:
+    return os.path.isdir(path) and (
+        os.path.isfile(os.path.join(path, ckpt.MANIFEST_NAME))
+        or os.path.isfile(os.path.join(path, "state.msgpack"))
+        or ckpt.parse_step_dir(os.path.basename(path)) is not None
+    )
+
+
+def discover(paths: List[str], ckpt) -> List[str]:
+    """Checkpoint dirs under the given paths: each path is either a
+    checkpoint itself or a tree walked recursively (so a run directory
+    containing `<exp>.steps/step-*` subtrees heals in one invocation).
+    Checkpoint dirs are not descended into — their contents are data,
+    not more checkpoints."""
+    found = []
+    for path in paths:
+        if _looks_like_checkpoint(path, ckpt):
+            found.append(path)
+        elif os.path.isdir(path):
+            for dirpath, dirnames, _ in os.walk(path):
+                kept = []
+                for name in sorted(dirnames):
+                    child = os.path.join(dirpath, name)
+                    if _looks_like_checkpoint(child, ckpt):
+                        found.append(child)
+                    else:
+                        kept.append(name)
+                dirnames[:] = kept
+    return found
+
+
+def diagnose(path: str, ckpt) -> Tuple[str, Optional[int], str]:
+    """(status, step, detail) for one checkpoint dir."""
+    try:
+        manifest = ckpt.verify_checkpoint(path)
+        return "verified", manifest.get("step"), ""
+    except ckpt.CheckpointError as e:
+        if os.path.isfile(os.path.join(path, ckpt.MANIFEST_NAME)):
+            return "corrupt", _meta_step(path), str(e)
+    if os.path.isfile(os.path.join(path, "state.msgpack")) and os.path.isfile(
+        os.path.join(path, "meta.json")
+    ):
+        return "legacy", _meta_step(path), "no integrity manifest"
+    return "incomplete", None, "no certifiable checkpoint content"
+
+
+def _meta_step(path: str) -> Optional[int]:
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            return int(json.load(f)["iter_num"])
+    except Exception:  # noqa: BLE001 — best-effort annotation only
+        return None
+
+
+def run(args: argparse.Namespace) -> int:
+    ckpt = load_ckpt_module()
+    dirs = discover(args.paths, ckpt)
+    summary = {
+        "checkpoints": len(dirs), "verified": 0, "corrupt": 0,
+        "legacy": 0, "incomplete": 0, "total_bytes": 0,
+        "newest_verified": None, "newest_verified_step": None,
+    }
+    repaired, adopted = [], []
+    for path in dirs:
+        status, step, detail = diagnose(path, ckpt)
+        if status == "legacy" and args.adopt_legacy:
+            ckpt.write_manifest(path, step=step if step is not None else -1)
+            adopted.append(path)
+            status, step, detail = diagnose(path, ckpt)
+        if status in ("corrupt", "incomplete") and args.repair:
+            if ckpt.parse_step_dir(os.path.basename(path)) is not None:
+                ckpt.delete_checkpoint_dir(path)
+                repaired.append(path)
+                print(f"{path}: {status} -> deleted ({detail})",
+                      file=sys.stderr)
+                continue
+            detail += " [not a step-* dir; refusing to auto-delete]"
+        summary[status] += 1
+        summary["total_bytes"] += _dir_bytes(path)
+        if status == "verified" and (
+            summary["newest_verified_step"] is None
+            or (step or -1) > summary["newest_verified_step"]
+        ):
+            summary["newest_verified"] = path
+            summary["newest_verified_step"] = step
+        line = f"{path}: {status}"
+        if step is not None:
+            line += f" (step {step})"
+        if detail:
+            line += f" — {detail}"
+        print(line, file=sys.stderr)
+    if repaired:
+        summary["repaired"] = repaired
+    if adopted:
+        summary["adopted"] = adopted
+    print(json.dumps(summary))
+    if args.check:
+        bad = []
+        if summary["corrupt"] or summary["incomplete"]:
+            bad.append(
+                f"{summary['corrupt']} corrupt + {summary['incomplete']} "
+                "incomplete checkpoint(s) present"
+            )
+        if summary["verified"] == 0:
+            bad.append("no verified checkpoint in the tree")
+        for b in bad:
+            print(f"CHECK FAILED: {b}", file=sys.stderr)
+        return 1 if bad else 0
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("paths", nargs="+",
+                   help="checkpoint dir(s) or tree root(s)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when corruption remains or no verified "
+                        "checkpoint exists")
+    p.add_argument("--repair", action="store_true",
+                   help="delete corrupt/incomplete step-* dirs "
+                        "(manifest-first crash-safe ordering)")
+    p.add_argument("--adopt-legacy", action="store_true",
+                   help="stamp integrity manifests onto pre-manifest "
+                        "checkpoints (certifies their current bytes)")
+    return run(p.parse_args())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,9 +13,20 @@ needs an outside process to relaunch it. This wrapper is that process:
 Behavior:
   - Runs the child command verbatim first. On an ABNORMAL exit it
     relaunches with ``--resume-from <resume-ckpt>`` injected (replacing
-    any existing ``--resume-from``) when that checkpoint exists on disk,
+    any existing ``--resume-from``) when that checkpoint VERIFIES,
     after an exponential backoff (``backoff_base * 2^restart``, capped),
     up to ``--max-restarts`` relaunches.
+  - Verified resume: ``--resume-ckpt`` may be a checkpoint dir or the
+    root of a rotating ``step-*`` tree. Integrity manifests
+    (train/ckpt_writer.py, spec-loaded by file path so no jax is
+    imported) are checked before injecting: a tree resolves to the
+    NEWEST step checkpoint whose digests verify, falling back to older
+    ones; a single dir must verify (a corrupt or manifest-less one is
+    skipped and logged — the child may still resolve its own via
+    ``--resume-from auto``, and a pre-manifest dir can be certified
+    with ``tools/ckpt_doctor.py --adopt-legacy``). A crash mid-save
+    can therefore never wedge the restart loop on a half-written
+    checkpoint.
   - Exit classification: rc 0 is a CLEAN exit (done — this includes the
     trainer's SIGTERM graceful stop, which exits 0 after its rescue
     save); death BY SIGTERM without the graceful handler is a
@@ -48,6 +59,73 @@ import time
 from typing import List, Optional
 
 FAULTS_ENV = "DTX_FAULTS"
+
+
+def _ckpt_tools():
+    """train/ckpt_writer.py loaded BY FILE PATH: its module scope is
+    stdlib-only, so manifest verification works here without importing
+    the package (whose __init__ chain would pull jax — the runtime this
+    supervisor must outlive). None when the file is missing (repo
+    layout changed): callers degrade to the legacy existence check."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..",
+        "differential_transformer_replication_tpu", "train",
+        "ckpt_writer.py",
+    )
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_supervisor_ckpt_writer", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception as e:  # noqa: BLE001
+        print(f"train_supervisor: checkpoint verification unavailable "
+              f"({e!r}); falling back to existence checks",
+              file=sys.stderr)
+        return None
+
+
+def resolve_resume_ckpt(path: Optional[str], ckpt=None) -> Optional[str]:
+    """The checkpoint dir to inject as ``--resume-from``, or None.
+
+    ``path`` is a checkpoint dir or a rotating-tree root; ``ckpt`` is
+    the (possibly None) ckpt_writer module. Only a checkpoint that
+    passes manifest verification is injected — newest-first with
+    fallback across a tree — so the child never restarts into a
+    half-written or bit-rotted save."""
+    if not path:
+        return None
+    if ckpt is None:
+        ckpt = _ckpt_tools()
+    if ckpt is None:  # degraded mode: the pre-manifest behavior
+        return path if os.path.isfile(
+            os.path.join(path, "state.msgpack")
+        ) else None
+    if ckpt.list_step_checkpoints(path):
+        resolved, skipped = ckpt.latest_verified_checkpoint(path)
+        for p, why in skipped:
+            print(f"train_supervisor: skipping unverified checkpoint "
+                  f"{p}: {why}", file=sys.stderr)
+        return resolved
+    if os.path.exists(os.path.join(path, ckpt.MANIFEST_NAME)):
+        if ckpt.is_verified(path):
+            return path
+        print(f"train_supervisor: checkpoint {path} fails integrity "
+              "verification; not injecting --resume-from",
+              file=sys.stderr)
+        return None
+    if os.path.isfile(os.path.join(path, "state.msgpack")):
+        # manifest-less legacy dir: the trainer's verified load would
+        # reject it on every relaunch — injecting it would wedge the
+        # restart loop on a CheckpointError, the exact failure this
+        # resolution exists to prevent
+        print(f"train_supervisor: checkpoint {path} has no integrity "
+              "manifest; not injecting --resume-from (certify it with "
+              "tools/ckpt_doctor.py --adopt-legacy)", file=sys.stderr)
+    return None
 
 
 def classify_exit(rc: int) -> str:
@@ -96,9 +174,11 @@ def build_parser() -> argparse.ArgumentParser:
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
     )
     p.add_argument("--resume-ckpt", default=None,
-                   help="checkpoint dir to resume from on restarts (point "
-                        "it at the run's last/rescue checkpoint); only "
-                        "injected when <dir>/state.msgpack exists")
+                   help="checkpoint dir — or root of a rotating step-* "
+                        "tree — to resume from on restarts (point it at "
+                        "the run's last/rescue checkpoint or its .steps "
+                        "dir); only a checkpoint passing integrity "
+                        "verification is injected, newest first")
     p.add_argument("--max-restarts", type=int, default=5,
                    help="restart budget; exhausted -> exit with the "
                         "child's last returncode")
@@ -156,8 +236,8 @@ def supervise(args: argparse.Namespace) -> int:
         resumed_from = None
         env = None  # inherit
         if restarts > 0:
-            ckpt = args.resume_ckpt
-            if ckpt and os.path.isfile(os.path.join(ckpt, "state.msgpack")):
+            ckpt = resolve_resume_ckpt(args.resume_ckpt)
+            if ckpt:
                 launch_cmd = with_resume(cmd, ckpt)
                 resumed_from = ckpt
             if not args.keep_faults:

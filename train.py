@@ -66,7 +66,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--last-checkpoint-path", default=t.last_checkpoint_path,
                    help="resumable last-state checkpoint written on any "
                         "exit (SIGTERM/Ctrl-C/crash/completion); '' disables")
-    p.add_argument("--resume-from", default=None)
+    p.add_argument("--resume-from", default=None,
+                   help="checkpoint dir to resume from, or 'auto' to "
+                        "pick the newest checkpoint that passes "
+                        "integrity verification (step tree, then "
+                        "last/best), falling back to older ones; with "
+                        "no verified checkpoint, starts fresh")
+    p.add_argument("--ckpt-interval", type=int, default=t.ckpt_interval,
+                   help="iterations between rotating step-NNNNNNNN "
+                        "checkpoints, each certified by a SHA-256 "
+                        "manifest (train/ckpt_writer.py); 0 = off")
+    p.add_argument("--ckpt-dir", default=t.ckpt_dir,
+                   help="root of the step-checkpoint tree ('auto' = "
+                        "<checkpoint-path stem>.steps)")
+    p.add_argument("--ckpt-async", action=argparse.BooleanOptionalAction,
+                   default=t.ckpt_async,
+                   help="write step checkpoints from a background "
+                        "thread (the loop blocks only for the "
+                        "device->host snapshot); --no-ckpt-async "
+                        "writes inline")
+    p.add_argument("--ckpt-keep-last", type=int, default=t.ckpt_keep_last,
+                   help="retention: newest N verified step checkpoints "
+                        "to keep")
+    p.add_argument("--ckpt-keep-every", type=int, default=t.ckpt_keep_every,
+                   help="retention: additionally keep every Nth-step "
+                        "checkpoint forever (0 = none)")
     p.add_argument("--checkpoint-min-interval-s", type=float,
                    default=t.checkpoint_min_interval_s,
                    help="throttle best-checkpoint disk writes to at most "
@@ -177,6 +201,11 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         last_checkpoint_path=args.last_checkpoint_path or None,
         resume_from=args.resume_from,
         checkpoint_min_interval_s=args.checkpoint_min_interval_s,
+        ckpt_interval=args.ckpt_interval,
+        ckpt_dir=args.ckpt_dir,
+        ckpt_async=args.ckpt_async,
+        ckpt_keep_last=args.ckpt_keep_last,
+        ckpt_keep_every=args.ckpt_keep_every,
         anomaly_guard=args.anomaly_guard,
         anomaly_spike_factor=args.anomaly_spike_factor,
         anomaly_warmup_steps=args.anomaly_warmup_steps,
