@@ -218,6 +218,126 @@ class ServingConfig:
 
 
 @dataclass(frozen=True)
+class RouterConfig:
+    """Multi-replica router knobs (serving/router.py).
+
+    The router is the fleet-level robustness layer over N single-engine
+    replicas: it probes each replica's ``/ready`` + ``/metrics``, spreads
+    ``/generate`` traffic with a power-of-two-choices picker over passive
+    load scores, fails retriable replies over to a DIFFERENT replica
+    under a total per-request deadline, and (optionally) hedges requests
+    stuck past a p99-derived latency budget. All knobs are host-side —
+    nothing here touches device code or compile caches.
+    """
+
+    # -- active health probing ----------------------------------------
+    # Seconds between probes of a replica whose last probe succeeded.
+    probe_interval_s: float = 0.5
+    # Per-probe HTTP timeout (GET /ready, GET /metrics).
+    probe_timeout_s: float = 2.0
+    # A FAILING replica is probed with exponential backoff: first retry
+    # after probe_backoff_s, doubling up to probe_backoff_max_s — a dead
+    # host is not hammered at the healthy cadence.
+    probe_backoff_s: float = 0.5
+    probe_backoff_max_s: float = 10.0
+    # Consecutive probe/request transport failures before the replica is
+    # EJECTED (never picked, probed on the backoff schedule).
+    eject_after: int = 3
+    # Slow re-admission: an ejected replica must pass this many
+    # consecutive probes before it takes traffic again (a flapping host
+    # does not oscillate in and out of rotation on one lucky probe).
+    readmit_after: int = 2
+
+    # -- failover / retry ----------------------------------------------
+    # Max failover ATTEMPTS per request (first attempt included).
+    # Attempts prefer distinct replicas, but when nothing un-tried is
+    # eligible a recovered already-tried replica may be re-tried — so
+    # on a small fleet this bounds attempts, not distinct replicas.
+    max_attempts: int = 3
+    # Total per-request wall-clock budget at the router (seconds),
+    # bounding first attempt + backoffs + failovers; a client deadline_s
+    # tightens it further. 0 = unbounded.
+    default_deadline_s: float = 120.0
+    # Jittered-backoff envelope between failover attempts
+    # (serving/retry.py:backoff_delay semantics).
+    retry_base_s: float = 0.05
+    retry_cap_s: float = 1.0
+    # Honored Retry-After values are capped here — a replica asking for
+    # a 30 s drain-budget wait must not stall a request that another
+    # replica could serve right now (and a buggy/hostile header must
+    # never park the router for minutes).
+    retry_after_cap_s: float = 2.0
+
+    # -- hedging -------------------------------------------------------
+    # Fire a second (hedged) attempt on a different replica when the
+    # first has been in flight longer than hedge_factor * observed-p99
+    # latency (floored at hedge_min_s). First reply wins. 0 = off.
+    hedge_factor: float = 0.0
+    hedge_min_s: float = 0.25
+
+    # -- load scoring (power-of-two-choices inputs) --------------------
+    # score = queue_weight * queue_depth/slots
+    #       + slot_weight  * slot_occupancy/slots
+    #       + kv_weight    * kv_utilization
+    #       + inflight/slots   (router-side, always on: the passive
+    #         metrics are probe-stale; in-flight counts are not)
+    queue_weight: float = 1.0
+    slot_weight: float = 1.0
+    kv_weight: float = 0.5
+
+    # -- admission shedding / affinity ---------------------------------
+    # Before shedding (or failing a mid-failover request), wait up to
+    # this long for SOME replica to become eligible — it bridges the
+    # sub-second windows where a rolling restart has one replica
+    # draining and the other not yet re-admitted. Bounded additionally
+    # by the request's deadline. 0 = shed immediately.
+    wait_for_replica_s: float = 2.0
+    # Retry-After sent when the router itself sheds (zero eligible
+    # replicas, or every eligible replica already tried and failed).
+    shed_retry_after_s: float = 1.0
+    # Sticky session routing: requests carrying a "session_id" stick to
+    # one replica (prefix-cache locality groundwork, ROADMAP item 1)
+    # and fail over — with re-pinning — when it dies.
+    affinity: bool = True
+    # The affinity map is LRU-capped at this many sessions — a router
+    # fronting months of unique session_ids must not grow without
+    # bound. Evicting a quiet session only costs it its pin.
+    affinity_max_sessions: int = 10_000
+
+    def __post_init__(self):
+        for name in ("probe_interval_s", "probe_timeout_s",
+                     "probe_backoff_s", "probe_backoff_max_s",
+                     "default_deadline_s", "retry_base_s", "retry_cap_s",
+                     "retry_after_cap_s", "hedge_factor", "hedge_min_s",
+                     "queue_weight", "slot_weight", "kv_weight",
+                     "wait_for_replica_s", "shed_retry_after_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0, got {getattr(self, name)}"
+                )
+        if self.eject_after < 1:
+            raise ValueError(
+                f"eject_after must be >= 1, got {self.eject_after}"
+            )
+        if self.readmit_after < 1:
+            raise ValueError(
+                f"readmit_after must be >= 1, got {self.readmit_after}"
+            )
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}"
+            )
+        if self.affinity_max_sessions < 1:
+            raise ValueError(
+                f"affinity_max_sessions must be >= 1, got "
+                f"{self.affinity_max_sessions}"
+            )
+
+    def replace(self, **kw) -> "RouterConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Logical device mesh. The reference has no working distributed path
     (NCCL/DDP imported but never initialized, train.py:7-10,88); this is the

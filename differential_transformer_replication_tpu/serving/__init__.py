@@ -1,45 +1,87 @@
-"""Continuous-batching inference serving (see serving/engine.py)."""
+"""Continuous-batching inference serving (see serving/engine.py).
 
-from differential_transformer_replication_tpu.serving.engine import (
-    EngineCrashError,
-    ServingEngine,
-)
-from differential_transformer_replication_tpu.serving.request import (
-    Request,
-    RequestOutput,
-    SamplingParams,
-)
-from differential_transformer_replication_tpu.serving.retry import (
-    backoff_delay,
-    call_with_retries,
-    http_post_json_with_retries,
-)
-from differential_transformer_replication_tpu.serving.scheduler import (
-    DeadlineExceededError,
-    QueueFullError,
-    Scheduler,
-)
-from differential_transformer_replication_tpu.serving.server import (
-    EngineRunner,
-    ServingClient,
-    ShuttingDownError,
-    serve,
-)
+Exports resolve lazily (PEP 562): the engine/server stack pulls in jax,
+but the host-side members of this package — :mod:`serving.retry` and
+:mod:`serving.router` — are pure stdlib and must stay importable from
+processes that deliberately avoid the device runtime (the fleet
+launcher and router front, tools/fleet.py, which babysit the very
+processes whose runtime may be crashing). ``from ...serving import
+ServingEngine`` works exactly as before; it just pays the jax import at
+first attribute access instead of at package import.
+"""
 
-__all__ = [
-    "ServingEngine",
-    "EngineCrashError",
-    "Request",
-    "RequestOutput",
-    "SamplingParams",
-    "Scheduler",
-    "QueueFullError",
-    "DeadlineExceededError",
-    "ShuttingDownError",
-    "EngineRunner",
-    "ServingClient",
-    "serve",
-    "backoff_delay",
-    "call_with_retries",
-    "http_post_json_with_retries",
-]
+from typing import TYPE_CHECKING
+
+# attribute name -> submodule that defines it
+_EXPORTS = {
+    "ServingEngine": "engine",
+    "EngineCrashError": "engine",
+    "Request": "request",
+    "RequestOutput": "request",
+    "SamplingParams": "request",
+    "Scheduler": "scheduler",
+    "QueueFullError": "scheduler",
+    "DeadlineExceededError": "scheduler",
+    "ShuttingDownError": "server",
+    "EngineRunner": "server",
+    "ServingClient": "server",
+    "serve": "server",
+    "backoff_delay": "retry",
+    "call_with_retries": "retry",
+    "http_post_json_with_retries": "retry",
+    "Router": "router",
+    "Replica": "router",
+    "serve_router": "router",
+}
+
+__all__ = sorted(_EXPORTS)
+
+if TYPE_CHECKING:  # static analyzers see the eager imports
+    from differential_transformer_replication_tpu.serving.engine import (
+        EngineCrashError,
+        ServingEngine,
+    )
+    from differential_transformer_replication_tpu.serving.request import (
+        Request,
+        RequestOutput,
+        SamplingParams,
+    )
+    from differential_transformer_replication_tpu.serving.retry import (
+        backoff_delay,
+        call_with_retries,
+        http_post_json_with_retries,
+    )
+    from differential_transformer_replication_tpu.serving.router import (
+        Replica,
+        Router,
+        serve_router,
+    )
+    from differential_transformer_replication_tpu.serving.scheduler import (
+        DeadlineExceededError,
+        QueueFullError,
+        Scheduler,
+    )
+    from differential_transformer_replication_tpu.serving.server import (
+        EngineRunner,
+        ServingClient,
+        ShuttingDownError,
+        serve,
+    )
+
+
+def __getattr__(name: str):
+    submodule = _EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    module = importlib.import_module(f"{__name__}.{submodule}")
+    value = getattr(module, name)
+    globals()[name] = value  # cache: resolve each name once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

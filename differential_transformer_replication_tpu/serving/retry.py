@@ -75,6 +75,9 @@ def http_post_json_with_retries(
     max_retries: int = 3, base: float = 0.2, cap: float = 5.0,
     rng: Optional[random.Random] = None,
     sleep: Callable[[float], None] = time.sleep,
+    deadline_s: Optional[float] = None,
+    retry_after_cap: float = 30.0,
+    clock: Callable[[], float] = time.monotonic,
 ) -> Tuple[int, dict, int]:
     """POST JSON, retrying retriable 503s (honoring ``Retry-After``)
     and transport errors with jittered backoff; returns
@@ -90,16 +93,30 @@ def http_post_json_with_retries(
     retriable. When the retry budget runs out the last 503 is returned
     as its status (or raised with ``retry_attempts`` set, for transport
     errors) rather than hidden.
+
+    ``deadline_s`` budgets TOTAL elapsed time (attempts + backoffs)
+    against the same deadline the server enforces: a retry whose
+    backoff would land past it is not taken — the server would only
+    answer 504 — and each attempt's transport timeout is clamped to
+    the time remaining. Honored ``Retry-After`` values are capped at
+    ``retry_after_cap`` seconds so a long drain budget (or a buggy
+    header) can never park the client longer than its own deadline
+    policy allows; the jittered-backoff envelope is unaffected.
+    ``clock`` is injectable for tests (pairs with ``sleep``).
     """
     attempt = 0
+    end = None if deadline_s is None else clock() + deadline_s
     while True:
         retry_after = None
         try:
+            attempt_timeout = timeout
+            if end is not None:
+                attempt_timeout = max(0.001, min(timeout, end - clock()))
             req = urllib.request.Request(
                 url, data=json.dumps(payload).encode(),
                 headers={"Content-Type": "application/json"},
             )
-            with urllib.request.urlopen(req, timeout=timeout) as r:
+            with urllib.request.urlopen(req, timeout=attempt_timeout) as r:
                 return r.status, json.load(r), attempt
         except urllib.error.HTTPError as e:
             body = {}
@@ -117,15 +134,28 @@ def http_post_json_with_retries(
             ra = e.headers.get("Retry-After")
             if ra is not None:
                 try:
-                    retry_after = float(ra)
+                    retry_after = min(float(ra), retry_after_cap)
                 except ValueError:
                     pass
-        except (urllib.error.URLError, TimeoutError, ConnectionError) as e:
+            delay = backoff_delay(attempt, base, cap,
+                                  retry_after=retry_after, rng=rng)
+            if end is not None and clock() + delay >= end:
+                # the deadline would expire mid-backoff: surface the
+                # last typed 503 now instead of retrying into a 504
+                return e.code, body, attempt
+        except (urllib.error.URLError, TimeoutError, ConnectionError,
+                ValueError) as e:
             # transport-level: the server may be mid-restart; retry on
-            # the same schedule, raise when the budget runs out
+            # the same schedule, raise when the budget runs out.
+            # ValueError covers a 200 whose body arrives truncated or
+            # garbled (a server killed mid-response) — same class of
+            # failure as the connection dying outright
             if attempt >= max_retries:
                 e.retry_attempts = attempt
                 raise
-        sleep(backoff_delay(attempt, base, cap,
-                            retry_after=retry_after, rng=rng))
+            delay = backoff_delay(attempt, base, cap, rng=rng)
+            if end is not None and clock() + delay >= end:
+                e.retry_attempts = attempt
+                raise
+        sleep(delay)
         attempt += 1

@@ -1,0 +1,923 @@
+"""Health-aware multi-replica serving router.
+
+PRs 3-5 built per-instance resilience — typed retriable errors with
+Retry-After, ``/health``/``/ready``, graceful drain, ``/metrics``
+gauges — but one engine crash or restart was still a full outage for
+its traffic. This module composes N replicas into one fleet-level
+endpoint (ROADMAP item 4):
+
+- **Replica registry + active probing** — each replica is probed at
+  ``GET /ready`` (state: up / draining / not_ready) and scored from its
+  ``GET /metrics`` gauges (queue depth, slot occupancy, KV
+  utilization). Failing replicas are probed on an exponential backoff
+  and EJECTED after ``RouterConfig.eject_after`` consecutive transport
+  failures; re-admission is slow (``readmit_after`` consecutive good
+  probes) so a flapping host cannot oscillate into rotation.
+- **Power-of-two-choices picking** — two random eligible replicas,
+  lower load score wins. The score blends the probe-stale passive
+  metrics with the router's own live in-flight count, so balance holds
+  even between probes.
+- **Failover on typed errors** — a retriable reply (503 queue_full /
+  shutting_down / engine_crash, or an unreachable replica) is retried
+  on a DIFFERENT replica under a total per-request deadline budget;
+  non-recoverable codes (504 deadline, timeout, engine_failed) pass
+  through untouched. Honored Retry-After values are capped
+  (``retry_after_cap_s``) — another replica can usually serve NOW.
+- **Hedging (optional)** — a request stuck past a p99-derived latency
+  budget fires a second attempt on another replica; first reply wins.
+- **Session affinity** — requests carrying ``session_id`` stick to one
+  replica (prefix-cache locality groundwork, ROADMAP item 1) and
+  re-pin elsewhere when the pinned replica dies.
+- **Router-level degradation** — zero eligible replicas means a fast
+  503 ``no_replica`` with Retry-After, not a hang; the router's own
+  ``/health``, ``/ready`` and ``/metrics`` (per-replica request/error/
+  ejection counters, pick latency, hedge counters via obs/registry.py)
+  make the fleet observable as one unit.
+
+Drain-aware by construction: a replica answering ``/ready`` 503 with
+status ``draining`` (what SIGTERM triggers, serving/server.py) is
+removed from rotation WITHOUT being ejected — no connection ever
+breaks, which is what makes tools/fleet.py's rolling restarts
+zero-loss.
+
+Pure stdlib, no jax import — the router must keep routing while the
+device runtimes it fronts are the things crashing. Successful replies
+gain ``replica`` / ``attempts`` / ``hedged`` fields so every response
+is attributable (tools/serve_bench.py's per-replica breakdown keys off
+them).
+
+Run standalone::
+
+    python -m differential_transformer_replication_tpu.serving.router \
+        --target http://127.0.0.1:8101 --target http://127.0.0.1:8102 \
+        --port 8000
+
+or let ``tools/fleet.py`` launch replicas + router together.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from collections import OrderedDict, deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from differential_transformer_replication_tpu.config import RouterConfig
+from differential_transformer_replication_tpu.obs.registry import (
+    CONTENT_TYPE as METRICS_CONTENT_TYPE,
+    Registry,
+)
+from differential_transformer_replication_tpu.serving.retry import (
+    backoff_delay,
+)
+from differential_transformer_replication_tpu.utils import faults
+
+# Replica lifecycle states. UP is the only state the picker considers.
+UP = "up"                  # last probe: reachable and ready
+NOT_READY = "not_ready"    # reachable, /ready 503 (e.g. restarting)
+DRAINING = "draining"      # reachable, /ready 503 with status=draining
+EJECTED = "ejected"        # eject_after consecutive transport failures
+UNKNOWN = "unknown"        # never successfully probed yet
+
+# Reply codes the router retries on a different replica. Anything else
+# on a 503 that is not explicitly non-retriable (unknown proxies) is
+# also retried — mirrors serving/retry.py's stance.
+NON_RETRIABLE_503_CODES = ("timeout", "engine_failed")
+
+# /metrics gauge names (serving/engine.py) -> Replica score fields.
+_SCORE_METRICS = {
+    "serving_queue_depth": "queue_depth",
+    "serving_slot_occupancy": "slot_occupancy",
+    "serving_slots": "slots",
+    "serving_kv_utilization": "kv_utilization",
+}
+
+
+def parse_replica_scores(text: str) -> Dict[str, float]:
+    """Extract the load-score gauges from a Prometheus text exposition.
+    Unknown/malformed lines are skipped — a replica with a bigger
+    registry (or none of these gauges) still probes fine."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            continue
+        key = _SCORE_METRICS.get(parts[0])
+        if key is not None:
+            try:
+                out[key] = float(parts[1])
+            except ValueError:
+                pass
+    return out
+
+
+class Replica:
+    """One backend's registry entry: URL, health state machine, passive
+    load scores, and router-side in-flight count. All mutation happens
+    under ``self.lock``; the state machine itself is pure bookkeeping
+    (``note_*`` methods) so tests drive it without HTTP."""
+
+    def __init__(self, url: str, cfg: RouterConfig):
+        self.url = url.rstrip("/")
+        # label/attribution name: host:port reads better than a full URL
+        split = urllib.parse.urlsplit(self.url)
+        self.name = split.netloc or self.url
+        self.cfg = cfg
+        self.lock = threading.Lock()
+        self.state = UNKNOWN
+        self.status = "unknown"    # replica-reported status string
+        self.consec_fail = 0       # consecutive transport failures
+        self.consec_ok = 0         # consecutive good probes (re-admission)
+        self.ejections = 0
+        self.inflight = 0          # router-side live requests
+        self.queue_depth = 0.0
+        self.slot_occupancy = 0.0
+        self.slots = 1.0
+        self.kv_utilization = 0.0
+        self.next_probe_t = 0.0    # monotonic ts of the next due probe
+        self.probe_backoff = cfg.probe_backoff_s
+        self.probing = False       # an async probe is in flight
+        self.last_probe_ok_t: Optional[float] = None
+
+    def eligible(self) -> bool:
+        with self.lock:
+            return self.state == UP
+
+    def score(self) -> float:
+        """Load score for power-of-two-choices (lower = less loaded)."""
+        cfg = self.cfg
+        with self.lock:
+            slots = max(1.0, self.slots)
+            return (
+                cfg.queue_weight * self.queue_depth / slots
+                + cfg.slot_weight * self.slot_occupancy / slots
+                + cfg.kv_weight * self.kv_utilization
+                + self.inflight / slots
+            )
+
+    # -- health state machine -----------------------------------------
+
+    def note_probe_success(self, ready: bool, status: str,
+                           scores: Dict[str, float], now: float) -> None:
+        """A probe REACHED the replica (whatever it answered)."""
+        with self.lock:
+            self.consec_fail = 0
+            self.probe_backoff = self.cfg.probe_backoff_s
+            self.next_probe_t = now + self.cfg.probe_interval_s
+            self.last_probe_ok_t = now
+            self.status = status
+            for key, value in scores.items():
+                setattr(self, key, value)
+            if not ready:
+                # reachable but refusing traffic: connection-free
+                # removal (drain / restart), NOT an ejection — and it
+                # resets the re-admission streak. An EJECTED replica
+                # STAYS ejected (a booting relaunch answering
+                # "restarting" must not launder away the slow
+                # re-admission requirement)
+                self.consec_ok = 0
+                if self.state != EJECTED:
+                    self.state = (
+                        DRAINING if status == "draining" else NOT_READY
+                    )
+                return
+            self.consec_ok += 1
+            if self.state == EJECTED:
+                # slow re-admission: one good probe is not enough
+                if self.consec_ok >= self.cfg.readmit_after:
+                    self.state = UP
+                return
+            self.state = UP
+
+    def note_failure(self, now: float) -> bool:
+        """A probe or forwarded request could not reach the replica.
+        Returns True when this failure newly ejected it."""
+        with self.lock:
+            self.consec_ok = 0
+            self.consec_fail += 1
+            self.next_probe_t = now + self.probe_backoff
+            self.probe_backoff = min(
+                self.probe_backoff * 2, self.cfg.probe_backoff_max_s
+            )
+            if (self.consec_fail >= self.cfg.eject_after
+                    and self.state != EJECTED):
+                self.state = EJECTED
+                self.ejections += 1
+                return True
+            return False
+
+    def note_request_success(self) -> None:
+        """A forwarded request got an HTTP answer: the transport works,
+        whatever the status code said. Does NOT touch probe state —
+        only probes can re-admit an ejected replica (slow re-admission
+        stays meaningful under live traffic)."""
+        with self.lock:
+            if self.state != EJECTED:
+                self.consec_fail = 0
+
+    def snapshot(self) -> dict:
+        """Point-in-time view for the router's /health JSON."""
+        with self.lock:
+            return {
+                "url": self.url,
+                "name": self.name,
+                "state": self.state,
+                "status": self.status,
+                "inflight": self.inflight,
+                "consec_fail": self.consec_fail,
+                "ejections": self.ejections,
+                "queue_depth": self.queue_depth,
+                "slot_occupancy": self.slot_occupancy,
+                "slots": self.slots,
+                "kv_utilization": self.kv_utilization,
+            }
+
+
+class Router:
+    """The fleet front: replica registry + prober + picker + failover.
+
+    ``start()`` runs one synchronous probe pass (so the router knows its
+    fleet before the first request) and then probes from a background
+    thread; ``close()`` stops it. ``handle_generate`` is the whole
+    request path — :func:`serve_router` is just HTTP plumbing around
+    it. ``probe_fn``/``forward_fn``/``sleep``/``rng`` are injectable
+    for tests.
+    """
+
+    def __init__(self, targets: Sequence[str],
+                 cfg: Optional[RouterConfig] = None,
+                 registry: Optional[Registry] = None,
+                 rng: Optional[random.Random] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        if not targets:
+            raise ValueError("router needs at least one replica URL")
+        self.cfg = cfg or RouterConfig()
+        self.replicas = [Replica(t, self.cfg) for t in targets]
+        if len({r.url for r in self.replicas}) != len(self.replicas):
+            raise ValueError(f"duplicate replica URLs in {list(targets)}")
+        self.registry = registry or Registry()
+        self._rng = rng or random.Random()
+        self._rng_lock = threading.Lock()
+        self._sleep = sleep
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        # session affinity: session_id -> Replica, LRU-capped at
+        # cfg.affinity_max_sessions (unique sessions are unbounded over
+        # a router's lifetime; pins are cheap to lose)
+        self._affinity: "OrderedDict[str, Replica]" = OrderedDict()
+        self._aff_lock = threading.Lock()
+        # latency reservoir feeding the p99-derived hedge budget
+        self._lat_lock = threading.Lock()
+        self._latencies: deque = deque(maxlen=512)
+
+        reg = self.registry
+        self._req_counter = reg.counter(
+            "router_requests_total",
+            "Requests forwarded to a replica (attempts, incl. hedges).",
+            labelnames=("replica",),
+        )
+        self._err_counter = reg.counter(
+            "router_replica_errors_total",
+            "Non-200 replica replies and transport failures, by code.",
+            labelnames=("replica", "code"),
+        )
+        self._retry_counter = reg.counter(
+            "router_retries_total",
+            "Failovers: attempts re-sent to a different replica.",
+        )
+        self._hedge_counter = reg.counter(
+            "router_hedges_total",
+            "Hedged second attempts fired for slow requests.",
+        )
+        self._hedge_win_counter = reg.counter(
+            "router_hedge_wins_total",
+            "Requests whose winning reply came from the hedge.",
+        )
+        self._eject_counter = reg.counter(
+            "router_ejections_total",
+            "Replica ejections after consecutive transport failures.",
+            labelnames=("replica",),
+        )
+        self._shed_counter = reg.counter(
+            "router_shed_total",
+            "Requests shed at the router (no eligible replica).",
+        )
+        self._move_counter = reg.counter(
+            "router_session_moves_total",
+            "Sticky sessions re-pinned because their replica died.",
+        )
+        self._pick_hist = reg.histogram(
+            "router_pick_seconds",
+            "Latency of one replica pick (registry scan + scoring).",
+        )
+        self._eligible_gauge = reg.gauge(
+            "router_replicas_eligible",
+            "Replicas currently in rotation (state=up).",
+        )
+        reg.gauge(
+            "router_replicas", "Configured replica count."
+        ).set(len(self.replicas))
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "Router":
+        """Probe every replica once (concurrently — one slow replica
+        must not delay knowing about the others), then keep probing
+        from a daemon thread."""
+        now = time.monotonic()
+        initial = [
+            threading.Thread(target=self.probe, args=(r, now),
+                             daemon=True)
+            for r in self.replicas
+        ]
+        for t in initial:
+            t.start()
+        for t in initial:
+            t.join(self.cfg.probe_timeout_s * 2 + 1.0)
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="router-prober", daemon=True
+        )
+        self._probe_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(5.0)
+            self._probe_thread = None
+
+    # -- probing -------------------------------------------------------
+
+    def _http_get(self, url: str, timeout: float) -> Tuple[int, bytes]:
+        """GET returning (status, body) — reachable 503s are ANSWERS
+        here, not exceptions; transport errors propagate."""
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read() or b""
+
+    def probe(self, replica: Replica, now: Optional[float] = None) -> None:
+        """One probe: /ready for state, /metrics (best-effort) for load
+        scores. Transport failures drive the ejection state machine."""
+        t = self.cfg.probe_timeout_s
+        try:
+            faults.check("router_probe_fail")
+            status_code, body = self._http_get(
+                replica.url + "/ready", timeout=t
+            )
+            try:
+                payload = json.loads(body or b"{}")
+            except ValueError:
+                payload = {}
+            ready = status_code == 200 and bool(payload.get("ready", True))
+            status = str(payload.get("status", "unknown"))
+            scores: Dict[str, float] = {}
+            if ready:
+                try:
+                    code, text = self._http_get(
+                        replica.url + "/metrics", timeout=t
+                    )
+                    if code == 200:
+                        scores = parse_replica_scores(
+                            text.decode("utf-8", "replace")
+                        )
+                except OSError:
+                    pass  # scores are advisory; /ready is the contract
+            replica.note_probe_success(
+                ready, status, scores,
+                now=time.monotonic() if now is None else now,
+            )
+        except Exception:
+            # unreachable (or an injected probe failure): one strike
+            newly_ejected = replica.note_failure(
+                time.monotonic() if now is None else now
+            )
+            if newly_ejected:
+                self._eject_counter.inc(replica=replica.name)
+                print(f"[router] replica {replica.name} ejected after "
+                      f"{replica.consec_fail} consecutive failures",
+                      file=sys.stderr)
+        self.eligible_count()  # refresh the eligibility gauge
+
+    def _probe_and_release(self, replica: Replica) -> None:
+        try:
+            self.probe(replica)
+        finally:
+            with replica.lock:
+                replica.probing = False
+
+    def _probe_loop(self) -> None:
+        """Dispatch due probes, each on its own short-lived thread — a
+        blackholed replica blocking its full probe timeout must not
+        stall health detection (ejection, re-admission) for the rest
+        of the fleet. At most one probe per replica is in flight."""
+        while not self._stop.is_set():
+            now = time.monotonic()
+            next_due = now + self.cfg.probe_interval_s
+            for r in self.replicas:
+                with r.lock:
+                    due = r.next_probe_t <= now and not r.probing
+                    if due:
+                        r.probing = True
+                    elif not r.probing:
+                        next_due = min(next_due, r.next_probe_t)
+                if due:
+                    threading.Thread(
+                        target=self._probe_and_release, args=(r,),
+                        daemon=True,
+                    ).start()
+            # wake for the earliest due probe; floor keeps a busy loop
+            # impossible, cap keeps shutdown and new faults responsive
+            self._stop.wait(min(max(next_due - time.monotonic(), 0.01),
+                                0.25))
+
+    # -- picking -------------------------------------------------------
+
+    def pick(self, session_id: Optional[str] = None,
+             exclude: Sequence[str] = ()) -> Optional[Replica]:
+        """Choose a replica: sticky session first (if its pin is still
+        eligible), else power-of-two-choices by load score. Returns
+        None when nothing is eligible. ``exclude`` lists replica URLs
+        already tried by this request (failover must move)."""
+        t0 = time.perf_counter()
+        try:
+            faults.check("router_pick_raise")
+            eligible = [
+                r for r in self.replicas
+                if r.eligible() and r.url not in exclude
+            ]
+            if session_id is not None and self.cfg.affinity:
+                with self._aff_lock:
+                    pinned = self._affinity.get(session_id)
+                    if pinned is not None:
+                        self._affinity.move_to_end(session_id)
+                pinned_alive = pinned is not None and pinned.eligible()
+                if pinned_alive and pinned.url not in exclude:
+                    return pinned
+                if not eligible:
+                    return None
+                choice = self._p2c(eligible)
+                if pinned_alive:
+                    # the pin is healthy but excluded by THIS request's
+                    # failover (a transient queue_full, say): serve
+                    # elsewhere without re-pinning — one backpressure
+                    # blip must not permanently forfeit the session's
+                    # prefix-cache locality
+                    return choice
+                with self._aff_lock:
+                    self._affinity[session_id] = choice
+                    self._affinity.move_to_end(session_id)
+                    while (len(self._affinity)
+                           > self.cfg.affinity_max_sessions):
+                        self._affinity.popitem(last=False)
+                if pinned is not None:
+                    self._move_counter.inc()  # pinned replica died
+                return choice
+            if not eligible:
+                return None
+            return self._p2c(eligible)
+        finally:
+            self._pick_hist.observe(time.perf_counter() - t0)
+
+    def _p2c(self, eligible: List[Replica]) -> Replica:
+        if len(eligible) == 1:
+            return eligible[0]
+        with self._rng_lock:
+            a, b = self._rng.sample(eligible, 2)
+        return a if a.score() <= b.score() else b
+
+    # -- forwarding ----------------------------------------------------
+
+    def _forward(self, replica: Replica, payload: dict, timeout: float,
+                 timeout_is_deadline: bool = False,
+                 ) -> Tuple[int, dict, Optional[float]]:
+        """POST one attempt to one replica. Returns ``(status, body,
+        retry_after)``; transport failures come back as status ``-1``
+        with a typed body (and count toward the replica's ejection
+        streak) instead of raising — the failover loop treats them like
+        a retriable 503 from a replica that told us nothing.
+
+        ``timeout_is_deadline`` marks a timeout clamped to the
+        request's remaining deadline budget: hitting it means the
+        REQUEST ran out of time while the replica worked, so it maps
+        to a non-retriable 504 ``deadline`` and the replica takes no
+        ejection strike — three slow requests must not eject a healthy
+        replica."""
+        with replica.lock:
+            replica.inflight += 1
+        self._req_counter.inc(replica=replica.name)
+        t0 = time.perf_counter()
+        try:
+            faults.stall("router_replica_hang")
+            req = urllib.request.Request(
+                replica.url + "/generate",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                body = json.load(r)
+                if not isinstance(body, dict):
+                    raise ValueError(f"non-object reply: {body!r}")
+                replica.note_request_success()
+                with self._lat_lock:
+                    self._latencies.append(time.perf_counter() - t0)
+                return r.status, body, None
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except (ValueError, OSError):
+                body = {}
+            replica.note_request_success()  # transport worked
+            self._err_counter.inc(
+                replica=replica.name,
+                code=str(body.get("code", e.code)),
+            )
+            retry_after = None
+            ra = e.headers.get("Retry-After")
+            if ra is not None:
+                try:
+                    retry_after = float(ra)
+                except ValueError:
+                    pass
+            return e.code, body, retry_after
+        except (urllib.error.URLError, TimeoutError, ConnectionError,
+                OSError, ValueError) as e:
+            timed_out = isinstance(e, TimeoutError) or isinstance(
+                getattr(e, "reason", None), TimeoutError
+            )
+            if timed_out and timeout_is_deadline:
+                self._err_counter.inc(
+                    replica=replica.name, code="deadline"
+                )
+                return 504, {
+                    "error": f"request deadline expired after "
+                             f"{timeout:.3f}s waiting on replica "
+                             f"{replica.name}",
+                    "code": "deadline",
+                }, None
+            # ValueError = truncated/garbage reply body — a replica
+            # SIGKILLed mid-response looks like this, and it must fail
+            # over like any other transport death, not surface a 500
+            if replica.note_failure(time.monotonic()):
+                self._eject_counter.inc(replica=replica.name)
+                print(f"[router] replica {replica.name} ejected "
+                      f"(request transport failure: {e!r})",
+                      file=sys.stderr)
+            self._err_counter.inc(
+                replica=replica.name, code="unreachable"
+            )
+            return -1, {
+                "error": f"replica {replica.name} unreachable: {e!r}",
+                "code": "replica_unreachable",
+            }, None
+        finally:
+            with replica.lock:
+                replica.inflight -= 1
+
+    def _hedge_budget(self) -> Optional[float]:
+        """Seconds to wait before hedging, derived from observed p99
+        latency; None = hedging off."""
+        if self.cfg.hedge_factor <= 0:
+            return None
+        with self._lat_lock:
+            xs = sorted(self._latencies)
+        if not xs:
+            return max(self.cfg.hedge_min_s, 0.0)
+        p99 = xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+        return max(self.cfg.hedge_min_s, self.cfg.hedge_factor * p99)
+
+    def _attempt(self, replica: Replica, payload: dict, timeout: float,
+                 exclude: Sequence[str],
+                 timeout_is_deadline: bool = False):
+        """One failover attempt, with an optional hedged twin. Returns
+        ``(status, body, retry_after, replica, hedged)`` where
+        ``replica`` is the one whose reply was used."""
+        budget = self._hedge_budget()
+        if budget is None:
+            status, body, ra = self._forward(
+                replica, payload, timeout, timeout_is_deadline
+            )
+            return status, body, ra, replica, False
+
+        cond = threading.Condition()
+        results: List[Tuple[int, dict, Optional[float], Replica]] = []
+        expected = [1]
+
+        def run(rep: Replica) -> None:
+            out = self._forward(rep, payload, timeout,
+                                timeout_is_deadline)
+            with cond:
+                results.append((*out, rep))
+                cond.notify_all()
+
+        threading.Thread(target=run, args=(replica,), daemon=True).start()
+        hedged = False
+        end = time.monotonic() + timeout + 1.0
+        with cond:
+            if not results:
+                cond.wait(budget)
+            if not results:
+                # primary is slow: fire the hedge on a different replica
+                other = self.pick(
+                    exclude=tuple(exclude) + (replica.url,)
+                )
+                if other is not None:
+                    hedged = True
+                    self._hedge_counter.inc()
+                    threading.Thread(
+                        target=run, args=(other,), daemon=True
+                    ).start()
+                    expected[0] = 2
+            while True:
+                if any(s == 200 for s, _, _, _ in results):
+                    break
+                if len(results) >= expected[0]:
+                    break
+                left = end - time.monotonic()
+                if left <= 0 or not cond.wait(min(left, 1.0)):
+                    if time.monotonic() >= end:
+                        break
+            done = list(results)
+        for status, body, ra, rep in done:
+            if status == 200:
+                if hedged and rep is not replica:
+                    self._hedge_win_counter.inc()
+                return status, body, ra, rep, hedged
+        if done:
+            # no winner: report the primary's failure when it answered,
+            # else whatever the hedge saw
+            for status, body, ra, rep in done:
+                if rep is replica:
+                    return status, body, ra, rep, hedged
+            status, body, ra, rep = done[0]
+            return status, body, ra, rep, hedged
+        return -1, {
+            "error": f"replica {replica.name} did not answer in time",
+            "code": "replica_unreachable",
+        }, None, replica, hedged
+
+    def _pick_for_attempt(self, session_id: Optional[str],
+                          tried: Sequence[str],
+                          end: Optional[float]) -> Optional[Replica]:
+        """Pick with graceful degradation: prefer an un-tried eligible
+        replica; fall back to RE-trying one that recovered (a rebooted
+        replica beats a guaranteed failure); and when nothing at all is
+        eligible, wait up to ``wait_for_replica_s`` (bounded by the
+        request deadline) — that bridges the sub-second windows of a
+        rolling restart where one replica is draining and the other is
+        mid-re-admission."""
+        wait_end = time.monotonic() + self.cfg.wait_for_replica_s
+        if end is not None:
+            wait_end = min(wait_end, end)
+        while True:
+            replica = self.pick(session_id=session_id, exclude=tried)
+            if replica is None and tried:
+                replica = self.pick(session_id=session_id)
+            if replica is not None:
+                return replica
+            if time.monotonic() >= wait_end:
+                return None
+            self._sleep(min(
+                0.05, max(0.001, wait_end - time.monotonic())
+            ))
+
+    # -- the request path ----------------------------------------------
+
+    def handle_generate(self, payload: dict) -> Tuple[int, dict, dict]:
+        """Route one /generate request; returns ``(status, body,
+        headers)``. Implements admission shedding, failover across
+        distinct replicas under the deadline budget, Retry-After
+        capping, affinity, and response attribution."""
+        session_id = payload.get("session_id")
+        if session_id is not None:
+            session_id = str(session_id)
+        budget = self.cfg.default_deadline_s
+        try:
+            client_deadline = float(payload.get("deadline_s") or 0.0)
+        except (TypeError, ValueError):
+            client_deadline = 0.0
+        if client_deadline > 0:
+            budget = (
+                min(budget, client_deadline) if budget > 0
+                else client_deadline
+            )
+        end = time.monotonic() + budget if budget > 0 else None
+        shed_headers = {
+            "Retry-After": _fmt_secs(self.cfg.shed_retry_after_s)
+        }
+        tried: List[str] = []
+        last: Optional[Tuple[int, dict, dict]] = None
+        attempt = 0
+        while True:
+            replica = self._pick_for_attempt(session_id, tried, end)
+            if replica is None:
+                if last is not None:
+                    return last
+                # nothing eligible within the wait budget: shed typed
+                self._shed_counter.inc()
+                return 503, {
+                    "error": "no replica available "
+                             "(all ejected, draining, or not ready)",
+                    "code": "no_replica",
+                }, shed_headers
+            timeout = 600.0
+            timeout_is_deadline = False
+            if end is not None:
+                timeout = max(0.05, end - time.monotonic())
+                timeout_is_deadline = True
+            status, body, retry_after, used, hedged = self._attempt(
+                replica, payload, timeout, tried, timeout_is_deadline
+            )
+            attempt += 1
+            if status == 200:
+                body["replica"] = used.name
+                body["attempts"] = attempt
+                body["hedged"] = hedged
+                return 200, body, {}
+            retriable = status == -1 or (
+                status == 503
+                and body.get("code") not in NON_RETRIABLE_503_CODES
+            )
+            if not retriable:
+                # non-recoverable (504 deadline, timeout,
+                # engine_failed, 4xx/5xx): pass through, attributed
+                body.setdefault("replica", used.name)
+                return (status, body, {})
+            tried.append(replica.url)
+            if used is not replica and used.url not in tried:
+                tried.append(used.url)  # a failed hedge also counts
+            capped_ra = None
+            if retry_after is not None:
+                capped_ra = min(retry_after, self.cfg.retry_after_cap_s)
+            headers = {
+                "Retry-After": _fmt_secs(
+                    capped_ra if capped_ra is not None
+                    else self.cfg.shed_retry_after_s
+                )
+            }
+            last = (503 if status == -1 else status, body, headers)
+            if attempt >= self.cfg.max_attempts:
+                return last
+            delay = backoff_delay(
+                attempt - 1, base=self.cfg.retry_base_s,
+                cap=self.cfg.retry_cap_s, retry_after=capped_ra,
+                rng=self._rng,
+            )
+            if end is not None and time.monotonic() + delay >= end:
+                # deadline would expire mid-backoff: surface the last
+                # typed failure instead of manufacturing a 504
+                return last
+            self._retry_counter.inc()
+            self._sleep(delay)
+
+    # -- fleet observability -------------------------------------------
+
+    def eligible_count(self) -> int:
+        n = sum(1 for r in self.replicas if r.eligible())
+        self._eligible_gauge.set(n)
+        return n
+
+    def health(self) -> dict:
+        return {
+            "ok": self.eligible_count() > 0,
+            "eligible": self.eligible_count(),
+            "replicas": [r.snapshot() for r in self.replicas],
+        }
+
+
+def _fmt_secs(secs: float) -> str:
+    """Retry-After header value: integer seconds, floored at 1 (the
+    header is delta-seconds; 0 invites an instant re-pile-on)."""
+    return str(max(1, int(secs)))
+
+
+def _make_handler(router: Router):
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, payload: dict,
+                   headers: Optional[dict] = None) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                body = router.registry.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", METRICS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/health":
+                self._reply(200, router.health())
+            elif self.path == "/ready":
+                n = router.eligible_count()
+                if n > 0:
+                    self._reply(200, {"ready": True, "eligible": n})
+                else:
+                    self._reply(
+                        503, {"ready": False, "eligible": 0},
+                        headers={"Retry-After": _fmt_secs(
+                            router.cfg.shed_retry_after_s
+                        )},
+                    )
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}",
+                                  "code": "bad_request"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._reply(404, {"error": f"unknown path {self.path}",
+                                  "code": "bad_request"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("request body must be a JSON object")
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e), "code": "bad_request"})
+                return
+            try:
+                status, body, headers = router.handle_generate(payload)
+            except Exception as e:  # router bug: typed 500, keep serving
+                self._reply(500, {"error": f"router error: {e!r}",
+                                  "code": "internal"})
+                return
+            self._reply(status, body, headers)
+
+        def log_message(self, *a):  # quiet by default
+            pass
+
+    return Handler
+
+
+def serve_router(router: Router, host: str = "127.0.0.1",
+                 port: int = 8000) -> ThreadingHTTPServer:
+    """Build the router's HTTP server (not yet serving; call
+    serve_forever())."""
+    return ThreadingHTTPServer((host, port), _make_handler(router))
+
+
+def main() -> None:
+    """CLI: route traffic over already-running replicas (tools/fleet.py
+    launches replicas AND a router in one command)."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--target", action="append", required=True,
+                   help="replica base URL (repeat per replica), e.g. "
+                        "http://127.0.0.1:8101")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--probe-interval", type=float, default=0.5)
+    p.add_argument("--eject-after", type=int, default=3)
+    p.add_argument("--readmit-after", type=int, default=2)
+    p.add_argument("--max-attempts", type=int, default=3)
+    p.add_argument("--deadline", type=float, default=120.0,
+                   help="total per-request budget in seconds (0 = none)")
+    p.add_argument("--hedge-factor", type=float, default=0.0,
+                   help="hedge a request stuck past this multiple of "
+                        "observed p99 latency (0 = hedging off)")
+    p.add_argument("--hedge-min", type=float, default=0.25)
+    args = p.parse_args()
+
+    cfg = RouterConfig(
+        probe_interval_s=args.probe_interval,
+        eject_after=args.eject_after,
+        readmit_after=args.readmit_after,
+        max_attempts=args.max_attempts,
+        default_deadline_s=args.deadline,
+        hedge_factor=args.hedge_factor,
+        hedge_min_s=args.hedge_min,
+    )
+    router = Router(args.target, cfg).start()
+    httpd = serve_router(router, args.host, args.port)
+    print(f"[router] fronting {len(router.replicas)} replicas — "
+          f"POST http://{args.host}:{args.port}/generate, fleet state "
+          f"at GET http://{args.host}:{args.port}/health")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        router.close()
+
+
+if __name__ == "__main__":
+    main()

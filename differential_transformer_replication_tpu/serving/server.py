@@ -616,13 +616,21 @@ def _make_handler(client: ServingClient, tokenizer=None):
                 self.wfile.write(body)
             elif self.path == "/health":
                 status = client.status()
-                self._reply(200, {
+                payload = {
                     "ok": status in ("healthy", "degraded"),
                     "status": status,
                     "restarts": client.runner.restarts,
                     "last_step_s": client.runner.last_step_s,
                     "stats": client.stats,
-                })
+                }
+                # compile-cache sizes, so fleet chaos tests can pin
+                # "zero added recompiles" on REMOTE replicas too
+                compile_stats = getattr(
+                    client.runner.engine, "compile_stats", None
+                )
+                if compile_stats is not None:
+                    payload["compiles"] = compile_stats()
+                self._reply(200, payload)
             elif self.path == "/ready":
                 if client.runner.accepting():
                     self._reply(200, {"ready": True,
@@ -723,8 +731,12 @@ def _make_handler(client: ServingClient, tokenizer=None):
                 self._reply(503, {"error": "generation timed out",
                                   "code": "timeout"})
                 return
-            except RuntimeError as e:  # runner closed / engine failure
-                self._reply(500, {"error": str(e), "code": "internal"})
+            except Exception as e:  # unexpected failure — still typed:
+                # the router (serving/router.py) and retry client key
+                # retriability off the machine-readable "code"; an
+                # untyped stack-trace 500 would strand them guessing
+                self._reply(500, {"error": str(e) or repr(e),
+                                  "code": "internal"})
                 return
             payload = {
                 "request_id": out.request_id,

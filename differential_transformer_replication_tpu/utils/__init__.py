@@ -1,8 +1,40 @@
+"""Host-side utilities.
+
+:mod:`utils.faults` is stdlib-only and imported eagerly — the fault
+harness must be armable from supervisor/router processes that never
+touch the device runtime. The profiling helpers pull in jax, so they
+resolve lazily (PEP 562): ``from ...utils import ProfilerWindow`` works
+as before but pays the jax import at first access, keeping
+``from ...utils import faults`` jax-free.
+"""
+
+from typing import TYPE_CHECKING
+
 from differential_transformer_replication_tpu.utils import faults
-from differential_transformer_replication_tpu.utils.profiling import (
-    ProfilerWindow,
-    Throughput,
-    trace,
-)
+
+_LAZY = {"ProfilerWindow", "Throughput", "trace"}
 
 __all__ = ["ProfilerWindow", "Throughput", "trace", "faults"]
+
+if TYPE_CHECKING:
+    from differential_transformer_replication_tpu.utils.profiling import (
+        ProfilerWindow,
+        Throughput,
+        trace,
+    )
+
+
+def __getattr__(name: str):
+    if name not in _LAZY:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    from differential_transformer_replication_tpu.utils import profiling
+
+    value = getattr(profiling, name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

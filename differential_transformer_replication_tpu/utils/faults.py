@@ -64,6 +64,25 @@ serving/server.py):
                         guard turns this into a typed EngineCrashError
                         that the supervised restart recovers from
 
+Router fault points (call-point style like ``ckpt_*`` — ``@N`` counts
+CALLS until the fault fires, default 1; exercised by
+tests/test_router.py against serving/router.py):
+
+  ``router_probe_fail`` / ``router_probe_fail@N``
+                        fail the Nth upcoming health probe (the prober
+                        treats it like an unreachable replica — drives
+                        the ejection state machine deterministically)
+  ``router_replica_hang`` / ``router_replica_hang@N``
+                        stall the Nth upcoming forwarded request for
+                        ``DTX_ROUTER_HANG_S`` seconds (default 2.0)
+                        before it leaves the router — a hung replica
+                        from the client's view; the hedging trigger
+  ``router_pick_raise`` / ``router_pick_raise@N``
+                        raise :class:`FaultInjected` inside the Nth
+                        upcoming replica pick — an unexpected router
+                        bug; must surface as a typed 500, never kill
+                        the router process
+
 Armed from the ``DTX_FAULTS`` environment variable on first use (env
 crosses the supervisor's subprocess boundary) and/or programmatically
 via :func:`arm` (``TrainConfig.faults`` feeds this). One-shot kinds
@@ -83,6 +102,7 @@ from typing import Optional, Set
 ENV_VAR = "DTX_FAULTS"
 HANG_ENV_VAR = "DTX_SERVE_HANG_S"
 CKPT_HANG_ENV_VAR = "DTX_CKPT_HANG_S"
+ROUTER_HANG_ENV_VAR = "DTX_ROUTER_HANG_S"
 
 _STEP_KINDS = (
     "raise", "sigterm", "sigkill", "nan", "corrupt_params",
@@ -93,6 +113,9 @@ _POINT_KINDS = (
     "ckpt_write", "ckpt_fsync", "ckpt_manifest", "ckpt_gc",
     # stall-class point: fires through stall() (sleeps), not check()
     "ckpt_hang",
+    # router points (serving/router.py): probe/pick fire through
+    # check(), replica_hang through stall()
+    "router_probe_fail", "router_pick_raise", "router_replica_hang",
 )
 
 
@@ -236,13 +259,20 @@ def check(point: str) -> None:
 
 
 def stall(point: str) -> None:
-    """Stall-class call-point fault (e.g. ``ckpt_hang``): the armed call
-    SLEEPS for ``DTX_CKPT_HANG_S`` seconds instead of raising — a slow
-    disk, not a broken one. Same ``@N`` call-counting as :func:`check`."""
+    """Stall-class call-point fault (``ckpt_hang``, ``router_replica_hang``):
+    the armed call SLEEPS instead of raising — a slow disk / hung
+    replica, not a broken one. The sleep length comes from
+    ``DTX_ROUTER_HANG_S`` for ``router_*`` points and
+    ``DTX_CKPT_HANG_S`` otherwise (default 2.0 s). Same ``@N``
+    call-counting as :func:`check`."""
     points = _get()["points"]
     if point not in points:
         return
     points[point] -= 1
     if points[point] <= 0:
         del points[point]
-        time.sleep(float(os.environ.get(CKPT_HANG_ENV_VAR, "2.0")))
+        env = (
+            ROUTER_HANG_ENV_VAR if point.startswith("router_")
+            else CKPT_HANG_ENV_VAR
+        )
+        time.sleep(float(os.environ.get(env, "2.0")))

@@ -1,0 +1,1157 @@
+"""Multi-replica serving router (ISSUE 6): health-aware load
+balancing, failover, hedging, affinity, rolling restarts.
+
+The load-bearing contracts:
+
+- the picker only ever chooses UP replicas, prefers less-loaded ones
+  (power-of-two-choices over queue/slot/KV scores + live in-flight),
+  and honors ``session_id`` affinity with re-pinning when the pinned
+  replica dies;
+- ejection takes ``eject_after`` consecutive transport failures,
+  re-admission takes ``readmit_after`` consecutive good probes (a
+  flapping replica cannot oscillate into rotation), and a DRAINING
+  replica leaves rotation connection-free without ever being ejected;
+- retriable replica replies (503 queue_full / shutting_down /
+  engine_crash, unreachable transport) fail over to a DIFFERENT
+  replica under the request's deadline budget; non-recoverable codes
+  (504 deadline, timeout, engine_failed) pass through once, untouched;
+- Retry-After values the router honors or propagates are capped;
+- the retry client budgets total elapsed time against ``deadline_s``
+  (satellite: serving/retry.py);
+- every server error reply carries a machine-readable ``code``
+  (satellite: serving/server.py), because ALL of the above keys off it.
+
+Quick tier: pure state-machine/picker tests plus canned-HTTP-replica
+tests (no jax, no engine). Slow tier: the rolling-restart chaos test
+over two real replica subprocesses via tools/fleet.py.
+"""
+
+import importlib.util
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from differential_transformer_replication_tpu.config import (
+    RouterConfig,
+    ServingConfig,
+)
+from differential_transformer_replication_tpu.serving.retry import (
+    http_post_json_with_retries,
+)
+from differential_transformer_replication_tpu.serving.router import (
+    DRAINING,
+    EJECTED,
+    NOT_READY,
+    UP,
+    Replica,
+    Router,
+    parse_replica_scores,
+    serve_router,
+)
+from differential_transformer_replication_tpu.utils import faults
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _load_fleet():
+    spec = importlib.util.spec_from_file_location(
+        "fleet", os.path.join(TOOLS, "fleet.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cfg(**kw):
+    kw.setdefault("probe_interval_s", 0.05)
+    kw.setdefault("probe_backoff_s", 0.05)
+    kw.setdefault("probe_backoff_max_s", 0.4)
+    kw.setdefault("retry_base_s", 0.001)
+    kw.setdefault("retry_cap_s", 0.01)
+    # unit tests want deterministic immediate shedding; the chaos test
+    # opts back into the wait that bridges rolling-restart windows
+    kw.setdefault("wait_for_replica_s", 0.0)
+    return RouterConfig(**kw)
+
+
+def _router(n=2, cfg=None, start=False, **kw):
+    r = Router(
+        [f"http://127.0.0.1:{19000 + i}" for i in range(n)],
+        cfg or _cfg(), rng=random.Random(0), **kw,
+    )
+    if start:
+        r.start()
+    return r
+
+
+def _mark_up(*replicas, now=0.0):
+    for r in replicas:
+        r.note_probe_success(True, "healthy", {}, now=now)
+
+
+# -- fault-spec parsing -------------------------------------------------
+
+
+class TestRouterFaultSpec:
+    def test_point_kinds_parse_and_one_shot(self):
+        faults.arm("router_probe_fail,router_pick_raise@2")
+        assert faults.armed()
+        with pytest.raises(faults.FaultInjected, match="router_probe_fail"):
+            faults.check("router_probe_fail")
+        faults.check("router_probe_fail")  # one-shot: disarmed
+        faults.check("router_pick_raise")  # 1st call: armed for 2nd
+        with pytest.raises(faults.FaultInjected, match="router_pick_raise"):
+            faults.check("router_pick_raise")
+
+    def test_replica_hang_uses_router_env(self, monkeypatch):
+        monkeypatch.setenv(faults.ROUTER_HANG_ENV_VAR, "0.12")
+        monkeypatch.setenv(faults.CKPT_HANG_ENV_VAR, "9.0")  # must NOT apply
+        faults.arm("router_replica_hang")
+        t0 = time.perf_counter()
+        faults.stall("router_replica_hang")
+        dt = time.perf_counter() - t0
+        assert 0.1 <= dt < 1.0
+        t0 = time.perf_counter()
+        faults.stall("router_replica_hang")  # disarmed
+        assert time.perf_counter() - t0 < 0.05
+
+
+# -- retry client deadline budget (satellite) ---------------------------
+
+
+class _Canned(BaseHTTPRequestHandler):
+    """One-endpoint server replying from the class-level script."""
+
+    script = []  # list of (status, body_dict, headers_dict)
+    hits = None
+
+    def do_POST(self):
+        i = min(len(self.script) - 1, self.hits["n"])
+        self.hits["n"] += 1
+        status, body, headers = self.script[i]
+        # bytes bodies ship verbatim (truncated/garbage-reply tests)
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+def _canned_server(script):
+    hits = {"n": 0}
+    handler = type("H", (_Canned,), {"script": script, "hits": hits})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}", hits
+
+
+class TestRetryDeadlineBudget:
+    def test_deadline_stops_retry_after_sequence(self):
+        """A Retry-After longer than the remaining deadline must not be
+        slept through — the server would answer 504 anyway."""
+        httpd, url, hits = _canned_server([
+            (503, {"code": "queue_full"}, {"Retry-After": "10"}),
+        ])
+        try:
+            clock = {"t": 0.0}
+            sleeps = []
+
+            def fake_sleep(s):
+                sleeps.append(s)
+                clock["t"] += s
+
+            status, body, retries = http_post_json_with_retries(
+                url, {}, max_retries=5, sleep=fake_sleep,
+                deadline_s=1.0, clock=lambda: clock["t"],
+                retry_after_cap=30.0,
+            )
+            # elapsed(0) + honored Retry-After(10) >= deadline(1): the
+            # typed 503 surfaces immediately, zero sleeps burned
+            assert status == 503 and body["code"] == "queue_full"
+            assert retries == 0 and sleeps == [] and hits["n"] == 1
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_retry_after_capped(self):
+        """An absurd Retry-After is capped, not honored verbatim."""
+        httpd, url, hits = _canned_server([
+            (503, {"code": "queue_full"}, {"Retry-After": "500"}),
+            (200, {"ok": True}, {}),
+        ])
+        try:
+            sleeps = []
+            status, body, retries = http_post_json_with_retries(
+                url, {}, max_retries=3, base=0.001, cap=0.002,
+                sleep=sleeps.append, retry_after_cap=0.05,
+                rng=random.Random(0),
+            )
+            assert status == 200 and retries == 1
+            assert len(sleeps) == 1 and sleeps[0] <= 0.06
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_no_deadline_keeps_retrying_as_before(self):
+        httpd, url, hits = _canned_server([
+            (503, {"code": "queue_full"}, {"Retry-After": "0.01"}),
+            (503, {"code": "queue_full"}, {"Retry-After": "0.01"}),
+            (200, {"ok": True}, {}),
+        ])
+        try:
+            status, body, retries = http_post_json_with_retries(
+                url, {}, max_retries=5, base=0.001, cap=0.002,
+                sleep=lambda s: None, rng=random.Random(0),
+            )
+            assert status == 200 and retries == 2 and hits["n"] == 3
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_garbage_200_body_is_retried_like_transport_death(self):
+        """A 200 whose body is truncated/garbled (server killed
+        mid-response) retries instead of raising out of the client
+        and killing the caller's worker thread."""
+        httpd, url, hits = _canned_server([
+            (200, b'{"tokens": [1,', {}),  # truncated JSON
+            (200, {"ok": True}, {}),
+        ])
+        try:
+            status, body, retries = http_post_json_with_retries(
+                url, {}, max_retries=3, base=0.001, cap=0.002,
+                sleep=lambda s: None, rng=random.Random(0),
+            )
+            assert status == 200 and body == {"ok": True}
+            assert retries == 1 and hits["n"] == 2
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_transport_error_respects_deadline(self):
+        clock = {"t": 0.0}
+
+        def fake_sleep(s):
+            clock["t"] += s
+
+        with pytest.raises(OSError) as ei:
+            # nothing listens on this port: every attempt is a
+            # transport error; the deadline cuts the retry budget short
+            http_post_json_with_retries(
+                "http://127.0.0.1:9", {}, timeout=0.2, max_retries=50,
+                base=0.5, cap=0.5, sleep=fake_sleep,
+                deadline_s=1.0, clock=lambda: clock["t"],
+                rng=random.Random(0),
+            )
+        assert getattr(ei.value, "retry_attempts", None) is not None
+        assert ei.value.retry_attempts < 50
+
+
+# -- metrics parsing ----------------------------------------------------
+
+
+def test_parse_replica_scores_picks_gauges_and_skips_noise():
+    text = "\n".join([
+        "# HELP serving_queue_depth Requests waiting for a slot.",
+        "# TYPE serving_queue_depth gauge",
+        "serving_queue_depth 3",
+        "serving_slot_occupancy 2",
+        "serving_slots 8",
+        "serving_kv_utilization 0.25",
+        'serving_requests_finished_total{reason="length"} 17',
+        "garbage line with too many parts",
+        "serving_queue_wait_seconds_sum 1.5",
+    ])
+    assert parse_replica_scores(text) == {
+        "queue_depth": 3.0, "slot_occupancy": 2.0,
+        "slots": 8.0, "kv_utilization": 0.25,
+    }
+
+
+# -- replica health state machine ---------------------------------------
+
+
+class TestReplicaStateMachine:
+    def test_ejection_after_consecutive_failures_and_backoff_growth(self):
+        cfg = _cfg(eject_after=3)
+        r = Replica("http://x:1", cfg)
+        assert r.state == "unknown" and not r.eligible()
+        _mark_up(r)
+        assert r.eligible()
+        assert r.note_failure(now=1.0) is False
+        assert r.note_failure(now=2.0) is False
+        assert r.eligible()  # below the ejection threshold: still UP
+        assert r.note_failure(now=3.0) is True  # newly ejected
+        assert r.state == EJECTED and not r.eligible()
+        assert r.note_failure(now=4.0) is False  # already ejected
+        # probe backoff doubled each failure, capped
+        assert r.probe_backoff == pytest.approx(
+            min(cfg.probe_backoff_s * 2 ** 4, cfg.probe_backoff_max_s)
+        )
+
+    def test_slow_readmission_needs_consecutive_good_probes(self):
+        cfg = _cfg(eject_after=1, readmit_after=2)
+        r = Replica("http://x:1", cfg)
+        _mark_up(r)
+        assert r.note_failure(now=1.0) is True
+        assert r.state == EJECTED
+        r.note_probe_success(True, "healthy", {}, now=2.0)
+        assert r.state == EJECTED  # one good probe is not enough
+        r.note_failure(now=3.0)  # flap: streak resets
+        r.note_probe_success(True, "healthy", {}, now=4.0)
+        assert r.state == EJECTED
+        r.note_probe_success(True, "healthy", {}, now=5.0)
+        assert r.state == UP and r.eligible()  # re-admitted
+
+    def test_ejected_stays_ejected_through_not_ready_probes(self):
+        """A relaunched-but-booting replica answering 'restarting'
+        must not launder an EJECTED replica into NOT_READY, which a
+        single good probe would flip straight to UP — slow
+        re-admission applies from ejection until readmit_after
+        consecutive READY probes, whatever happened in between."""
+        cfg = _cfg(eject_after=1, readmit_after=2)
+        r = Replica("http://x:1", cfg)
+        _mark_up(r)
+        assert r.note_failure(now=1.0) is True
+        assert r.state == EJECTED
+        r.note_probe_success(False, "restarting", {}, now=2.0)
+        assert r.state == EJECTED  # reachable-not-ready != recovered
+        r.note_probe_success(True, "healthy", {}, now=3.0)
+        assert r.state == EJECTED  # still one short of readmit_after
+        r.note_probe_success(True, "healthy", {}, now=4.0)
+        assert r.state == UP
+
+    def test_draining_removes_without_ejecting(self):
+        r = Replica("http://x:1", _cfg())
+        _mark_up(r)
+        r.note_probe_success(False, "draining", {}, now=1.0)
+        assert r.state == DRAINING and not r.eligible()
+        assert r.ejections == 0 and r.consec_fail == 0
+        r.note_probe_success(False, "restarting", {}, now=2.0)
+        assert r.state == NOT_READY
+        r.note_probe_success(True, "healthy", {}, now=3.0)
+        assert r.state == UP  # back instantly: it was never ejected
+
+    def test_scores_ride_probes_into_the_score(self):
+        cfg = _cfg(queue_weight=1.0, slot_weight=1.0, kv_weight=0.5)
+        r = Replica("http://x:1", cfg)
+        r.note_probe_success(True, "healthy", {
+            "queue_depth": 4.0, "slot_occupancy": 2.0,
+            "slots": 8.0, "kv_utilization": 0.5,
+        }, now=1.0)
+        assert r.score() == pytest.approx(4 / 8 + 2 / 8 + 0.5 * 0.5)
+        with r.lock:
+            r.inflight = 8
+        assert r.score() == pytest.approx(4 / 8 + 2 / 8 + 0.25 + 1.0)
+
+
+# -- picker -------------------------------------------------------------
+
+
+class TestPicker:
+    def test_only_up_replicas_are_picked(self):
+        router = _router(3)
+        a, b, c = router.replicas
+        _mark_up(a)
+        b.note_probe_success(False, "draining", {}, now=0.0)
+        c.note_failure(now=0.0)
+        c.note_failure(now=0.0)
+        c.note_failure(now=0.0)
+        assert c.state == EJECTED
+        for _ in range(20):
+            assert router.pick() is a
+
+    def test_p2c_prefers_lower_score(self):
+        router = _router(2)
+        a, b = router.replicas
+        a.note_probe_success(True, "healthy",
+                             {"queue_depth": 10.0, "slots": 1.0}, now=0.0)
+        b.note_probe_success(True, "healthy",
+                             {"queue_depth": 0.0, "slots": 1.0}, now=0.0)
+        picks = [router.pick() for _ in range(50)]
+        # with exactly 2 eligible, p2c compares them every time: the
+        # loaded replica must never win
+        assert all(p is b for p in picks)
+
+    def test_exclude_forces_failover_target(self):
+        router = _router(2)
+        a, b = router.replicas
+        _mark_up(a, b)
+        assert router.pick(exclude=(a.url,)) is b
+        assert router.pick(exclude=(a.url, b.url)) is None
+
+    def test_no_eligible_returns_none(self):
+        router = _router(2)
+        assert router.pick() is None  # never probed: unknown
+
+    def test_affinity_sticks_and_fails_over_with_repin(self):
+        router = _router(2)
+        a, b = router.replicas
+        _mark_up(a, b)
+        first = router.pick(session_id="s1")
+        for _ in range(10):
+            assert router.pick(session_id="s1") is first
+        # the pinned replica dies: the session re-pins elsewhere
+        other = b if first is a else a
+        for _ in range(3):
+            first.note_failure(now=1.0)
+        assert first.state == EJECTED
+        moved = router.pick(session_id="s1")
+        assert moved is other
+        assert router._affinity["s1"] is other  # re-pinned, not orphaned
+        counter = router._move_counter
+        assert counter.value >= 1
+        # and sticks to the new home afterwards
+        assert router.pick(session_id="s1") is other
+
+    def test_pick_latency_is_observed(self):
+        router = _router(2)
+        _mark_up(*router.replicas)
+        router.pick()
+        snap = router._pick_hist.snapshot()
+        assert snap["count"] >= 1
+
+
+# -- failover & taxonomy (handle_generate over canned replicas) ---------
+
+
+def _two_replica_router(script_a, script_b, cfg=None, **kw):
+    """Router over two canned HTTP replicas; probes disabled (tests
+    mark replicas UP by hand so state is deterministic)."""
+    ha, url_a, hits_a = _canned_server(script_a)
+    hb, url_b, hits_b = _canned_server(script_b)
+    router = Router([url_a, url_b], cfg or _cfg(), rng=random.Random(0),
+                    **kw)
+    _mark_up(*router.replicas)
+    cleanup = lambda: [  # noqa: E731
+        (h.shutdown(), h.server_close()) for h in (ha, hb)
+    ]
+    return router, (hits_a, hits_b), cleanup
+
+
+_OK_BODY = {"request_id": 1, "prompt_ids": [1], "tokens": [2, 3],
+            "finish_reason": "length", "ttft_ms": 1.0}
+
+
+class TestFailover:
+    def test_retriable_503_fails_over_to_other_replica(self):
+        router, (ha, hb), cleanup = _two_replica_router(
+            [(503, {"code": "queue_full"}, {"Retry-After": "0.01"})],
+            [(200, dict(_OK_BODY), {})],
+        )
+        try:
+            # force the first pick onto the 503 replica
+            router._affinity["s"] = router.replicas[0]
+            status, body, headers = router.handle_generate(
+                {"prompt_ids": [1], "session_id": "s"}
+            )
+            assert status == 200
+            assert body["replica"] == router.replicas[1].name
+            assert body["attempts"] == 2
+            assert body["hedged"] is False
+            assert router._retry_counter.value == 1
+        finally:
+            cleanup()
+
+    def test_transient_failover_does_not_repin_healthy_session(self):
+        """One queue_full blip on the pinned replica serves THIS
+        request elsewhere but keeps the pin — the next request goes
+        back home (prefix-cache locality survives backpressure)."""
+        router, (ha, hb), cleanup = _two_replica_router(
+            [(503, {"code": "queue_full"}, {"Retry-After": "0.01"}),
+             (200, dict(_OK_BODY, tokens=[7]), {})],
+            [(200, dict(_OK_BODY), {})],
+        )
+        try:
+            a, b = router.replicas
+            router._affinity["s"] = a
+            status, body, _ = router.handle_generate(
+                {"prompt_ids": [1], "session_id": "s"}
+            )
+            assert status == 200 and body["replica"] == b.name
+            assert router._affinity["s"] is a  # pin survived the blip
+            assert router._move_counter.value == 0
+            status, body, _ = router.handle_generate(
+                {"prompt_ids": [1], "session_id": "s"}
+            )
+            assert status == 200 and body["replica"] == a.name  # home
+        finally:
+            cleanup()
+
+    def test_non_retriable_codes_pass_through_once(self):
+        for code, status in (("engine_failed", 503), ("timeout", 503),
+                             ("deadline", 504)):
+            router, (ha, hb), cleanup = _two_replica_router(
+                [(status, {"code": code}, {})],
+                [(200, dict(_OK_BODY), {})],
+            )
+            try:
+                router._affinity["s"] = router.replicas[0]
+                got_status, body, headers = router.handle_generate(
+                    {"prompt_ids": [1], "session_id": "s"}
+                )
+                assert got_status == status and body["code"] == code
+                assert body["replica"] == router.replicas[0].name
+                assert hb["n"] == 0  # never touched the healthy one
+            finally:
+                cleanup()
+
+    def test_exhausted_failover_returns_last_503_with_capped_retry_after(self):
+        cfg = _cfg(max_attempts=2, retry_after_cap_s=2.0)
+        router, (ha, hb), cleanup = _two_replica_router(
+            [(503, {"code": "queue_full"}, {"Retry-After": "60"})],
+            [(503, {"code": "shutting_down"}, {"Retry-After": "60"})],
+            cfg=cfg, sleep=lambda s: None,
+        )
+        try:
+            status, body, headers = router.handle_generate(
+                {"prompt_ids": [1]}
+            )
+            assert status == 503
+            assert body["code"] in ("queue_full", "shutting_down")
+            # propagated Retry-After is capped, not the replica's 60s
+            assert float(headers["Retry-After"]) <= 2.0
+        finally:
+            cleanup()
+
+    def test_sheds_with_retry_after_when_nothing_eligible(self):
+        router = _router(2, cfg=_cfg(shed_retry_after_s=3.0))
+        status, body, headers = router.handle_generate(
+            {"prompt_ids": [1]}
+        )
+        assert status == 503 and body["code"] == "no_replica"
+        assert headers["Retry-After"] == "3"
+        assert router._shed_counter.value == 1
+
+    def test_unreachable_replica_fails_over_and_counts_strike(self):
+        # replica 0 is a dead port; replica 1 answers
+        hb, url_b, hits_b = _canned_server([(200, dict(_OK_BODY), {})])
+        router = Router(["http://127.0.0.1:9", url_b], _cfg(),
+                        rng=random.Random(0))
+        _mark_up(*router.replicas)
+        try:
+            router._affinity["s"] = router.replicas[0]
+            status, body, _ = router.handle_generate(
+                {"prompt_ids": [1], "session_id": "s"}
+            )
+            assert status == 200
+            assert body["replica"] == router.replicas[1].name
+            assert router.replicas[0].consec_fail == 1
+        finally:
+            hb.shutdown()
+            hb.server_close()
+
+    def test_router_deadline_timeout_is_504_without_replica_strike(self):
+        """A forward timeout CAUSED by the request's own deadline
+        budget maps to a non-retriable 504 `deadline` and must not
+        strike (let alone eject) the replica — it was healthy, just
+        slower than the caller's patience."""
+
+        class _Slow(BaseHTTPRequestHandler):
+            def do_POST(self):
+                time.sleep(1.0)
+                body = json.dumps(_OK_BODY).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Slow)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        router = Router([url], _cfg(), rng=random.Random(0))
+        _mark_up(*router.replicas)
+        try:
+            status, body, _ = router.handle_generate(
+                {"prompt_ids": [1], "deadline_s": 0.2}
+            )
+            assert status == 504 and body["code"] == "deadline"
+            assert router.replicas[0].consec_fail == 0  # no strike
+            assert router.replicas[0].state == UP
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_affinity_map_is_lru_capped(self):
+        router = _router(2, cfg=_cfg(affinity_max_sessions=3))
+        _mark_up(*router.replicas)
+        for i in range(5):
+            assert router.pick(session_id=f"s{i}") is not None
+        assert len(router._affinity) == 3
+        assert "s0" not in router._affinity  # oldest evicted
+        assert "s4" in router._affinity
+        # touching a surviving session refreshes it
+        router.pick(session_id="s2")
+        router.pick(session_id="s5")
+        assert "s2" in router._affinity and "s3" not in router._affinity
+
+    def test_deadline_budget_bounds_failover(self):
+        """With an expired budget the router reports the last typed
+        failure instead of burning more attempts."""
+        cfg = _cfg(max_attempts=3, retry_base_s=5.0, retry_cap_s=5.0,
+                   retry_after_cap_s=5.0)
+        router, (ha, hb), cleanup = _two_replica_router(
+            [(503, {"code": "queue_full"}, {"Retry-After": "5"})],
+            [(503, {"code": "queue_full"}, {"Retry-After": "5"})],
+            cfg=cfg,
+        )
+        try:
+            t0 = time.monotonic()
+            status, body, headers = router.handle_generate(
+                {"prompt_ids": [1], "deadline_s": 0.2}
+            )
+            # the backoff (>=5s floor) would blow the 0.2s budget: the
+            # 503 surfaces without sleeping through it
+            assert status == 503 and body["code"] == "queue_full"
+            assert time.monotonic() - t0 < 2.0
+            assert ha["n"] + hb["n"] == 1
+        finally:
+            cleanup()
+
+
+# -- hedging ------------------------------------------------------------
+
+
+class TestHedging:
+    def test_hung_replica_hedges_to_other_and_wins(self, monkeypatch):
+        monkeypatch.setenv(faults.ROUTER_HANG_ENV_VAR, "0.6")
+        cfg = _cfg(hedge_factor=1.0, hedge_min_s=0.05)
+        router, (ha, hb), cleanup = _two_replica_router(
+            [(200, dict(_OK_BODY, tokens=[9]), {})],
+            [(200, dict(_OK_BODY), {})],
+            cfg=cfg,
+        )
+        try:
+            router._affinity["s"] = router.replicas[0]
+            faults.arm("router_replica_hang@1")  # 1st forward stalls
+            t0 = time.monotonic()
+            status, body, _ = router.handle_generate(
+                {"prompt_ids": [1], "session_id": "s"}
+            )
+            assert status == 200
+            # the hedge (replica 1) answered while the primary hung
+            assert body["hedged"] is True
+            assert body["replica"] == router.replicas[1].name
+            assert time.monotonic() - t0 < 0.55
+            assert router._hedge_counter.value == 1
+            assert router._hedge_win_counter.value == 1
+        finally:
+            cleanup()
+
+    def test_hedging_off_by_default(self):
+        router, (ha, hb), cleanup = _two_replica_router(
+            [(200, dict(_OK_BODY), {})],
+            [(200, dict(_OK_BODY), {})],
+        )
+        try:
+            status, body, _ = router.handle_generate({"prompt_ids": [1]})
+            assert status == 200 and body["hedged"] is False
+            assert router._hedge_counter.value == 0
+        finally:
+            cleanup()
+
+
+# -- router HTTP surface ------------------------------------------------
+
+
+class TestRouterHTTP:
+    def _serve(self, router):
+        httpd = serve_router(router, port=0)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def test_generate_health_ready_metrics_roundtrip(self):
+        router, (ha, hb), cleanup = _two_replica_router(
+            [(200, dict(_OK_BODY), {})],
+            [(200, dict(_OK_BODY), {})],
+        )
+        httpd, url = self._serve(router)
+        try:
+            status, body, retries = http_post_json_with_retries(
+                url + "/generate", {"prompt_ids": [1],
+                                    "max_new_tokens": 2},
+            )
+            assert status == 200 and body["tokens"] == [2, 3]
+            assert body["replica"] in (
+                router.replicas[0].name, router.replicas[1].name
+            )
+            with urllib.request.urlopen(url + "/health", timeout=30) as r:
+                health = json.load(r)
+            assert health["ok"] is True and health["eligible"] == 2
+            assert {x["state"] for x in health["replicas"]} == {UP}
+            with urllib.request.urlopen(url + "/ready", timeout=30) as r:
+                assert json.load(r)["ready"] is True
+            with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+                text = r.read().decode()
+            assert "router_requests_total" in text
+            assert "router_replicas_eligible 2" in text
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            cleanup()
+
+    def test_ready_503_when_fleet_empty_and_bad_json_is_400(self):
+        router = _router(2)  # nothing probed: zero eligible
+        httpd, url = self._serve(router)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "/ready", timeout=30)
+            assert ei.value.code == 503
+            assert "Retry-After" in ei.value.headers
+            req = urllib.request.Request(
+                url + "/generate", data=b"{not json",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400
+            assert json.loads(ei.value.read())["code"] == "bad_request"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_pick_raise_fault_is_typed_500_and_router_survives(self):
+        router, (ha, hb), cleanup = _two_replica_router(
+            [(200, dict(_OK_BODY), {})],
+            [(200, dict(_OK_BODY), {})],
+        )
+        httpd, url = self._serve(router)
+        try:
+            faults.arm("router_pick_raise")
+            status, body, _ = http_post_json_with_retries(
+                url + "/generate", {"prompt_ids": [1]}, max_retries=0,
+            )
+            assert status == 500 and body["code"] == "internal"
+            # the fault was one-shot; the router keeps serving
+            status, body, _ = http_post_json_with_retries(
+                url + "/generate", {"prompt_ids": [1]},
+            )
+            assert status == 200
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            cleanup()
+
+
+# -- server error-code satellite ----------------------------------------
+
+
+class TestServerErrorCodes:
+    def _fake_client(self, exc):
+        """The minimal surface serving/server.py's handler touches."""
+
+        class _Engine:
+            serving = ServingConfig(num_slots=1)
+
+        class _Runner:
+            engine = _Engine()
+            restarts = 0
+            last_step_s = None
+
+            def status(self):
+                return "healthy"
+
+            def accepting(self):
+                return True
+
+        class _Client:
+            runner = _Runner()
+            registry = None
+            stats = {}
+
+            def status(self):
+                return "healthy"
+
+            def generate(self, *a, **kw):
+                raise exc
+
+        return _Client()
+
+    def _post(self, url):
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps({"prompt_ids": [1],
+                             "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    @pytest.mark.parametrize("exc", [
+        RuntimeError("runner closed"),
+        AttributeError("engine lost an attribute mid-flight"),
+        KeyError("missing"),
+        OSError("device backend vanished"),
+    ])
+    def test_unexpected_exceptions_reply_500_with_code(self, exc):
+        """Regression (satellite): EVERY error reply carries the
+        machine-readable ``code`` the router keys retriability off —
+        including 500s from exception types the handler never
+        anticipated (previously only RuntimeError was typed; anything
+        else fell through to http.server's HTML 500)."""
+        from differential_transformer_replication_tpu.serving.server import (
+            serve,
+        )
+
+        httpd = serve(self._fake_client(exc), port=0)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            status, body = self._post(url)
+            assert status == 500
+            assert body["code"] == "internal"
+            assert body["error"]  # human text still present
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# -- serve_bench per-replica breakdown (satellite) ----------------------
+
+
+def test_serve_bench_target_mode_reports_per_replica_breakdown(capsys):
+    """--target mode needs no jax and no local engine: two canned
+    replicas, round-robin, per-replica req/s in the JSON line."""
+    ha, url_a, hits_a = _canned_server([(200, dict(_OK_BODY), {})])
+    hb, url_b, hits_b = _canned_server([(200, dict(_OK_BODY), {})])
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(TOOLS, "serve_bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    argv = sys.argv
+    sys.argv = ["serve_bench.py", "--target", url_a, "--target", url_b,
+                "--requests", "8", "--clients", "2", "--min-prompt", "2",
+                "--max-prompt", "4", "--new-tokens", "2",
+                "--prefill-chunk", "4", "--vocab-size", "97"]
+    try:
+        bench.main()
+    finally:
+        sys.argv = argv
+        for h in (ha, hb):
+            h.shutdown()
+            h.server_close()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["http"] is True and line["failed"] == 0
+    assert line["n_requests"] == 8
+    assert set(line["per_replica"]) == {url_a + "/generate",
+                                        url_b + "/generate"}
+    for entry in line["per_replica"].values():
+        assert entry["ok"] == 4  # strict round-robin over 2 targets
+        assert entry["req_per_s"] > 0
+        assert {"ok", "errors", "retries", "hedges",
+                "req_per_s"} <= set(entry)
+    assert "hedges" in line and "no_replica" in line["errors"]
+
+
+# -- probing over live HTTP (ejection + re-admission end to end) --------
+
+
+class _ReadyHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = json.dumps({"ready": True, "status": "healthy"}).encode()
+        self.send_response(200 if self.path == "/ready" else 404)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_probe_fault_point_counts_a_strike_against_healthy_replica():
+    """router_probe_fail makes probe failures deterministic: the armed
+    probe counts a transport strike even though the replica is fine."""
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _ReadyHandler)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    router = Router([url], _cfg(eject_after=1), rng=random.Random(0))
+    replica = router.replicas[0]
+    try:
+        router.probe(replica)
+        assert replica.state == UP
+        faults.arm("router_probe_fail")
+        router.probe(replica)
+        assert replica.state == EJECTED  # eject_after=1: one strike
+        assert router._eject_counter.labels(
+            replica=replica.name
+        ).value == 1
+        router.probe(replica)  # fault was one-shot: probes work again
+        assert replica.consec_ok == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_slow_probe_does_not_stall_fleet_health_detection():
+    """A blackholed replica blocking its probe timeout must not slow
+    the probe cadence for the rest of the fleet (probes run
+    concurrently, one in flight per replica)."""
+    probe_times = []
+    times_lock = threading.Lock()
+
+    class _SlowReady(BaseHTTPRequestHandler):
+        def do_GET(self):
+            time.sleep(0.8)  # blackholed-ish: accepts, answers late
+            body = json.dumps({"ready": True, "status": "healthy"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    class _FastReady(_ReadyHandler):
+        def do_GET(self):
+            if self.path == "/ready":
+                with times_lock:
+                    probe_times.append(time.monotonic())
+            _ReadyHandler.do_GET(self)
+
+    slow = ThreadingHTTPServer(("127.0.0.1", 0), _SlowReady)
+    fast = ThreadingHTTPServer(("127.0.0.1", 0), _FastReady)
+    for h in (slow, fast):
+        threading.Thread(target=h.serve_forever, daemon=True).start()
+    cfg = _cfg(probe_interval_s=0.05, probe_timeout_s=2.0)
+    router = Router(
+        [f"http://127.0.0.1:{slow.server_address[1]}",
+         f"http://127.0.0.1:{fast.server_address[1]}"],
+        cfg, rng=random.Random(0),
+    )
+    try:
+        router.start()
+        time.sleep(1.0)
+        with times_lock:
+            n = len(probe_times)
+        # sequential probing behind the 0.8s-slow replica would manage
+        # ~1-2 fast-replica probes in this window; concurrent probing
+        # sustains the 0.05s cadence
+        assert n >= 5, f"fast replica only probed {n} times"
+        assert router.replicas[1].state == UP
+    finally:
+        router.close()
+        slow.shutdown()
+        slow.server_close()
+        fast.shutdown()
+        fast.server_close()
+
+
+def test_probe_loop_ejects_dead_replica_and_readmits_on_recovery():
+    """End-to-end prober: a replica whose process dies gets ejected
+    after consecutive failed probes, and the SAME replica is slowly
+    re-admitted once it listens again (same port — a restart)."""
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _ReadyHandler)
+    port = httpd.server_address[1]
+    url = f"http://127.0.0.1:{port}"
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    cfg = _cfg(probe_interval_s=0.03, probe_backoff_s=0.03,
+               probe_backoff_max_s=0.1, eject_after=2, readmit_after=2)
+    router = Router([url], cfg, rng=random.Random(0))
+    revived = None
+    try:
+        router.start()
+        replica = router.replicas[0]
+        deadline = time.time() + 5
+        while replica.state != UP and time.time() < deadline:
+            time.sleep(0.01)
+        assert replica.state == UP
+        # the "process" dies: probes hit a closed port -> ejection
+        httpd.shutdown()
+        httpd.server_close()
+        deadline = time.time() + 10
+        while replica.state != EJECTED and time.time() < deadline:
+            time.sleep(0.01)
+        assert replica.state == EJECTED
+        assert router.eligible_count() == 0
+        # restart on the same port -> slow re-admission back to UP
+        revived = ThreadingHTTPServer(("127.0.0.1", port), _ReadyHandler)
+        threading.Thread(target=revived.serve_forever,
+                         daemon=True).start()
+        deadline = time.time() + 10
+        while replica.state != UP and time.time() < deadline:
+            time.sleep(0.01)
+        assert replica.state == UP
+        assert router.eligible_count() == 1
+    finally:
+        router.close()
+        if revived is not None:
+            revived.shutdown()
+            revived.server_close()
+
+
+# -- chaos (slow tier): rolling restart over a real 2-replica fleet -----
+
+
+@pytest.mark.slow
+def test_chaos_rolling_restart_and_crash_zero_client_failures():
+    """Acceptance pin: sustained HTTP load through the router over a
+    2-replica fleet (tools/fleet.py) survives (1) a full rolling
+    restart and (2) a hard SIGKILL of one replica with ZERO failed
+    client requests — plain posts, no client-side retries; all
+    failover happens in the router. Every reply is attributable to a
+    known replica, and each replica's compile counts stay at the
+    pinned values (decode=1: routing added no new shapes)."""
+    fleet_mod = _load_fleet()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+
+    fleet = fleet_mod.Fleet(
+        2,
+        server_args=["--num-slots", "2", "--prefill-chunk", "16",
+                     "--prefill-budget", "32", "--drain-timeout", "60",
+                     "--max-queue-len", "0"],
+        env=env, max_restarts=3, backoff_base=0.2, backoff_max=2.0,
+        ready_timeout_s=180.0,
+    )
+    router = None
+    httpd = None
+    try:
+        fleet.start()
+        names = set()
+        # warm every replica DIRECTLY (prefill ladder + decode) so the
+        # measured window and the compile pin are deterministic
+        for r_url in fleet.urls:
+            for n in (1, 2, 4, 8, 16):
+                status, body, _ = http_post_json_with_retries(
+                    r_url + "/generate",
+                    {"prompt_ids": [1] * n, "max_new_tokens": 2,
+                     "temperature": 0.0, "seed": 0},
+                    timeout=120, max_retries=2,
+                )
+                assert status == 200, (r_url, n, body)
+
+        cfg = RouterConfig(
+            probe_interval_s=0.05, probe_backoff_s=0.05,
+            probe_backoff_max_s=0.5, eject_after=2, readmit_after=2,
+            max_attempts=4, retry_base_s=0.02, retry_cap_s=0.2,
+            default_deadline_s=120.0, wait_for_replica_s=5.0,
+        )
+        router = Router(fleet.urls, cfg).start()
+        for rep in router.replicas:
+            names.add(rep.name)
+        httpd = serve_router(router, port=0)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/generate"
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+        results = []
+        results_lock = threading.Lock()
+        stop = threading.Event()
+
+        def client(wid):
+            k = 0
+            while not stop.is_set():
+                k += 1
+                payload = {
+                    "prompt_ids": [1 + (wid + k) % 7] * (1 + (k % 12)),
+                    "max_new_tokens": 4, "temperature": 0.0,
+                    "seed": wid * 1000 + k, "timeout": 60,
+                    "session_id": f"w{wid}",
+                }
+                req = urllib.request.Request(
+                    url, data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=90) as r:
+                        rec = (r.status, json.load(r))
+                except urllib.error.HTTPError as e:
+                    rec = (e.code, json.loads(e.read() or b"{}"))
+                except OSError as e:
+                    rec = (-1, {"error": repr(e)})
+                with results_lock:
+                    results.append(rec)
+
+        workers = [
+            threading.Thread(target=client, args=(w,)) for w in range(4)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            # phase 1: rolling restart under load (drain -> kill ->
+            # relaunch each replica, one at a time), gated on the
+            # router RE-ADMITTING each replica before the next drains
+            by_url = {rep.url: rep for rep in router.replicas}
+            time.sleep(1.0)
+            fleet.rolling_restart(
+                ready_check=lambda r: by_url[r.url].eligible()
+            )
+            with results_lock:
+                n_after_rolling = len(results)
+            assert n_after_rolling > 0, "no load flowed during restart"
+            # phase 2: hard crash one replica under load; the fleet
+            # supervisor relaunches it, the router routes around it
+            fleet.kill(0)
+            deadline = time.time() + 120
+            while time.time() < deadline and not fleet.replicas[0].alive():
+                time.sleep(0.05)
+            assert fleet.replicas[0].alive(), "supervisor never relaunched"
+            assert fleet.wait_ready(0, timeout_s=180)
+            time.sleep(1.0)  # serve a little while fully healed
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=120)
+                assert not w.is_alive(), "client hung"
+
+        # ZERO failed client requests, no client-side retries involved
+        bad = [(s, b) for s, b in results if s != 200]
+        assert not bad, f"{len(bad)} failed requests, first: {bad[:3]}"
+        assert len(results) >= 20
+        # every reply attributable to a known healthy replica
+        for s, b in results:
+            assert b.get("replica") in names, b
+        assert fleet.replicas[0].restarts >= 1  # the SIGKILL was real
+        # compile pin: re-warm each (restarted, cold) replica with the
+        # full pinned shape set directly, then assert routed traffic
+        # added NOTHING on top — decode sits at exactly 1 cache entry
+        for r_url in fleet.urls:
+            for n in (1, 2, 4, 8, 16):
+                status, _b, _ = http_post_json_with_retries(
+                    r_url + "/generate",
+                    {"prompt_ids": [1] * n, "max_new_tokens": 2,
+                     "temperature": 0.0, "seed": 0},
+                    timeout=120, max_retries=2,
+                )
+                assert status == 200, (r_url, n, _b)
+            with urllib.request.urlopen(r_url + "/health",
+                                        timeout=30) as r:
+                health = json.load(r)
+            assert health["compiles"]["decode"] == 1, (r_url, health)
+        # the router observed the dance: ejections and/or retries fired
+        reg = router.registry.render()
+        assert "router_requests_total" in reg
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if router is not None:
+            router.close()
+        fleet.stop()

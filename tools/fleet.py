@@ -1,0 +1,456 @@
+#!/usr/bin/env python
+"""Local serving fleet: launch, supervise, and rolling-restart N
+replicas behind the health-aware router.
+
+The serving-side sibling of tools/train_supervisor.py (whose
+restart-budget / exponential-backoff / exit-classification pattern it
+reuses): each replica is one ``serving.server`` process on its own
+port, and the fleet keeps them alive —
+
+  - a CRASHED replica (segfault, OOM kill, SIGKILL preemption) is
+    relaunched after ``backoff_base * 2^restarts`` seconds (capped),
+    up to ``--max-restarts`` per replica; the router ejects it while
+    it is down and slowly re-admits it once ``/ready`` answers again;
+  - a replica that exits CLEANLY (rc 0 — e.g. an operator's SIGTERM
+    drain outside a rolling restart) is NOT relaunched: someone asked
+    it to stop;
+  - ``rolling_restart()`` upgrades the fleet with zero dropped
+    requests: one replica at a time, SIGTERM (the server drains —
+    admission stops with 503 + Retry-After, in-flight requests
+    finish), wait for exit, relaunch, wait for ``/ready``, then move
+    to the next. The router sees ``draining`` and routes around the
+    replica the whole time — connection-free removal.
+
+CLI (replicas + router in one process tree)::
+
+    python tools/fleet.py --replicas 2 --router-port 8000 \
+        -- --model control --num-slots 4
+
+Everything after ``--`` is passed through to every replica's
+``serving.server`` CLI verbatim. SIGHUP triggers a rolling restart;
+SIGTERM/SIGINT drain and stop the whole fleet. Every launch/exit
+appends one JSON line to ``--fleet-log`` for forensics.
+
+No jax import — the fleet must stay alive when the runtime it babysits
+is the thing crashing. (serving/router.py and serving/retry.py are
+stdlib-only and safe to import here; the package's serving/__init__
+resolves its jax-heavy exports lazily.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."
+))
+
+from train_supervisor import backoff_s, classify_exit  # noqa: E402
+
+SERVER_MODULE = "differential_transformer_replication_tpu.serving.server"
+
+
+def pick_free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (best-effort: released before the
+    replica binds it, so a collision is possible but vanishingly rare
+    on a loopback test host)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def wait_http_ready(url: str, timeout_s: float = 120.0,
+                    interval_s: float = 0.1) -> bool:
+    """Poll ``GET <url>/ready`` until it answers 200. A reachable 503
+    (draining/restarting) keeps polling — the process is up but not
+    admitting; transport errors mean it is still booting."""
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        try:
+            with urllib.request.urlopen(url + "/ready", timeout=2.0) as r:
+                if r.status == 200:
+                    return True
+        except urllib.error.HTTPError:
+            pass  # alive, not ready yet
+        except OSError:
+            pass  # not listening yet
+        time.sleep(interval_s)
+    return False
+
+
+class ReplicaProc:
+    """One replica's process slot: argv, port, restart accounting."""
+
+    def __init__(self, index: int, host: str, port: int,
+                 argv: List[str], env: Optional[dict]):
+        self.index = index
+        self.host = host
+        self.port = port
+        self.argv = argv
+        self.env = env
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self.expected_exit = False  # rolling restart / fleet stop
+        self.gave_up = False        # restart budget exhausted
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class Fleet:
+    """Launch + supervise N local replicas; see module docstring.
+
+    Programmatic surface (what tests/test_router.py's chaos test
+    drives): ``start()``, ``urls``, ``rolling_restart()``, ``kill()``
+    (chaos: SIGKILL one replica and let supervision relaunch it),
+    ``stop()``.
+    """
+
+    def __init__(self, num_replicas: int,
+                 server_args: Optional[Sequence[str]] = None,
+                 host: str = "127.0.0.1",
+                 ports: Optional[Sequence[int]] = None,
+                 python: str = sys.executable,
+                 env: Optional[dict] = None,
+                 max_restarts: int = 3,
+                 backoff_base: float = 0.5,
+                 backoff_max: float = 10.0,
+                 ready_timeout_s: float = 120.0,
+                 drain_exit_timeout_s: float = 60.0,
+                 fleet_log: Optional[str] = None):
+        if num_replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {num_replicas}")
+        self.host = host
+        self.max_restarts = max_restarts
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.ready_timeout_s = ready_timeout_s
+        self.drain_exit_timeout_s = drain_exit_timeout_s
+        self.fleet_log = fleet_log
+        ports = list(ports) if ports else [
+            pick_free_port(host) for _ in range(num_replicas)
+        ]
+        if len(ports) != num_replicas:
+            raise ValueError(
+                f"{num_replicas} replicas but {len(ports)} ports"
+            )
+        extra = list(server_args or [])
+        self.replicas = [
+            ReplicaProc(
+                i, host, port,
+                [python, "-m", SERVER_MODULE,
+                 "--host", host, "--port", str(port)] + extra,
+                env=dict(env) if env is not None else None,
+            )
+            for i, port in enumerate(ports)
+        ]
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+        # restart relaunch deadlines (monotonic ts), per replica index
+        self._relaunch_at: Dict[int, float] = {}
+
+    # -- observability -------------------------------------------------
+
+    @property
+    def urls(self) -> List[str]:
+        return [r.url for r in self.replicas]
+
+    def _log(self, record: dict) -> None:
+        record = {"time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                  **record}
+        print(f"[fleet] {json.dumps(record)}", file=sys.stderr)
+        if self.fleet_log:
+            with open(self.fleet_log, "a") as f:
+                f.write(json.dumps(record) + "\n")
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _launch(self, r: ReplicaProc) -> None:
+        r.proc = subprocess.Popen(r.argv, env=r.env)
+        self._log({"event": "launch", "replica": r.index,
+                   "port": r.port, "pid": r.proc.pid,
+                   "restarts": r.restarts})
+
+    def start(self, wait_ready: bool = True) -> "Fleet":
+        for r in self.replicas:
+            self._launch(r)
+        if wait_ready:
+            for r in self.replicas:
+                if not wait_http_ready(r.url, self.ready_timeout_s):
+                    self.stop()
+                    raise RuntimeError(
+                        f"replica {r.index} ({r.url}) not ready within "
+                        f"{self.ready_timeout_s}s"
+                    )
+        self._supervisor = threading.Thread(
+            target=self._supervise_loop, name="fleet-supervisor",
+            daemon=True,
+        )
+        self._supervisor.start()
+        return self
+
+    def _supervise_loop(self) -> None:
+        """Relaunch crashed replicas with backoff + restart budget
+        (train_supervisor semantics, one budget per replica)."""
+        while not self._stop.wait(0.05):
+            now = time.monotonic()
+            for r in self.replicas:
+                with self._lock:
+                    if (r.expected_exit or r.gave_up or r.proc is None
+                            or r.proc.poll() is None):
+                        continue
+                    due = self._relaunch_at.get(r.index)
+                    if due is None:
+                        rc = r.proc.returncode
+                        outcome = classify_exit(rc)
+                        self._log({"event": "exit", "replica": r.index,
+                                   "rc": rc, "outcome": outcome})
+                        if outcome == "clean":
+                            # someone asked it to stop; honor that
+                            r.gave_up = True
+                            continue
+                        if r.restarts >= self.max_restarts:
+                            self._log({
+                                "event": "give_up", "replica": r.index,
+                                "restarts": r.restarts,
+                            })
+                            r.gave_up = True
+                            continue
+                        delay = backoff_s(r.restarts, self.backoff_base,
+                                          self.backoff_max)
+                        r.restarts += 1
+                        self._relaunch_at[r.index] = now + delay
+                        self._log({"event": "backoff", "replica": r.index,
+                                   "delay_s": round(delay, 3),
+                                   "restart": r.restarts})
+                    elif due <= now:
+                        del self._relaunch_at[r.index]
+                        self._launch(r)
+
+    def kill(self, index: int) -> None:
+        """Chaos helper: SIGKILL one replica (uncatchable, no drain).
+        Supervision relaunches it on the backoff schedule."""
+        r = self.replicas[index]
+        if r.alive():
+            r.proc.send_signal(signal.SIGKILL)
+
+    def wait_ready(self, index: int,
+                   timeout_s: Optional[float] = None) -> bool:
+        return wait_http_ready(
+            self.replicas[index].url,
+            self.ready_timeout_s if timeout_s is None else timeout_s,
+        )
+
+    # -- rolling restart ----------------------------------------------
+
+    def rolling_restart(self, ready_check=None) -> None:
+        """Drain-aware, one replica at a time; see module docstring.
+        Raises when a replica fails to come back — continuing would
+        take the NEXT replica down too and shrink the fleet to zero.
+
+        ``ready_check(replica)`` (optional) gates the move to the next
+        replica beyond the replica's own ``/ready``: pass a probe of
+        the ROUTER's view (replica re-admitted, i.e. state ``up``) so
+        the restart never drains replica k+1 while the router is still
+        slow-re-admitting replica k — the zero-eligible window that
+        would shed requests."""
+        for r in self.replicas:
+            with self._lock:
+                r.expected_exit = True  # supervisor: hands off
+                self._relaunch_at.pop(r.index, None)
+            try:
+                self._log({"event": "rolling_drain", "replica": r.index})
+                if r.alive():
+                    r.proc.send_signal(signal.SIGTERM)
+                    try:
+                        r.proc.wait(self.drain_exit_timeout_s)
+                    except subprocess.TimeoutExpired:
+                        self._log({"event": "drain_timeout_kill",
+                                   "replica": r.index})
+                        r.proc.kill()
+                        r.proc.wait(10)
+                self._launch(r)
+                if not wait_http_ready(r.url, self.ready_timeout_s):
+                    raise RuntimeError(
+                        f"replica {r.index} ({r.url}) did not come back "
+                        f"within {self.ready_timeout_s}s after rolling "
+                        "restart"
+                    )
+                if ready_check is not None:
+                    end = time.monotonic() + self.ready_timeout_s
+                    while not ready_check(r):
+                        if time.monotonic() >= end:
+                            raise RuntimeError(
+                                f"replica {r.index} ({r.url}) ready but "
+                                "not re-admitted (ready_check) within "
+                                f"{self.ready_timeout_s}s"
+                            )
+                        time.sleep(0.05)
+                with self._lock:
+                    # a deliberate operator restart grants a fresh
+                    # supervision lease — without this, a replica that
+                    # had exhausted its budget (or exited cleanly once)
+                    # would be revived yet silently unsupervised
+                    r.gave_up = False
+                    r.restarts = 0
+                self._log({"event": "rolling_done", "replica": r.index})
+            finally:
+                with self._lock:
+                    r.expected_exit = False
+
+    # -- shutdown ------------------------------------------------------
+
+    def stop(self, drain: bool = True) -> None:
+        """SIGTERM everything (graceful drain), escalate to SIGKILL on
+        stragglers, stop supervision."""
+        self._stop.set()
+        with self._lock:
+            for r in self.replicas:
+                r.expected_exit = True
+        if self._supervisor is not None:
+            self._supervisor.join(5.0)
+            self._supervisor = None
+        for r in self.replicas:
+            if r.alive():
+                r.proc.send_signal(
+                    signal.SIGTERM if drain else signal.SIGKILL
+                )
+        deadline = time.monotonic() + (
+            self.drain_exit_timeout_s if drain else 10.0
+        )
+        for r in self.replicas:
+            if r.proc is None:
+                continue
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                r.proc.wait(left)
+            except subprocess.TimeoutExpired:
+                self._log({"event": "stop_kill", "replica": r.index})
+                r.proc.kill()
+                r.proc.wait(10)
+            self._log({"event": "stopped", "replica": r.index,
+                       "rc": r.proc.returncode})
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--base-port", type=int, default=0,
+                   help="first replica port (consecutive from here); "
+                        "0 = OS-assigned free ports")
+    p.add_argument("--router-port", type=int, default=8000)
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="per-replica crash-relaunch budget")
+    p.add_argument("--backoff-base", type=float, default=0.5)
+    p.add_argument("--backoff-max", type=float, default=10.0)
+    p.add_argument("--ready-timeout", type=float, default=120.0)
+    p.add_argument("--fleet-log", default=None,
+                   help="append one JSON line per fleet event")
+    p.add_argument("--hedge-factor", type=float, default=0.0,
+                   help="router hedging knob (0 = off); see "
+                        "RouterConfig.hedge_factor")
+    p.add_argument("server_args", nargs=argparse.REMAINDER,
+                   help="-- then extra serving.server CLI args passed "
+                        "to every replica")
+    args = p.parse_args()
+
+    server_args = list(args.server_args)
+    if server_args and server_args[0] == "--":
+        server_args = server_args[1:]
+    ports = None
+    if args.base_port:
+        ports = [args.base_port + i for i in range(args.replicas)]
+
+    fleet = Fleet(
+        args.replicas, server_args=server_args, host=args.host,
+        ports=ports, max_restarts=args.max_restarts,
+        backoff_base=args.backoff_base, backoff_max=args.backoff_max,
+        ready_timeout_s=args.ready_timeout, fleet_log=args.fleet_log,
+    )
+    print(f"[fleet] launching {args.replicas} replicas: "
+          f"{fleet.urls}", file=sys.stderr)
+    fleet.start()
+
+    # the router rides in this process: stdlib-only import chain
+    from differential_transformer_replication_tpu.config import (
+        RouterConfig,
+    )
+    from differential_transformer_replication_tpu.serving.router import (
+        Router,
+        serve_router,
+    )
+
+    router = Router(
+        fleet.urls,
+        RouterConfig(hedge_factor=args.hedge_factor),
+    ).start()
+    httpd = serve_router(router, args.host, args.router_port)
+
+    stopping = threading.Event()
+
+    def _stop_all(signum, frame):
+        del frame
+        print(f"[fleet] signal {signum}: stopping fleet", file=sys.stderr)
+        stopping.set()
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    by_url = {rep.url: rep for rep in router.replicas}
+
+    def _router_readmitted(r) -> bool:
+        # gate the rolling restart on the ROUTER's view, not just the
+        # replica's own /ready — see Fleet.rolling_restart
+        rep = by_url.get(r.url)
+        return rep is None or rep.eligible()
+
+    def _rolling(signum, frame):
+        del frame
+        print("[fleet] SIGHUP: rolling restart", file=sys.stderr)
+
+        def run():
+            try:
+                fleet.rolling_restart(ready_check=_router_readmitted)
+            except Exception as e:
+                print(f"[fleet] rolling restart FAILED: {e!r}",
+                      file=sys.stderr)
+
+        threading.Thread(target=run, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _stop_all)
+    signal.signal(signal.SIGINT, _stop_all)
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP, _rolling)
+
+    print(f"[fleet] router on http://{args.host}:{args.router_port} "
+          f"over {fleet.urls} — SIGHUP = rolling restart",
+          file=sys.stderr)
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        router.close()
+        fleet.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,20 @@ exercised by tests/test_serving.py as the quick-tier smoke.
 By default the model is RANDOM-INIT at the requested shape (throughput
 does not depend on trained weights); pass --checkpoint to serve real
 weights instead.
+
+``--target URL`` (repeatable) drives the SAME closed loop against
+already-running servers instead of an in-process one — point it at N
+replica URLs (round-robin) or at one router URL (serving/router.py);
+no jax is imported and no local engine is built. The JSON summary then
+carries a ``per_replica`` breakdown (req/s, errors, retries, hedges)
+keyed by the router's per-response ``replica`` attribution (or by
+target URL when driving replicas directly), so router fairness is
+measurable: a healthy 2-replica fleet should show ~equal req/s per
+replica and aggregate ≥ 1.7x one replica at equal per-replica config.
+Warmup posts the prefill-chunk ladder to every ``--target`` first so
+remote first-compiles stay out of the measured window (warming a
+router warms whichever replicas it picks; warm replicas directly for
+strict pins).
 """
 
 from __future__ import annotations
@@ -50,6 +64,186 @@ def _percentiles(xs, ps=(50, 95)):
     if not xs:
         return {f"p{p}": None for p in ps}
     return {f"p{p}": round(float(np.percentile(xs, p)), 3) for p in ps}
+
+
+def _run_against_targets(args, targets, post) -> None:
+    """Closed-loop HTTP load against already-running servers (replica
+    URLs round-robin, or one router URL). No jax, no local engine —
+    this path must be runnable from an operator laptop at a live
+    fleet. Reports the same JSON line as the in-process bench plus a
+    ``per_replica`` breakdown keyed by response attribution."""
+    import random as _random
+
+    rng = np.random.default_rng(args.seed)
+    max_prompt = max(1, args.max_prompt)
+    min_prompt = min(args.min_prompt, max_prompt)
+    prompts = [
+        rng.integers(
+            0, args.vocab_size,
+            size=int(rng.integers(min_prompt, max_prompt + 1)),
+        ).tolist()
+        for _ in range(args.requests)
+    ]
+
+    # warmup: post the prefill-chunk ladder to every target so remote
+    # first-compiles stay out of the measured window (a router target
+    # warms whichever replicas its picker chooses)
+    ladder, size = [], 1
+    while size <= min(args.prefill_chunk, max_prompt):
+        ladder.append(size)
+        size *= 2
+    for url in targets:
+        for n in ladder:
+            try:
+                post(url, {"prompt_ids": [1] * n, "max_new_tokens": 2,
+                           "temperature": args.temperature, "seed": 0},
+                     timeout=600, max_retries=args.max_retries)
+            except (OSError, ValueError) as e:
+                print(f"[serve_bench] warmup against {url} failed: {e!r}",
+                      file=sys.stderr)
+
+    completed = []
+    errors = {"queue_full": 0, "engine_crash": 0, "deadline": 0,
+              "timeout": 0, "shutting_down": 0, "no_replica": 0,
+              "other": 0}
+    per_replica: dict = {}
+    retries_total = [0]
+    hedges_total = [0]
+    lock = threading.Lock()
+    next_idx = [0]
+
+    def _acct(key):
+        entry = per_replica.get(key)
+        if entry is None:
+            entry = per_replica[key] = {
+                "ok": 0, "errors": 0, "retries": 0, "hedges": 0,
+            }
+        return entry
+
+    def _code_bucket(body):
+        code = (body or {}).get("code", "")
+        if code == "shutting_down":
+            return "shutting_down"
+        if code in ("engine_crash", "engine_failed"):
+            return "engine_crash"
+        if code == "timeout":
+            return "timeout"
+        if code == "queue_full":
+            return "queue_full"
+        if code in ("no_replica", "replica_unreachable"):
+            return "no_replica"
+        return "other"
+
+    def worker(wid):
+        rng_w = _random.Random(args.seed * 1000 + wid)
+        while True:
+            with lock:
+                i = next_idx[0]
+                if i >= len(prompts):
+                    return
+                next_idx[0] += 1
+            url = targets[i % len(targets)]
+            payload = {
+                "prompt_ids": prompts[i],
+                "max_new_tokens": args.new_tokens,
+                "temperature": args.temperature,
+                "seed": args.seed + i,
+                "timeout": 600,
+            }
+            if args.deadline:
+                payload["deadline_s"] = args.deadline
+            try:
+                status, body, retries = post(
+                    url, payload, timeout=600,
+                    max_retries=args.max_retries, rng=rng_w,
+                    deadline_s=args.deadline or None,
+                )
+            except (OSError, ValueError) as e:  # transport dead (or
+                # serving garbage bodies) past the retry budget
+                with lock:
+                    errors["no_replica"] += 1
+                    r = getattr(e, "retry_attempts", 0)
+                    retries_total[0] += r
+                    entry = _acct(url)
+                    entry["errors"] += 1
+                    entry["retries"] += r
+                continue
+            # attribution: the router stamps each reply with the
+            # replica that served it; direct replicas key by target
+            key = (body or {}).get("replica") or url
+            with lock:
+                retries_total[0] += retries
+                entry = _acct(key)
+                entry["retries"] += retries
+                if (body or {}).get("hedged"):
+                    hedges_total[0] += 1
+                    entry["hedges"] += 1
+                if status == 200:
+                    completed.append(
+                        (len(body["tokens"]), body["ttft_ms"], [])
+                    )
+                    entry["ok"] += 1
+                else:
+                    entry["errors"] += 1
+                    if status == 504:
+                        errors["deadline"] += 1
+                    elif status == 503:
+                        errors[_code_bucket(body)] += 1
+                    else:
+                        errors["other"] += 1
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=worker, args=(w,))
+        for w in range(args.clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    out_tokens = sum(n for n, _, _ in completed)
+    ttfts_ms = [t for _, t, _ in completed]
+    n_failed = sum(errors.values())
+    for entry in per_replica.values():
+        entry["req_per_s"] = round(entry["ok"] / wall, 3)
+    line = {
+        "metric": "serving_output_tokens_per_sec",
+        "value": round(out_tokens / wall, 1),
+        "unit": "tokens/sec",
+        "requests_per_sec": round(len(completed) / wall, 3),
+        "ttft_ms": _percentiles(ttfts_ms),
+        "itl_ms": _percentiles([]),
+        "n_requests": len(completed),
+        "errors": errors,
+        "retries": retries_total[0],
+        "hedges": hedges_total[0],
+        "failed": n_failed,
+        "output_tokens": out_tokens,
+        "wall_s": round(wall, 3),
+        "per_replica": per_replica,
+        "targets": targets,
+        "clients": args.clients,
+        "new_tokens": args.new_tokens,
+        "prompt_len_range": [min_prompt, max_prompt],
+        "http": True,
+        "smoke": bool(args.smoke),
+    }
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    print(
+        f"[serve_bench] targets={len(targets)} clients={args.clients} "
+        f"reqs={len(completed)} failed={n_failed} "
+        f"retries={retries_total[0]} hedges={hedges_total[0]} "
+        f"wall={wall:.2f}s out_tok/s={out_tokens / wall:.1f} "
+        f"per_replica={json.dumps(per_replica)}",
+        file=sys.stderr,
+    )
+    assert len(completed) + n_failed == args.requests, \
+        "some requests neither completed nor failed"
 
 
 def main() -> None:
@@ -79,6 +273,11 @@ def main() -> None:
     p.add_argument("--http", action="store_true",
                    help="drive the load through the stdlib HTTP server "
                         "(ephemeral port) instead of in-process calls")
+    p.add_argument("--target", action="append", default=None,
+                   help="base URL of an ALREADY-RUNNING server or "
+                        "router (repeat for several replicas, round-"
+                        "robin); implies --http, skips the local "
+                        "engine entirely")
     p.add_argument("--max-retries", type=int, default=3,
                    help="per-request retry budget for retriable "
                         "failures (503 / engine crash)")
@@ -99,6 +298,23 @@ def main() -> None:
         args.prefill_chunk, args.prefill_budget = 8, 16
         args.min_prompt, args.max_prompt, args.new_tokens = 3, 12, 8
 
+    # retry helpers are stdlib-only (serving/retry.py); the engine
+    # stack — and jax — loads only when the load runs in-process
+    from differential_transformer_replication_tpu.serving.retry import (
+        call_with_retries,
+        http_post_json_with_retries,
+    )
+
+    targets = [
+        t if t.endswith("/generate") else t.rstrip("/") + "/generate"
+        for t in (args.target or [])
+    ]
+    if targets:
+        args.http = True
+        _run_against_targets(args, targets,
+                             http_post_json_with_retries)
+        return
+
     import jax
 
     from differential_transformer_replication_tpu.config import (
@@ -112,8 +328,6 @@ def main() -> None:
         ServingClient,
         ServingEngine,
         ShuttingDownError,
-        call_with_retries,
-        http_post_json_with_retries,
         serve,
     )
 
@@ -243,9 +457,10 @@ def main() -> None:
                             "timeout": 600,
                         },
                         timeout=600, max_retries=args.max_retries,
-                        rng=rng_w,
+                        rng=rng_w, deadline_s=args.deadline or None,
                     )
-                except OSError as e:  # transport dead past retry budget
+                except (OSError, ValueError) as e:
+                    # transport dead (or garbage body) past retry budget
                     with lock:
                         errors["other"] += 1
                         retries_total[0] += getattr(
