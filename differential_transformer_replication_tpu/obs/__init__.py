@@ -16,6 +16,22 @@ Three host-side pieces, each dependency-free (stdlib only):
   exposition (the training sidecar; the serving server wires the same
   rendering into its own handler).
 
+Cross-process additions (ISSUE 7):
+
+- :mod:`obs.trace` — request-scoped trace contexts (``trace_id`` /
+  ``span_id``) minted at the router (or any entry point), carried as
+  a ``traceparent`` JSON field through every hop, and stamped onto
+  spans so ``tools/trace_stitch.py`` can follow one request across
+  router and replica trace files.
+- :mod:`obs.events` — a structured JSONL event log (request
+  admitted / finished / failed / retried, replica ejection /
+  re-admission, fleet launches) unifying what router, fleet
+  supervisor, and server used to print ad hoc; request events carry
+  ``trace_id``.
+- :mod:`obs.slo` — availability and latency objectives evaluated
+  against the registry's own histograms/counters, re-exposed as
+  ``slo_*`` burn-rate gauges and CI-gated by ``tools/slo_report.py``.
+
 :mod:`obs.introspect` adds the paper-level window: a jitted-cheap
 summary op extracting per-layer effective lambda (the Differential
 Transformer's central learnable quantity) and per-layer-group param
@@ -30,10 +46,26 @@ from differential_transformer_replication_tpu.obs.registry import (
     Histogram,
     LATENCY_BUCKETS_S,
     Registry,
+    parse_exposition,
+    set_build_info,
 )
 from differential_transformer_replication_tpu.obs.spans import (
     NOOP_TRACER,
     SpanTracer,
+)
+from differential_transformer_replication_tpu.obs.events import (
+    EventLog,
+    NOOP_EVENTS,
+    open_event_log,
+)
+from differential_transformer_replication_tpu.obs.trace import (
+    TraceContext,
+    parse_traceparent,
+)
+from differential_transformer_replication_tpu.obs.slo import (
+    AvailabilityObjective,
+    LatencyObjective,
+    SLOMonitor,
 )
 from differential_transformer_replication_tpu.obs.http import (
     start_metrics_server,
@@ -45,7 +77,17 @@ __all__ = [
     "Histogram",
     "LATENCY_BUCKETS_S",
     "Registry",
+    "parse_exposition",
+    "set_build_info",
     "SpanTracer",
     "NOOP_TRACER",
+    "EventLog",
+    "NOOP_EVENTS",
+    "open_event_log",
+    "TraceContext",
+    "parse_traceparent",
+    "AvailabilityObjective",
+    "LatencyObjective",
+    "SLOMonitor",
     "start_metrics_server",
 ]

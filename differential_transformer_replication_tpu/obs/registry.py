@@ -26,7 +26,9 @@ each read atomically — the standard Prometheus consistency level).
 from __future__ import annotations
 
 import math
+import re
 import threading
+import time
 from bisect import bisect_left
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -394,3 +396,104 @@ class StatsMap:
 
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+# -- reading expositions back (the scrape side) -------------------------
+#
+# The router aggregates its replicas' /metrics bodies into one fleet
+# exposition, and tools/slo_report.py computes burn rates from a
+# scraped snapshot — both need to PARSE the format this module writes.
+# One canonical parser here keeps writer and reader in lockstep.
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label_value(text: str) -> str:
+    out, i = [], 0
+    while i < len(text):
+        c = text[i]
+        if c == "\\" and i + 1 < len(text):
+            nxt = text[i + 1]
+            out.append("\n" if nxt == "n" else nxt)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_value(raw: str) -> float:
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    return float(raw)  # "NaN" parses natively
+
+
+def parse_exposition(
+    text: str,
+) -> Tuple[Dict[str, str], List[Tuple[str, Dict[str, str], float]]]:
+    """Parse a text exposition into ``(types, samples)``:
+    ``types[name] = kind`` from ``# TYPE`` lines, ``samples`` a list of
+    ``(sample_name, labels, value)``. Malformed lines are skipped —
+    a scrape of a foreign (or half-written) endpoint must degrade to
+    partial data, not an exception."""
+    types: Dict[str, str] = {}
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) == 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        labels: Dict[str, str] = {}
+        if m.group(2):
+            for lm in _LABEL_RE.finditer(m.group(2)):
+                labels[lm.group(1)] = _unescape_label_value(lm.group(2))
+        try:
+            samples.append((m.group(1), labels, _parse_value(m.group(3))))
+        except ValueError:
+            continue
+    return types, samples
+
+
+def set_build_info(registry: "Registry", role: str,
+                   config_hash: str = "",
+                   version: Optional[str] = None,
+                   start_time: Optional[float] = None) -> None:
+    """Stamp a registry with process identity: a ``build_info`` info
+    gauge (constant 1; the identity rides the labels, the standard
+    Prometheus idiom) plus ``process_start_time_seconds``. With these,
+    an aggregated fleet scrape (router ``/fleet/metrics``) can tell a
+    router from a replica from a trainer, spot config drift between
+    replicas, and detect silent restarts (start time moved).
+
+    ``role`` is ``router`` | ``replica`` | ``trainer``. ``version`` is
+    the jax version; resolved from package metadata when omitted —
+    WITHOUT importing jax, so the stdlib-only router can stamp itself.
+    """
+    if version is None:
+        try:
+            from importlib.metadata import version as _pkg_version
+
+            version = _pkg_version("jax")
+        except Exception:
+            version = "unknown"
+    registry.gauge(
+        "build_info",
+        "Process identity (constant 1; role/config/version in labels).",
+        labelnames=("role", "config_hash", "jax_version"),
+    ).set(1, role=role, config_hash=config_hash, jax_version=version)
+    registry.gauge(
+        "process_start_time_seconds",
+        "Unix time this process's registry was stamped (a moved value "
+        "across scrapes of one target means a restart).",
+    ).set(time.time() if start_time is None else start_time)

@@ -23,10 +23,20 @@ Design points:
 - **Free when off**: :data:`NOOP_TRACER` is a singleton whose ``span``
   returns a shared no-op context manager — the instrumented hot loops
   pay one attribute call and no allocation when tracing is disabled.
+- **Parented spans**: cross-process correlation rides the ordinary
+  ``args`` dict — a span emitted with ``trace_id``/``span_id``/
+  ``parent_id`` args (minted by obs/trace.py) joins the fleet-wide
+  timeline ``tools/trace_stitch.py`` assembles; :meth:`complete` emits
+  one over an already-measured interval (a request's submit→finish
+  lifetime). NOOP-safe: the no-op tracer accepts the same calls.
+- **Crash-safe tail**: every tracer registers an ``atexit`` close, so
+  a process that exits without reaching its explicit closer (SIGTERM
+  drain paths close eagerly) still terminates a valid JSON document.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -94,6 +104,11 @@ class SpanTracer:
         self._fh.write("[\n")
         self._meta("process_name", {"name": process_name})
         self._meta("process_sort_index", {"sort_index": 0})
+        # safety net: a SIGTERM'd (or plainly exiting) process must not
+        # lose its buffered tail — the graceful-drain paths close
+        # explicitly, and close() is idempotent, so double-closing here
+        # is free
+        atexit.register(self.close)
 
     # -- recording -----------------------------------------------------
 
@@ -120,6 +135,15 @@ class SpanTracer:
             "ts": self._ts(time.perf_counter()),
             "pid": self.pid, "tid": 0, "args": values,
         })
+
+    def complete(self, name: str, t0: float, t1: float, **args) -> None:
+        """One complete event over an ALREADY-MEASURED
+        ``perf_counter`` interval — for spans whose start was recorded
+        before the emitter knew whether (or where) they would end, e.g.
+        a request's submit→finish lifetime stamped with its trace
+        context (``trace_id``/``span_id``/``parent_id`` ride in
+        ``args`` like any other; obs/trace.py mints them)."""
+        self._emit_complete(name, t0, t1, args or None)
 
     # -- internals -----------------------------------------------------
 
@@ -192,6 +216,9 @@ class _NoopTracer:
         pass
 
     def counter(self, name: str, **values) -> None:
+        pass
+
+    def complete(self, name: str, t0: float, t1: float, **args) -> None:
         pass
 
     def flush(self) -> None:

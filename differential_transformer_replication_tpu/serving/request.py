@@ -100,6 +100,10 @@ class RequestOutput:
     finish_time: float = 0.0
     # host timestamp at which each generated token was collected
     token_times: List[float] = field(default_factory=list)
+    # cross-process trace id (obs/trace.py) when the request carried a
+    # trace context — echoed in HTTP replies so a slow request can be
+    # looked up in the stitched timeline (tools/trace_stitch.py)
+    trace_id: Optional[str] = None
 
     @property
     def ttft(self) -> float:

@@ -33,6 +33,23 @@ endpoint (ROADMAP item 4):
   ``/health``, ``/ready`` and ``/metrics`` (per-replica request/error/
   ejection counters, pick latency, hedge counters via obs/registry.py)
   make the fleet observable as one unit.
+- **Request tracing (ISSUE 7)** — every request gets a trace context
+  (client ``traceparent`` or minted here, obs/trace.py), re-injected
+  per attempt so each replica's spans parent to the exact forward hop
+  that caused them; the router's own ``pick``/``forward``/``retry``/
+  ``hedge`` spans (``--trace-path``) stitch with replica traces into
+  one timeline via tools/trace_stitch.py, and every reply — success
+  or failure — echoes ``trace_id``.
+- **Fleet aggregation** — ``GET /fleet/metrics`` re-serves the
+  replicas' last-probed ``/metrics`` bodies as ONE exposition
+  (counters/histograms summed, gauges labeled per replica,
+  :func:`aggregate_fleet_metrics`) plus the router's own registry and
+  per-replica up/down gauges: one scrape target for the whole fleet,
+  and the natural input for ``tools/slo_report.py --url``.
+- **Structured events** — ejection/re-admission and request
+  finished/failed/retried/hedged/shed land in a JSONL event log
+  (``--event-log``, obs/events.py), each request event carrying its
+  ``trace_id``.
 
 Drain-aware by construction: a replica answering ``/ready`` 503 with
 status ``draining`` (what SIGTERM triggers, serving/server.py) is
@@ -70,9 +87,22 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from differential_transformer_replication_tpu.config import RouterConfig
+from differential_transformer_replication_tpu.obs.events import (
+    NOOP_EVENTS,
+)
 from differential_transformer_replication_tpu.obs.registry import (
     CONTENT_TYPE as METRICS_CONTENT_TYPE,
     Registry,
+    _escape_label_value,
+    _fmt_value,
+    parse_exposition,
+    set_build_info,
+)
+from differential_transformer_replication_tpu.obs.spans import NOOP_TRACER
+from differential_transformer_replication_tpu.obs.trace import (
+    child_span_args,
+    from_payload as trace_from_payload,
+    instant_args,
 )
 from differential_transformer_replication_tpu.serving.retry import (
     backoff_delay,
@@ -120,6 +150,95 @@ def parse_replica_scores(text: str) -> Dict[str, float]:
     return out
 
 
+def _histogram_base(name: str, types: Dict[str, str]) -> Optional[str]:
+    """The histogram family a ``*_bucket``/``*_sum``/``*_count`` sample
+    belongs to, or None for a plain sample name."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if types.get(base) == "histogram":
+                return base
+    return None
+
+
+def aggregate_fleet_metrics(bodies: Dict[str, str],
+                            own: str = "") -> str:
+    """Merge N replicas' ``/metrics`` bodies (plus the router's
+    ``own``) into ONE fleet exposition — the single scrape target
+    ``GET /fleet/metrics`` serves:
+
+    - **counters and histograms are summed** across replicas by
+      identical label set (histogram buckets are cumulative counters,
+      so per-``le`` sums stay a valid histogram) — fleet throughput is
+      the sum of replica throughputs;
+    - **gauges keep per-replica identity**: each sample gains a
+      ``replica="host:port"`` label (summing slot occupancies would
+      hide exactly the imbalance a fleet scrape exists to show), which
+      also keeps per-replica ``build_info``/``process_start_time``
+      distinguishable;
+    - the router's ``own`` metrics pass through unmodified, merged
+      under the same TYPE declarations so shared names (``build_info``)
+      render once.
+
+    Unknown or malformed samples are skipped; replicas with disjoint
+    metric sets union cleanly. Pure function — tests drive it with
+    canned bodies and the oracle exposition parser."""
+    kinds: Dict[str, str] = {}
+    # sample name -> ordered {(label tuple) -> value}; summed flag per
+    # name decides merge semantics
+    values: "OrderedDict[str, OrderedDict]" = OrderedDict()
+
+    def _add(sample_name: str, labels: Dict[str, str], value: float,
+             summed: bool) -> None:
+        per = values.setdefault(sample_name, OrderedDict())
+        key = tuple(sorted(labels.items()))
+        if summed and key in per:
+            per[key] += value
+        else:
+            per[key] = value
+
+    def _ingest(text: str, replica: Optional[str]) -> None:
+        types, samples = parse_exposition(text)
+        for name, kind in types.items():
+            kinds.setdefault(name, kind)
+        for sample_name, labels, value in samples:
+            base = _histogram_base(sample_name, types)
+            family = base or sample_name
+            kind = types.get(family, "untyped")
+            if replica is None:
+                _add(sample_name, labels, value, summed=False)
+            elif kind in ("counter", "histogram"):
+                _add(sample_name, labels, value, summed=True)
+            else:  # gauge/untyped: keep replica identity
+                _add(sample_name, {**labels, "replica": replica},
+                     value, summed=False)
+
+    if own:
+        _ingest(own, None)
+    for replica_name, text in bodies.items():
+        _ingest(text, replica_name)
+
+    out: List[str] = []
+    seen_types = set()
+    for sample_name in sorted(values):
+        base = _histogram_base(sample_name, kinds)
+        family = base or sample_name
+        if family not in seen_types:
+            seen_types.add(family)
+            out.append(
+                f"# TYPE {family} {kinds.get(family, 'untyped')}"
+            )
+        for key, value in values[sample_name].items():
+            lbl = (
+                "{" + ",".join(
+                    f'{k}="{_escape_label_value(v)}"' for k, v in key
+                ) + "}"
+                if key else ""
+            )
+            out.append(f"{sample_name}{lbl} {_fmt_value(value)}")
+    return "\n".join(out) + "\n" if out else ""
+
+
 class Replica:
     """One backend's registry entry: URL, health state machine, passive
     load scores, and router-side in-flight count. All mutation happens
@@ -147,6 +266,10 @@ class Replica:
         self.probe_backoff = cfg.probe_backoff_s
         self.probing = False       # an async probe is in flight
         self.last_probe_ok_t: Optional[float] = None
+        # last successfully fetched /metrics body (text exposition) —
+        # what GET /fleet/metrics aggregates; kept across not-ready
+        # windows so a draining replica's counters stay visible
+        self.metrics_text: str = ""
 
     def eligible(self) -> bool:
         with self.lock:
@@ -257,7 +380,8 @@ class Router:
                  cfg: Optional[RouterConfig] = None,
                  registry: Optional[Registry] = None,
                  rng: Optional[random.Random] = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 tracer=None, events=None):
         if not targets:
             raise ValueError("router needs at least one replica URL")
         self.cfg = cfg or RouterConfig()
@@ -265,6 +389,13 @@ class Router:
         if len({r.url for r in self.replicas}) != len(self.replicas):
             raise ValueError(f"duplicate replica URLs in {list(targets)}")
         self.registry = registry or Registry()
+        # cross-process observability (ISSUE 7): span tracer for
+        # pick/forward/retry/hedge (obs/spans.py; stitchable with the
+        # replicas' traces via tools/trace_stitch.py) and a structured
+        # JSONL event log (obs/events.py). Both default to no-ops.
+        self.tracer = tracer or NOOP_TRACER
+        self.events = events or NOOP_EVENTS
+        set_build_info(self.registry, role="router")
         self._rng = rng or random.Random()
         self._rng_lock = threading.Lock()
         self._sleep = sleep
@@ -354,6 +485,10 @@ class Router:
         if self._probe_thread is not None:
             self._probe_thread.join(5.0)
             self._probe_thread = None
+        # land buffered telemetry; closing is the creator's call (the
+        # CLI closes in its finally, atexit is the safety net)
+        self.tracer.flush()
+        self.events.flush()
 
     # -- probing -------------------------------------------------------
 
@@ -368,8 +503,11 @@ class Router:
 
     def probe(self, replica: Replica, now: Optional[float] = None) -> None:
         """One probe: /ready for state, /metrics (best-effort) for load
-        scores. Transport failures drive the ejection state machine."""
+        scores AND the raw exposition body the fleet aggregation
+        re-serves. Transport failures drive the ejection state machine;
+        ejection and (slow) re-admission land structured events."""
         t = self.cfg.probe_timeout_s
+        prev_state = replica.state
         try:
             faults.check("router_probe_fail")
             status_code, body = self._http_get(
@@ -388,15 +526,19 @@ class Router:
                         replica.url + "/metrics", timeout=t
                     )
                     if code == 200:
-                        scores = parse_replica_scores(
-                            text.decode("utf-8", "replace")
-                        )
+                        decoded = text.decode("utf-8", "replace")
+                        scores = parse_replica_scores(decoded)
+                        with replica.lock:
+                            replica.metrics_text = decoded
                 except OSError:
                     pass  # scores are advisory; /ready is the contract
             replica.note_probe_success(
                 ready, status, scores,
                 now=time.monotonic() if now is None else now,
             )
+            if prev_state == EJECTED and replica.state == UP:
+                self.events.emit("replica_readmitted",
+                                 replica=replica.name)
         except Exception:
             # unreachable (or an injected probe failure): one strike
             newly_ejected = replica.note_failure(
@@ -404,6 +546,9 @@ class Router:
             )
             if newly_ejected:
                 self._eject_counter.inc(replica=replica.name)
+                self.events.emit("replica_ejected", replica=replica.name,
+                                 consec_fail=replica.consec_fail,
+                                 via="probe")
                 print(f"[router] replica {replica.name} ejected after "
                       f"{replica.consec_fail} consecutive failures",
                       file=sys.stderr)
@@ -499,7 +644,7 @@ class Router:
     # -- forwarding ----------------------------------------------------
 
     def _forward(self, replica: Replica, payload: dict, timeout: float,
-                 timeout_is_deadline: bool = False,
+                 timeout_is_deadline: bool = False, ctx=None,
                  ) -> Tuple[int, dict, Optional[float]]:
         """POST one attempt to one replica. Returns ``(status, body,
         retry_after)``; transport failures come back as status ``-1``
@@ -507,31 +652,45 @@ class Router:
         streak) instead of raising — the failover loop treats them like
         a retriable 503 from a replica that told us nothing.
 
+        ``ctx`` is the request's TraceContext: each attempt derives a
+        child hop, injects it as the outgoing ``traceparent`` (the
+        replica's spans parent to THIS attempt, so a retried request's
+        two attempts stay distinguishable in the stitched timeline),
+        and wraps the attempt in a ``forward`` span.
+
         ``timeout_is_deadline`` marks a timeout clamped to the
         request's remaining deadline budget: hitting it means the
         REQUEST ran out of time while the replica worked, so it maps
         to a non-retriable 504 ``deadline`` and the replica takes no
         ejection strike — three slow requests must not eject a healthy
         replica."""
+        span_args = {"replica": replica.name}
+        if ctx is not None:
+            fwd = ctx.child()
+            payload = dict(payload)
+            payload["traceparent"] = fwd.to_traceparent()
+            span_args.update(trace_id=ctx.trace_id, span_id=fwd.span_id,
+                             parent_id=ctx.span_id)
         with replica.lock:
             replica.inflight += 1
         self._req_counter.inc(replica=replica.name)
         t0 = time.perf_counter()
         try:
-            faults.stall("router_replica_hang")
-            req = urllib.request.Request(
-                replica.url + "/generate",
-                data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(req, timeout=timeout) as r:
-                body = json.load(r)
-                if not isinstance(body, dict):
-                    raise ValueError(f"non-object reply: {body!r}")
-                replica.note_request_success()
-                with self._lat_lock:
-                    self._latencies.append(time.perf_counter() - t0)
-                return r.status, body, None
+            with self.tracer.span("forward", **span_args):
+                faults.stall("router_replica_hang")
+                req = urllib.request.Request(
+                    replica.url + "/generate",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    body = json.load(r)
+                    if not isinstance(body, dict):
+                        raise ValueError(f"non-object reply: {body!r}")
+                    replica.note_request_success()
+                    with self._lat_lock:
+                        self._latencies.append(time.perf_counter() - t0)
+                    return r.status, body, None
         except urllib.error.HTTPError as e:
             try:
                 body = json.loads(e.read() or b"{}")
@@ -570,6 +729,9 @@ class Router:
             # over like any other transport death, not surface a 500
             if replica.note_failure(time.monotonic()):
                 self._eject_counter.inc(replica=replica.name)
+                self.events.emit("replica_ejected",
+                                 replica=replica.name, via="request",
+                                 error=repr(e))
                 print(f"[router] replica {replica.name} ejected "
                       f"(request transport failure: {e!r})",
                       file=sys.stderr)
@@ -598,14 +760,14 @@ class Router:
 
     def _attempt(self, replica: Replica, payload: dict, timeout: float,
                  exclude: Sequence[str],
-                 timeout_is_deadline: bool = False):
+                 timeout_is_deadline: bool = False, ctx=None):
         """One failover attempt, with an optional hedged twin. Returns
         ``(status, body, retry_after, replica, hedged)`` where
         ``replica`` is the one whose reply was used."""
         budget = self._hedge_budget()
         if budget is None:
             status, body, ra = self._forward(
-                replica, payload, timeout, timeout_is_deadline
+                replica, payload, timeout, timeout_is_deadline, ctx=ctx
             )
             return status, body, ra, replica, False
 
@@ -615,7 +777,7 @@ class Router:
 
         def run(rep: Replica) -> None:
             out = self._forward(rep, payload, timeout,
-                                timeout_is_deadline)
+                                timeout_is_deadline, ctx=ctx)
             with cond:
                 results.append((*out, rep))
                 cond.notify_all()
@@ -634,6 +796,18 @@ class Router:
                 if other is not None:
                     hedged = True
                     self._hedge_counter.inc()
+                    self.tracer.instant(
+                        "hedge", primary=replica.name,
+                        hedge=other.name,
+                        **(instant_args(ctx) if ctx is not None else {}),
+                    )
+                    self.events.emit(
+                        "request_hedged", primary=replica.name,
+                        hedge=other.name,
+                        trace_id=(
+                            ctx.trace_id if ctx is not None else None
+                        ),
+                    )
                     threading.Thread(
                         target=run, args=(other,), daemon=True
                     ).start()
@@ -697,7 +871,11 @@ class Router:
         """Route one /generate request; returns ``(status, body,
         headers)``. Implements admission shedding, failover across
         distinct replicas under the deadline budget, Retry-After
-        capping, affinity, and response attribution."""
+        capping, affinity, and response attribution. Every request
+        gets a trace context — client-supplied ``traceparent`` or
+        minted here — propagated to the replica on each attempt and
+        echoed as ``trace_id`` in every reply, success or failure."""
+        ctx = trace_from_payload(payload)
         session_id = payload.get("session_id")
         if session_id is not None:
             session_id = str(session_id)
@@ -718,32 +896,46 @@ class Router:
         tried: List[str] = []
         last: Optional[Tuple[int, dict, dict]] = None
         attempt = 0
+
+        def _done(status: int, body: dict, headers: dict):
+            body.setdefault("trace_id", ctx.trace_id)
+            self.events.emit(
+                "request_finished" if status == 200 else "request_failed",
+                status=status, trace_id=ctx.trace_id, attempts=attempt,
+                replica=body.get("replica"), code=body.get("code"),
+            )
+            return status, body, headers
+
         while True:
-            replica = self._pick_for_attempt(session_id, tried, end)
+            with self.tracer.span("pick", attempt=attempt,
+                                  **child_span_args(ctx)):
+                replica = self._pick_for_attempt(session_id, tried, end)
             if replica is None:
                 if last is not None:
-                    return last
+                    return _done(*last)
                 # nothing eligible within the wait budget: shed typed
                 self._shed_counter.inc()
-                return 503, {
+                self.events.emit("request_shed", trace_id=ctx.trace_id)
+                return _done(503, {
                     "error": "no replica available "
                              "(all ejected, draining, or not ready)",
                     "code": "no_replica",
-                }, shed_headers
+                }, shed_headers)
             timeout = 600.0
             timeout_is_deadline = False
             if end is not None:
                 timeout = max(0.05, end - time.monotonic())
                 timeout_is_deadline = True
             status, body, retry_after, used, hedged = self._attempt(
-                replica, payload, timeout, tried, timeout_is_deadline
+                replica, payload, timeout, tried, timeout_is_deadline,
+                ctx=ctx,
             )
             attempt += 1
             if status == 200:
                 body["replica"] = used.name
                 body["attempts"] = attempt
                 body["hedged"] = hedged
-                return 200, body, {}
+                return _done(200, body, {})
             retriable = status == -1 or (
                 status == 503
                 and body.get("code") not in NON_RETRIABLE_503_CODES
@@ -752,7 +944,7 @@ class Router:
                 # non-recoverable (504 deadline, timeout,
                 # engine_failed, 4xx/5xx): pass through, attributed
                 body.setdefault("replica", used.name)
-                return (status, body, {})
+                return _done(status, body, {})
             tried.append(replica.url)
             if used is not replica and used.url not in tried:
                 tried.append(used.url)  # a failed hedge also counts
@@ -767,7 +959,7 @@ class Router:
             }
             last = (503 if status == -1 else status, body, headers)
             if attempt >= self.cfg.max_attempts:
-                return last
+                return _done(*last)
             delay = backoff_delay(
                 attempt - 1, base=self.cfg.retry_base_s,
                 cap=self.cfg.retry_cap_s, retry_after=capped_ra,
@@ -776,8 +968,17 @@ class Router:
             if end is not None and time.monotonic() + delay >= end:
                 # deadline would expire mid-backoff: surface the last
                 # typed failure instead of manufacturing a 504
-                return last
+                return _done(*last)
             self._retry_counter.inc()
+            self.tracer.instant(
+                "retry", attempt=attempt, failed=used.name,
+                code=str(body.get("code", status)), **instant_args(ctx),
+            )
+            self.events.emit(
+                "request_retried", trace_id=ctx.trace_id,
+                attempt=attempt, failed=used.name,
+                code=body.get("code"),
+            )
             self._sleep(delay)
 
     # -- fleet observability -------------------------------------------
@@ -793,6 +994,28 @@ class Router:
             "eligible": self.eligible_count(),
             "replicas": [r.snapshot() for r in self.replicas],
         }
+
+    def fleet_metrics(self) -> str:
+        """One exposition for the whole fleet (``GET /fleet/metrics``):
+        the replicas' last-probed ``/metrics`` bodies summed/labeled
+        (see :func:`aggregate_fleet_metrics`) plus the router's own
+        registry, plus a synthesized ``fleet_replica_up`` gauge from
+        the health state machine — so one scrape answers both "how
+        much work is the fleet doing" and "who is in rotation"."""
+        bodies: Dict[str, str] = {}
+        up_lines = ["# TYPE fleet_replica_up gauge"]
+        for r in self.replicas:
+            with r.lock:
+                text = r.metrics_text
+                state = r.state
+            if text:
+                bodies[r.name] = text
+            up_lines.append(
+                f'fleet_replica_up{{replica="{r.name}",'
+                f'state="{state}"}} {1 if state == UP else 0}'
+            )
+        own = self.registry.render() + "\n".join(up_lines) + "\n"
+        return aggregate_fleet_metrics(bodies, own=own)
 
 
 def _fmt_secs(secs: float) -> str:
@@ -815,8 +1038,15 @@ def _make_handler(router: Router):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/metrics":
-                body = router.registry.render().encode("utf-8")
+            if self.path in ("/metrics", "/fleet/metrics"):
+                # /metrics = the router's own registry; /fleet/metrics
+                # = one scrape target for the whole fleet (per-replica
+                # bodies summed/labeled from the probe loop's parses)
+                text = (
+                    router.registry.render() if self.path == "/metrics"
+                    else router.fleet_metrics()
+                )
+                body = text.encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type", METRICS_CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(body)))
@@ -894,6 +1124,14 @@ def main() -> None:
                    help="hedge a request stuck past this multiple of "
                         "observed p99 latency (0 = hedging off)")
     p.add_argument("--hedge-min", type=float, default=0.25)
+    p.add_argument("--trace-path", default=None,
+                   help="write a Chrome-trace-event JSON of "
+                        "pick/forward/retry/hedge spans (stitch with "
+                        "replica traces via tools/trace_stitch.py)")
+    p.add_argument("--event-log", default=None,
+                   help="append structured JSONL events (request "
+                        "finished/failed/retried, replica ejection/"
+                        "re-admission; obs/events.py)")
     args = p.parse_args()
 
     cfg = RouterConfig(
@@ -905,11 +1143,27 @@ def main() -> None:
         hedge_factor=args.hedge_factor,
         hedge_min_s=args.hedge_min,
     )
-    router = Router(args.target, cfg).start()
+    tracer = None
+    if args.trace_path:
+        from differential_transformer_replication_tpu.obs.spans import (
+            SpanTracer,
+        )
+
+        tracer = SpanTracer(args.trace_path, process_name="router")
+    events = None
+    if args.event_log:
+        from differential_transformer_replication_tpu.obs.events import (
+            EventLog,
+        )
+
+        events = EventLog(args.event_log, process="router")
+    router = Router(args.target, cfg, tracer=tracer,
+                    events=events).start()
     httpd = serve_router(router, args.host, args.port)
     print(f"[router] fronting {len(router.replicas)} replicas — "
           f"POST http://{args.host}:{args.port}/generate, fleet state "
-          f"at GET http://{args.host}:{args.port}/health")
+          f"at GET http://{args.host}:{args.port}/health, one-scrape "
+          f"fleet metrics at GET /fleet/metrics")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
@@ -917,6 +1171,10 @@ def main() -> None:
     finally:
         httpd.server_close()
         router.close()
+        if tracer is not None:
+            tracer.close()
+        if events is not None:
+            events.close()
 
 
 if __name__ == "__main__":

@@ -77,6 +77,10 @@ class Slot:
     deadline: float = 0.0
     first_token_time: float = 0.0
     token_times: List[float] = field(default_factory=list)
+    # the request's cross-process trace context
+    # (obs/trace.py:TraceContext), or None when it arrived untraced —
+    # pure host-side bookkeeping, stamped onto span/instant args only
+    trace: Optional[object] = None
 
     @property
     def prompt_len(self) -> int:
@@ -93,6 +97,7 @@ class Slot:
         self.deadline = 0.0
         self.first_token_time = 0.0
         self.token_times = []
+        self.trace = None
 
 
 def _pow2_chunk(n: int, cap: int) -> int:
@@ -107,9 +112,12 @@ class Scheduler:
     def __init__(self, serving: ServingConfig):
         self.serving = serving
         self.slots = [Slot(index=i) for i in range(serving.num_slots)]
-        # (request, cropped prompt, submit_time, deadline) — deadline is
-        # an absolute perf_counter() timestamp, 0.0 = none
-        self.queue: Deque[Tuple[Request, np.ndarray, float, float]] = deque()
+        # (request, cropped prompt, submit_time, deadline, trace) —
+        # deadline is an absolute perf_counter() timestamp, 0.0 = none;
+        # trace is the request's TraceContext or None
+        self.queue: Deque[
+            Tuple[Request, np.ndarray, float, float, Optional[object]]
+        ] = deque()
         self._admit_seq = 0
         # invariant checked by tests: concurrent occupied slots never
         # exceed the pool
@@ -118,7 +126,8 @@ class Scheduler:
     # -- submission ---------------------------------------------------
 
     def submit(self, request: Request, prompt: np.ndarray,
-               submit_time: float, deadline: float = 0.0) -> None:
+               submit_time: float, deadline: float = 0.0,
+               trace: Optional[object] = None) -> None:
         """Enqueue an engine-validated (request, cropped prompt) pair.
         Raises :class:`QueueFullError` when the wait queue is at
         ``max_queue_len`` (0 = unbounded): overload must degrade into
@@ -131,7 +140,7 @@ class Scheduler:
                 f"{self.occupied()}/{len(self.slots)} slots busy); retry "
                 "later"
             )
-        self.queue.append((request, prompt, submit_time, deadline))
+        self.queue.append((request, prompt, submit_time, deadline, trace))
 
     def cancel(self, request_id: int) -> bool:
         """Remove a request wherever it lives: still waiting (dropped
@@ -168,7 +177,7 @@ class Scheduler:
     # -- deadlines ----------------------------------------------------
 
     def shed_expired(self, now: float) -> List[
-        Tuple[Request, np.ndarray, float, float]
+        Tuple[Request, np.ndarray, float, float, Optional[object]]
     ]:
         """Drop already-expired entries from the wait queue and return
         them. Admission-time shedding: a request whose deadline passed
@@ -208,7 +217,9 @@ class Scheduler:
                 break
             if slot.state != FREE:
                 continue
-            request, prompt, t_submit, deadline = self.queue.popleft()
+            request, prompt, t_submit, deadline, trace = (
+                self.queue.popleft()
+            )
             slot.state = PREFILL
             slot.request = request
             slot.prompt = prompt
@@ -217,6 +228,7 @@ class Scheduler:
             slot.token_times = []
             slot.submit_time = t_submit
             slot.deadline = deadline
+            slot.trace = trace
             slot.admit_seq = self._admit_seq
             self._admit_seq += 1
         self.max_concurrent = max(self.max_concurrent, self.occupied())

@@ -40,8 +40,14 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence
 
+from differential_transformer_replication_tpu.obs.events import (
+    NOOP_EVENTS,
+)
 from differential_transformer_replication_tpu.obs.registry import (
     CONTENT_TYPE as METRICS_CONTENT_TYPE,
+)
+from differential_transformer_replication_tpu.obs.trace import (
+    from_payload as trace_from_payload,
 )
 from differential_transformer_replication_tpu.serving.engine import (
     EngineCrashError,
@@ -80,13 +86,14 @@ def _inc_stat(stats, key: str) -> None:
 class _Pending:
     """One submitted request's handle across the thread boundary."""
 
-    __slots__ = ("prompt", "params", "deadline", "done", "result",
-                 "error", "rid", "cancelled", "settled")
+    __slots__ = ("prompt", "params", "deadline", "trace", "done",
+                 "result", "error", "rid", "cancelled", "settled")
 
-    def __init__(self, prompt, params, deadline=None):
+    def __init__(self, prompt, params, deadline=None, trace=None):
         self.prompt = prompt
         self.params = params
         self.deadline = deadline  # absolute perf_counter ts, or None
+        self.trace = trace        # TraceContext (obs/trace.py) or None
         self.done = threading.Event()
         self.result: Optional[RequestOutput] = None
         self.error: Optional[BaseException] = None
@@ -174,7 +181,8 @@ class EngineRunner:
 
     def submit(self, prompt: Sequence[int],
                params: Optional[SamplingParams] = None,
-               deadline_s: Optional[float] = None, **kw) -> _Pending:
+               deadline_s: Optional[float] = None,
+               trace=None, **kw) -> _Pending:
         """Thread-safe enqueue; returns the request's :class:`_Pending`
         handle. Raises :class:`QueueFullError` IMMEDIATELY when the
         admission bound (ServingConfig.max_queue_len) is hit — counting
@@ -184,6 +192,8 @@ class EngineRunner:
         draining/closed. ``deadline_s`` is a server-side budget in
         seconds from now; the engine stops working on the request once
         it expires (the caller gets :class:`DeadlineExceededError`).
+        ``trace`` is the request's cross-process TraceContext
+        (obs/trace.py), forwarded to the engine for span stamping.
         Submissions during a supervised engine restart are accepted —
         they queue and run once the rebuilt engine is up."""
         params = params or SamplingParams(**kw)
@@ -191,7 +201,7 @@ class EngineRunner:
             time.perf_counter() + deadline_s
             if deadline_s is not None else None
         )
-        pending = _Pending(list(prompt), params, deadline)
+        pending = _Pending(list(prompt), params, deadline, trace)
         with self._cond:
             if self._failed:
                 err = EngineCrashError(
@@ -235,8 +245,10 @@ class EngineRunner:
     def generate(self, prompt: Sequence[int],
                  params: Optional[SamplingParams] = None,
                  timeout: Optional[float] = None,
-                 deadline_s: Optional[float] = None, **kw) -> RequestOutput:
-        pending = self.submit(prompt, params, deadline_s=deadline_s, **kw)
+                 deadline_s: Optional[float] = None,
+                 trace=None, **kw) -> RequestOutput:
+        pending = self.submit(prompt, params, deadline_s=deadline_s,
+                              trace=trace, **kw)
         if not pending.done.wait(timeout):
             # reclaim the engine-side resources before giving up — the
             # old behavior decoded to completion for nobody, pinning a
@@ -466,15 +478,16 @@ class EngineRunner:
                     )
                     continue
                 try:
+                    # optional kwargs passed only when set, so plain
+                    # test-double engines keep their narrow signatures
+                    opt = {}
                     if pending.deadline is not None:
-                        pending.rid = self.engine.submit(
-                            pending.prompt, params=pending.params,
-                            deadline=pending.deadline,
-                        )
-                    else:
-                        pending.rid = self.engine.submit(
-                            pending.prompt, params=pending.params
-                        )
+                        opt["deadline"] = pending.deadline
+                    if pending.trace is not None:
+                        opt["trace"] = pending.trace
+                    pending.rid = self.engine.submit(
+                        pending.prompt, params=pending.params, **opt
+                    )
                     waiters[pending.rid] = pending
                 except Exception as e:  # invalid request: fail the caller
                     self._settle(pending, error=e)
@@ -514,9 +527,11 @@ class ServingClient:
     def generate(self, prompt: Sequence[int],
                  params: Optional[SamplingParams] = None,
                  timeout: Optional[float] = None,
-                 deadline_s: Optional[float] = None, **kw) -> RequestOutput:
+                 deadline_s: Optional[float] = None,
+                 trace=None, **kw) -> RequestOutput:
         return self.runner.generate(
-            prompt, params, timeout=timeout, deadline_s=deadline_s, **kw
+            prompt, params, timeout=timeout, deadline_s=deadline_s,
+            trace=trace, **kw
         )
 
     def generate_batch(self, prompts: Sequence[Sequence[int]],
@@ -578,7 +593,10 @@ class ServingClient:
         self.runner.close()
 
 
-def _make_handler(client: ServingClient, tokenizer=None):
+def _make_handler(client: ServingClient, tokenizer=None, events=None,
+                  slo=None):
+    events = events or NOOP_EVENTS
+
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, code: int, payload: dict,
                    headers: Optional[dict] = None) -> None:
@@ -608,6 +626,10 @@ def _make_handler(client: ServingClient, tokenizer=None):
                 if registry is None:
                     self._reply(404, {"error": "no metrics registry"})
                     return
+                if slo is not None:
+                    # refresh the slo_* burn-rate gauges so every
+                    # scrape carries a current judgment (obs/slo.py)
+                    slo.evaluate()
                 body = registry.render().encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type", METRICS_CONTENT_TYPE)
@@ -647,9 +669,29 @@ def _make_handler(client: ServingClient, tokenizer=None):
             if self.path != "/generate":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
+            ctx = None  # TraceContext once the body parses
+
+            def _fail(code: int, payload: dict, headers=None,
+                      event: str = "request_failed") -> None:
+                # every error reply carries the request's trace id (when
+                # the body parsed far enough to have one) and lands one
+                # structured event, so a failed request is findable in
+                # both the stitched timeline and the event log
+                if ctx is not None:
+                    payload.setdefault("trace_id", ctx.trace_id)
+                events.emit(event, status=code,
+                            code=payload.get("code"),
+                            trace_id=payload.get("trace_id"))
+                self._reply(code, payload, headers=headers)
+
             try:
                 n = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(n) or b"{}")
+                # the traceparent JSON field is the cross-process trace
+                # contract (obs/trace.py): the router mints and injects
+                # one; a directly-hit replica mints its own, so replies
+                # ALWAYS carry a trace_id a stitched timeline can find
+                ctx = trace_from_payload(req)
                 prompt_ids = req.get("prompt_ids")
                 if prompt_ids is None and "prompt" in req:
                     if tokenizer is None:
@@ -670,15 +712,23 @@ def _make_handler(client: ServingClient, tokenizer=None):
                     eos_token_id=None if eos is None else int(eos),
                 )
                 deadline_s = req.get("deadline_s")
+                # "received", not "admitted": a QueueFullError /
+                # ShuttingDownError raised inside generate() means the
+                # scheduler never accepted this request — true
+                # admission is the engine's trace-stamped `admit`
+                # instant; this event marks arrival at the handler
+                events.emit("request_received", trace_id=ctx.trace_id,
+                            prompt_len=len(prompt_ids))
                 out = client.generate(
                     [int(t) for t in prompt_ids], params,
                     timeout=float(req.get("timeout", 600.0)),
                     deadline_s=(
                         None if deadline_s is None else float(deadline_s)
                     ),
+                    trace=ctx,
                 )
             except (ValueError, TypeError, json.JSONDecodeError) as e:
-                self._reply(400, {"error": str(e), "code": "bad_request"})
+                _fail(400, {"error": str(e), "code": "bad_request"})
                 return
             except QueueFullError as e:
                 # overload: reject fast with the retryable status so
@@ -687,7 +737,7 @@ def _make_handler(client: ServingClient, tokenizer=None):
                 # serving/retry.py gates retries on it and the bench
                 # classifies by it, so rewording the human text cannot
                 # silently change client behavior.
-                self._reply(
+                _fail(
                     503,
                     {"error": f"server overloaded: {e}",
                      "code": "queue_full"},
@@ -695,15 +745,15 @@ def _make_handler(client: ServingClient, tokenizer=None):
                 )
                 return
             except ShuttingDownError as e:
-                self._reply(503, {"error": str(e),
-                                  "code": "shutting_down"},
-                            headers=self._retry_after())
+                _fail(503, {"error": str(e),
+                            "code": "shutting_down"},
+                      headers=self._retry_after())
                 return
             except EngineCrashError as e:
                 if getattr(e, "retriable", True):
                     # the supervised restart is already underway — a
                     # retry after the backoff lands on the rebuilt engine
-                    self._reply(
+                    _fail(
                         503, {"error": f"engine crashed: {e}",
                               "code": "engine_crash"},
                         headers=self._retry_after(),
@@ -712,11 +762,11 @@ def _make_handler(client: ServingClient, tokenizer=None):
                     # restart budget exhausted: this replica will NEVER
                     # recover — no Retry-After, non-retriable code, so
                     # clients fail over instead of burning their budget
-                    self._reply(503, {"error": str(e),
-                                      "code": "engine_failed"})
+                    _fail(503, {"error": str(e),
+                                "code": "engine_failed"})
                 return
             except DeadlineExceededError as e:
-                self._reply(504, {
+                _fail(504, {
                     "error": str(e),
                     "code": "deadline",
                     "partial_tokens": (
@@ -728,15 +778,15 @@ def _make_handler(client: ServingClient, tokenizer=None):
                 # the request burned its FULL generation timeout — a
                 # retry would re-add that same load to a server at its
                 # slowest, so: no Retry-After, non-retriable code
-                self._reply(503, {"error": "generation timed out",
-                                  "code": "timeout"})
+                _fail(503, {"error": "generation timed out",
+                            "code": "timeout"})
                 return
             except Exception as e:  # unexpected failure — still typed:
                 # the router (serving/router.py) and retry client key
                 # retriability off the machine-readable "code"; an
                 # untyped stack-trace 500 would strand them guessing
-                self._reply(500, {"error": str(e) or repr(e),
-                                  "code": "internal"})
+                _fail(500, {"error": str(e) or repr(e),
+                            "code": "internal"})
                 return
             payload = {
                 "request_id": out.request_id,
@@ -744,9 +794,15 @@ def _make_handler(client: ServingClient, tokenizer=None):
                 "tokens": out.tokens,
                 "finish_reason": out.finish_reason,
                 "ttft_ms": round(out.ttft * 1e3, 3),
+                "trace_id": out.trace_id or ctx.trace_id,
             }
             if tokenizer is not None:
                 payload["text"] = tokenizer.decode(out.tokens)
+            events.emit("request_finished",
+                        trace_id=payload["trace_id"],
+                        reason=out.finish_reason,
+                        tokens=len(out.tokens),
+                        ttft_ms=payload["ttft_ms"])
             self._reply(200, payload)
 
         def log_message(self, *a):  # quiet by default
@@ -756,10 +812,12 @@ def _make_handler(client: ServingClient, tokenizer=None):
 
 
 def serve(client: ServingClient, host: str = "127.0.0.1", port: int = 8000,
-          tokenizer=None) -> ThreadingHTTPServer:
-    """Build the HTTP server (not yet serving; call serve_forever())."""
+          tokenizer=None, events=None, slo=None) -> ThreadingHTTPServer:
+    """Build the HTTP server (not yet serving; call serve_forever()).
+    ``events`` is an obs/events.py EventLog (None = off); ``slo`` an
+    obs/slo.py SLOMonitor evaluated on every /metrics scrape."""
     return ThreadingHTTPServer(
-        (host, port), _make_handler(client, tokenizer)
+        (host, port), _make_handler(client, tokenizer, events, slo)
     )
 
 
@@ -816,7 +874,26 @@ def main() -> None:
     p.add_argument("--trace-path", default=None,
                    help="write a Chrome-trace-event JSON of engine "
                         "iterations (schedule/prefill/decode/sample/emit "
-                        "spans; open in Perfetto) to this path")
+                        "spans + per-request trace-stamped lifecycle; "
+                        "open in Perfetto or merge fleet-wide with "
+                        "tools/trace_stitch.py) to this path")
+    p.add_argument("--event-log", default=None,
+                   help="append structured JSONL events (request "
+                        "received/finished/failed with trace ids; "
+                        "obs/events.py) to this path")
+    p.add_argument("--slo-ttft", type=float, default=1.0,
+                   help="TTFT latency objective bound in seconds "
+                        "(obs/slo.py; burn rates exposed as slo_* "
+                        "gauges on /metrics)")
+    p.add_argument("--slo-itl", type=float, default=0.25,
+                   help="inter-token latency objective bound in seconds")
+    p.add_argument("--slo-target", type=float, default=0.99,
+                   help="latency objectives' target fraction of "
+                        "requests under the bound")
+    p.add_argument("--slo-availability-target", type=float,
+                   default=0.999,
+                   help="availability objective target (completed vs "
+                        "rejected/deadline-expired)")
     p.add_argument("--no-verify-checkpoint", action="store_true",
                    help="skip integrity-manifest verification of "
                         "--checkpoint (needed for pre-manifest "
@@ -878,10 +955,44 @@ def main() -> None:
         )
 
         tracer = SpanTracer(args.trace_path, process_name="serving-engine")
-    client = ServingClient(
-        ServingEngine(params, model_cfg, serving, tracer=tracer)
+    events = None
+    if args.event_log:
+        from differential_transformer_replication_tpu.obs.events import (
+            EventLog,
+        )
+
+        events = EventLog(args.event_log, process="replica")
+    engine = ServingEngine(params, model_cfg, serving, tracer=tracer)
+    client = ServingClient(engine)
+
+    # process identity on /metrics: lets the router's aggregated
+    # /fleet/metrics tell replicas apart and spot config drift
+    import dataclasses as _dc
+    import hashlib as _hashlib
+
+    from differential_transformer_replication_tpu.obs.registry import (
+        set_build_info,
     )
-    httpd = serve(client, args.host, args.port, tokenizer)
+    from differential_transformer_replication_tpu.obs.slo import (
+        SLOMonitor,
+        default_serving_objectives,
+    )
+
+    cfg_hash = _hashlib.sha1(
+        json.dumps(_dc.asdict(model_cfg), sort_keys=True,
+                   default=str).encode()
+    ).hexdigest()[:12]
+    set_build_info(engine.registry, role="replica", config_hash=cfg_hash,
+                   version=jax.__version__)
+    slo_latency, slo_availability = default_serving_objectives(
+        ttft_threshold_s=args.slo_ttft, itl_threshold_s=args.slo_itl,
+        latency_target=args.slo_target,
+        availability_target=args.slo_availability_target,
+    )
+    slo = SLOMonitor(engine.registry, latency=slo_latency,
+                     availability=slo_availability)
+    httpd = serve(client, args.host, args.port, tokenizer,
+                  events=events, slo=slo)
 
     import signal
 
@@ -904,6 +1015,16 @@ def main() -> None:
                 # the same wedged thread
                 print(f"[serve] drain failed: {e!r}", file=sys.stderr)
             finally:
+                # buffered telemetry must land BEFORE the process goes
+                # away: a SIGTERM'd replica used to rely on the main
+                # thread's finally block alone, which a wedged drain
+                # could starve — close here (idempotent; the atexit net
+                # in obs/spans.py+obs/events.py is the last resort)
+                if tracer is not None:
+                    tracer.close()
+                if events is not None:
+                    events.emit("drained")
+                    events.close()
                 # the HTTP loop must stop regardless, or SIGTERM leaves
                 # a zombie serving 503s forever
                 drained["done"] = True
@@ -930,6 +1051,8 @@ def main() -> None:
         if tracer is not None:
             tracer.close()
             print(f"[serve] span trace written to {args.trace_path}")
+        if events is not None:
+            events.close()
 
 
 if __name__ == "__main__":

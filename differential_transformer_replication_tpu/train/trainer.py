@@ -37,6 +37,7 @@ from differential_transformer_replication_tpu.obs import (
     NOOP_TRACER,
     Registry,
     SpanTracer,
+    set_build_info,
     start_metrics_server,
 )
 from differential_transformer_replication_tpu.obs.introspect import (
@@ -45,6 +46,7 @@ from differential_transformer_replication_tpu.obs.introspect import (
 )
 from differential_transformer_replication_tpu.train.metrics import (
     MetricLogger,
+    config_hash,
     device_memory_mb,
 )
 from differential_transformer_replication_tpu.utils import ProfilerWindow, Throughput
@@ -294,6 +296,12 @@ def train(cfg: TrainConfig) -> dict:
     # cheap — a few lock-guarded float updates per iteration); the
     # sidecar exporter and the Chrome span trace are opt-in knobs.
     registry = Registry()
+    # process identity on the sidecar's /metrics (same build_info gauge
+    # roles as router/replica, so an aggregated fleet scrape that
+    # includes a training sidecar stays attributable)
+    set_build_info(registry, role="trainer",
+                   config_hash=config_hash(cfg),
+                   version=jax.__version__)
     obs_step_hist = registry.histogram(
         "train_step_seconds",
         "Wall time of one train-loop iteration, host-observed "

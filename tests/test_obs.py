@@ -401,7 +401,9 @@ def test_engine_observability_adds_zero_recompiles():
             self.spans += 1
             return NOOP_TRACER.span(name)
 
-        instant = counter = flush = close = staticmethod(lambda *a, **k: None)
+        instant = counter = complete = flush = close = staticmethod(
+            lambda *a, **k: None
+        )
 
     tracer = _CountingTracer()
     eng2 = ServingEngine(params, cfg, serving, tracer=tracer)

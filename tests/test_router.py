@@ -450,7 +450,8 @@ def _two_replica_router(script_a, script_b, cfg=None, **kw):
 
 
 _OK_BODY = {"request_id": 1, "prompt_ids": [1], "tokens": [2, 3],
-            "finish_reason": "length", "ttft_ms": 1.0}
+            "finish_reason": "length", "ttft_ms": 1.0,
+            "trace_id": "ab" * 16}
 
 
 class TestFailover:
@@ -868,6 +869,11 @@ def test_serve_bench_target_mode_reports_per_replica_breakdown(capsys):
         assert {"ok", "errors", "retries", "hedges",
                 "req_per_s"} <= set(entry)
     assert "hedges" in line and "no_replica" in line["errors"]
+    # p99 exemplars (satellite): slowest requests keyed by the
+    # replies' trace_id so a regression is stitch-lookupable
+    assert line["slow_exemplars"]
+    assert all(e["trace_id"] == "ab" * 16
+               for e in line["slow_exemplars"])
 
 
 # -- probing over live HTTP (ejection + re-admission end to end) --------

@@ -353,7 +353,7 @@ def test_default_deadline_from_config():
         params, cfg, _serving(default_deadline_s=0.5),
     )
     eng.submit(_prompts([4], cfg.vocab_size)[0], max_new_tokens=4)
-    _req, _p, t_submit, deadline = eng.scheduler.queue[0]
+    _req, _p, t_submit, deadline, _trace = eng.scheduler.queue[0]
     assert deadline == pytest.approx(t_submit + 0.5, abs=0.05)
 
 

@@ -59,6 +59,10 @@ sys.path.insert(0, os.path.join(
 
 from train_supervisor import backoff_s, classify_exit  # noqa: E402
 
+from differential_transformer_replication_tpu.obs.events import (  # noqa: E402
+    open_event_log,
+)
+
 SERVER_MODULE = "differential_transformer_replication_tpu.serving.server"
 
 
@@ -133,7 +137,8 @@ class Fleet:
                  backoff_max: float = 10.0,
                  ready_timeout_s: float = 120.0,
                  drain_exit_timeout_s: float = 60.0,
-                 fleet_log: Optional[str] = None):
+                 fleet_log: Optional[str] = None,
+                 replica_env: Optional[Dict[int, dict]] = None):
         if num_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {num_replicas}")
         self.host = host
@@ -143,6 +148,9 @@ class Fleet:
         self.ready_timeout_s = ready_timeout_s
         self.drain_exit_timeout_s = drain_exit_timeout_s
         self.fleet_log = fleet_log
+        # structured JSONL (obs/events.py): same shape as the router's
+        # and replicas' event logs, so fleet forensics join on ts
+        self._events = open_event_log(fleet_log, process="fleet")
         ports = list(ports) if ports else [
             pick_free_port(host) for _ in range(num_replicas)
         ]
@@ -151,12 +159,32 @@ class Fleet:
                 f"{num_replicas} replicas but {len(ports)} ports"
             )
         extra = list(server_args or [])
+
+        def _render(arg: str, i: int, port: int) -> str:
+            # per-replica templating: shared server_args naming a file
+            # path ("--trace-path", "--event-log") must not make N
+            # replicas clobber one file — "{replica}"/"{port}" expand
+            # per process
+            return (arg.replace("{replica}", str(i))
+                       .replace("{port}", str(port)))
+
+        def _env_for(i: int) -> Optional[dict]:
+            # per-replica env overrides (chaos tests arm DTX_FAULTS on
+            # ONE replica; the others must stay healthy)
+            base = dict(env) if env is not None else None
+            override = (replica_env or {}).get(i)
+            if override:
+                base = dict(os.environ) if base is None else base
+                base.update(override)
+            return base
+
         self.replicas = [
             ReplicaProc(
                 i, host, port,
                 [python, "-m", SERVER_MODULE,
-                 "--host", host, "--port", str(port)] + extra,
-                env=dict(env) if env is not None else None,
+                 "--host", host, "--port", str(port)]
+                + [_render(a, i, port) for a in extra],
+                env=_env_for(i),
             )
             for i, port in enumerate(ports)
         ]
@@ -173,12 +201,12 @@ class Fleet:
         return [r.url for r in self.replicas]
 
     def _log(self, record: dict) -> None:
-        record = {"time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
-                  **record}
-        print(f"[fleet] {json.dumps(record)}", file=sys.stderr)
-        if self.fleet_log:
-            with open(self.fleet_log, "a") as f:
-                f.write(json.dumps(record) + "\n")
+        printable = {"time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                     **record}
+        print(f"[fleet] {json.dumps(printable)}", file=sys.stderr)
+        record = dict(record)
+        self._events.emit(record.pop("event", "fleet_event"), **record)
+        self._events.flush()  # fleet events are rare; land them now
 
     # -- lifecycle -----------------------------------------------------
 
@@ -347,6 +375,9 @@ class Fleet:
                 r.proc.wait(10)
             self._log({"event": "stopped", "replica": r.index,
                        "rc": r.proc.returncode})
+        # SIGTERM path: the buffered event tail must land (the atexit
+        # net in obs/events.py is the last resort, not the plan)
+        self._events.close()
 
 
 def main() -> None:
@@ -366,7 +397,17 @@ def main() -> None:
     p.add_argument("--backoff-max", type=float, default=10.0)
     p.add_argument("--ready-timeout", type=float, default=120.0)
     p.add_argument("--fleet-log", default=None,
-                   help="append one JSON line per fleet event")
+                   help="append one JSON line per fleet event "
+                        "(obs/events.py shape)")
+    p.add_argument("--router-trace-path", default=None,
+                   help="write the IN-PROCESS router's span trace "
+                        "(pick/forward/retry/hedge; the clock "
+                        "reference tools/trace_stitch.py wants first) "
+                        "to this path")
+    p.add_argument("--router-event-log", default=None,
+                   help="append the router's structured JSONL events "
+                        "(request finished/failed/retried, replica "
+                        "ejection/re-admission) to this path")
     p.add_argument("--hedge-factor", type=float, default=0.0,
                    help="router hedging knob (0 = off); see "
                         "RouterConfig.hedge_factor")
@@ -401,9 +442,19 @@ def main() -> None:
         serve_router,
     )
 
+    router_tracer = None
+    if args.router_trace_path:
+        from differential_transformer_replication_tpu.obs.spans import (
+            SpanTracer,
+        )
+
+        router_tracer = SpanTracer(args.router_trace_path,
+                                   process_name="router")
     router = Router(
         fleet.urls,
         RouterConfig(hedge_factor=args.hedge_factor),
+        tracer=router_tracer,
+        events=open_event_log(args.router_event_log, process="router"),
     ).start()
     httpd = serve_router(router, args.host, args.router_port)
 
@@ -449,6 +500,9 @@ def main() -> None:
     finally:
         httpd.server_close()
         router.close()
+        if router_tracer is not None:
+            router_tracer.close()
+        router.events.close()
         fleet.stop()
 
 

@@ -69,6 +69,15 @@ def summarize(recs: dict) -> dict:
         "config_hashes": sorted(
             {h.get("config_hash") for h in recs["headers"]} - {None}
         ),
+        # build-info identity (mirrors the build_info gauge on
+        # /metrics): which runtimes produced this stream — aggregated
+        # streams with mixed versions are a red flag worth surfacing
+        "jax_versions": sorted(
+            {h.get("jax_version") for h in recs["headers"]} - {None}
+        ),
+        "roles": sorted(
+            {h.get("role", "trainer") for h in recs["headers"]}
+        ) if recs["headers"] else [],
         "step_records": len(steps),
         "eval_records": len(evals),
         "introspection_records": len(recs["intro"]),
@@ -142,7 +151,12 @@ def check(summary: dict, args) -> list:
 
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("metrics", help="path to a run's metrics.jsonl")
+    p.add_argument("metrics", nargs="?", default=None,
+                   help="path to a run's metrics.jsonl")
+    p.add_argument("--from-metrics-jsonl", default=None, dest="from_jsonl",
+                   help="same as the positional path — the flag shared "
+                        "with tools/slo_report.py so CI gates can point "
+                        "both tools at one stream with one spelling")
     p.add_argument("--check", action="store_true",
                    help="exit 1 when any health gate fails")
     p.add_argument("--require-loss-decrease", action="store_true",
@@ -158,7 +172,14 @@ def main() -> int:
                         "(0 = gate off; steady state is 1)")
     args = p.parse_args()
 
-    summary = summarize(load(args.metrics))
+    path = args.from_jsonl or args.metrics
+    if not path:
+        p.error("give a metrics.jsonl path (positional or "
+                "--from-metrics-jsonl)")
+    if args.from_jsonl and args.metrics:
+        p.error("give the path once, not both positionally and via "
+                "--from-metrics-jsonl")
+    summary = summarize(load(path))
     print(json.dumps(summary))
     if args.check:
         bad = check(summary, args)

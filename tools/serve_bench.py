@@ -43,6 +43,19 @@ Warmup posts the prefill-chunk ladder to every ``--target`` first so
 remote first-compiles stay out of the measured window (warming a
 router warms whichever replicas it picks; warm replicas directly for
 strict pins).
+
+Every bench run reports ``slow_exemplars``: the trace ids of the ~10
+slowest-TTFT requests (in-process requests mint their own trace
+contexts; --target replies carry the router/replica-minted id), so a
+latency regression is ONE command away from its fleet-wide timeline::
+
+    python tools/trace_stitch.py router.trace.json replica-*.trace.json \
+        -o slow.json --trace-id <slow_exemplars[0].trace_id>
+
+``--trace-dir`` makes the in-process engine write its span trace
+there; in --target mode it names where the fleet's own --trace-path
+files live and rides into the JSON line so the stitch command needs no
+guessing.
 """
 
 from __future__ import annotations
@@ -64,6 +77,21 @@ def _percentiles(xs, ps=(50, 95)):
     if not xs:
         return {f"p{p}": None for p in ps}
     return {f"p{p}": round(float(np.percentile(xs, p)), 3) for p in ps}
+
+
+def _slow_exemplars(completed, n=10):
+    """Trace ids of the ~p99 tail: the slowest-TTFT requests, so a
+    bench regression is directly looked up in the stitched timeline
+    (tools/trace_stitch.py --trace-id <id>). ``completed`` entries are
+    (tokens, ttft_ms, itls, trace_id); untraced requests are skipped."""
+    tail = sorted(
+        (e for e in completed if e[3]),
+        key=lambda e: e[1], reverse=True,
+    )[:n]
+    return [
+        {"trace_id": t, "ttft_ms": round(float(ms), 3)}
+        for _, ms, _, t in tail
+    ]
 
 
 def _run_against_targets(args, targets, post) -> None:
@@ -180,7 +208,8 @@ def _run_against_targets(args, targets, post) -> None:
                     entry["hedges"] += 1
                 if status == 200:
                     completed.append(
-                        (len(body["tokens"]), body["ttft_ms"], [])
+                        (len(body["tokens"]), body["ttft_ms"], [],
+                         body.get("trace_id"))
                     )
                     entry["ok"] += 1
                 else:
@@ -203,8 +232,8 @@ def _run_against_targets(args, targets, post) -> None:
         t.join()
     wall = time.perf_counter() - t0
 
-    out_tokens = sum(n for n, _, _ in completed)
-    ttfts_ms = [t for _, t, _ in completed]
+    out_tokens = sum(e[0] for e in completed)
+    ttfts_ms = [e[1] for e in completed]
     n_failed = sum(errors.values())
     for entry in per_replica.values():
         entry["req_per_s"] = round(entry["ok"] / wall, 3)
@@ -222,6 +251,8 @@ def _run_against_targets(args, targets, post) -> None:
         "failed": n_failed,
         "output_tokens": out_tokens,
         "wall_s": round(wall, 3),
+        "slow_exemplars": _slow_exemplars(completed),
+        "trace_dir": args.trace_dir,
         "per_replica": per_replica,
         "targets": targets,
         "clients": args.clients,
@@ -288,6 +319,14 @@ def main() -> None:
                         "0 = none")
     p.add_argument("--out", default=None,
                    help="also append the JSON line to this file")
+    p.add_argument("--trace-dir", default=None,
+                   help="directory for span traces: the in-process "
+                        "engine writes <dir>/serve_bench.engine."
+                        "trace.json; in --target mode this names where "
+                        "the fleet's own --trace-path files live, and "
+                        "rides into the JSON line so the slow_exemplars "
+                        "trace ids can be stitched (tools/"
+                        "trace_stitch.py) without guessing paths")
     args = p.parse_args()
 
     if args.smoke:
@@ -360,7 +399,18 @@ def main() -> None:
         # stays hard-capped at block_size)
         max_seq_len=model_cfg.block_size + args.new_tokens,
     )
-    engine = ServingEngine(params, model_cfg, serving)
+    tracer = None
+    if args.trace_dir:
+        from differential_transformer_replication_tpu.obs.spans import (
+            SpanTracer,
+        )
+
+        os.makedirs(args.trace_dir, exist_ok=True)
+        tracer = SpanTracer(
+            os.path.join(args.trace_dir, "serve_bench.engine.trace.json"),
+            process_name="serve-bench-engine",
+        )
+    engine = ServingEngine(params, model_cfg, serving, tracer=tracer)
     client = ServingClient(engine)
 
     httpd = None
@@ -398,8 +448,15 @@ def main() -> None:
         temperature=args.temperature, seed=0, timeout=600,
     )
 
-    # per-request record: (output_tokens, ttft_ms, itls_ms); failures
-    # land in `errors` by type instead of vanishing from the stats
+    from differential_transformer_replication_tpu.obs import trace as trace_mod
+
+    # one minted trace context per request (client-supplied ids, the
+    # contract the router/server honor) so slow_exemplars always carry
+    # a trace id, whichever mode ran
+    traces = [trace_mod.mint() for _ in prompts]
+
+    # per-request record: (output_tokens, ttft_ms, itls_ms, trace_id);
+    # failures land in `errors` by type instead of vanishing
     completed = []
     errors = {"queue_full": 0, "engine_crash": 0, "deadline": 0,
               "timeout": 0, "shutting_down": 0, "other": 0}
@@ -455,6 +512,7 @@ def main() -> None:
                             "temperature": args.temperature,
                             "seed": args.seed + i,
                             "timeout": 600,
+                            "traceparent": traces[i].to_traceparent(),
                         },
                         timeout=600, max_retries=args.max_retries,
                         rng=rng_w, deadline_s=args.deadline or None,
@@ -472,7 +530,8 @@ def main() -> None:
                         # the HTTP payload carries TTFT but not the
                         # per-token timestamps ITL needs
                         completed.append(
-                            (len(body["tokens"]), body["ttft_ms"], [])
+                            (len(body["tokens"]), body["ttft_ms"], [],
+                             body.get("trace_id"))
                         )
                     elif status == 503:
                         _record_http_503(body)
@@ -487,6 +546,7 @@ def main() -> None:
                             prompts[i], max_new_tokens=args.new_tokens,
                             temperature=args.temperature,
                             seed=args.seed + i, timeout=600,
+                            trace=traces[i],
                         ),
                         max_retries=args.max_retries,
                         retriable=(QueueFullError, EngineCrashError),
@@ -505,6 +565,7 @@ def main() -> None:
                     completed.append((
                         len(out.tokens), out.ttft * 1e3,
                         [itl * 1e3 for itl in out.itls],
+                        out.trace_id,
                     ))
 
     t0 = time.perf_counter()
@@ -522,9 +583,11 @@ def main() -> None:
         httpd.server_close()
     client.close()
 
-    out_tokens = sum(n for n, _, _ in completed)
-    ttfts_ms = [t for _, t, _ in completed]
-    itls_ms = [itl for _, _, itls in completed for itl in itls]
+    if tracer is not None:
+        tracer.close()
+    out_tokens = sum(e[0] for e in completed)
+    ttfts_ms = [e[1] for e in completed]
+    itls_ms = [itl for e in completed for itl in e[2]]
     n_failed = sum(errors.values())
     line = {
         "metric": "serving_output_tokens_per_sec",
@@ -539,6 +602,8 @@ def main() -> None:
         "failed": n_failed,
         "output_tokens": out_tokens,
         "wall_s": round(wall, 3),
+        "slow_exemplars": _slow_exemplars(completed),
+        "trace_dir": args.trace_dir,
         "model": model_cfg.model,
         "num_slots": serving.num_slots,
         "clients": args.clients,

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""SLO burn-rate report + CI gate over a metrics source (``--check``).
+
+The sibling of tools/metrics_report.py: where that tool judges a
+TRAINING run from its metrics.jsonl, this one judges a SERVING target
+(or the same training stream) against explicit objectives and prints
+ONE JSON summary line — availability and TTFT/ITL burn rates — so a
+bench script or CI job can gate on "are we inside the error budget"
+with an exit code::
+
+    # live endpoint (a replica's /metrics or the router's
+    # /fleet/metrics — the fleet-wide gate):
+    python tools/slo_report.py --url http://127.0.0.1:8000/fleet/metrics \
+        --check --ttft 0.5 --target 0.99
+    # a saved exposition snapshot (curl > metrics.txt):
+    python tools/slo_report.py metrics.txt --check
+    # the trainer's stream, same flag metrics_report.py takes:
+    python tools/slo_report.py --from-metrics-jsonl metrics.jsonl \
+        --check --step-time-ms 500
+
+Burn-rate semantics (obs/slo.py): ``error_ratio / (1 - target)``;
+1.0 = spending the budget exactly as provisioned, >1 = the objective
+is being missed. ``--check`` exits non-zero when any evaluated
+objective burns past ``--max-burn`` (default 1.0), listing each
+violation on stderr — the same contract as ``metrics_report.py
+--check`` and ``ckpt_doctor.py --check``.
+
+Inputs:
+
+- an exposition source (``--url`` or a file): latency objectives read
+  the ``serving_ttft_seconds`` / ``serving_itl_seconds`` histograms,
+  availability reads the completed/rejected/deadline counters — all
+  summed fleet-wide when pointed at ``/fleet/metrics``;
+- ``--from-metrics-jsonl``: the trainer's JSONL (shared input path
+  with metrics_report.py) — the latency objective applies to
+  ``step_time_ms`` against ``--step-time-ms``, availability to
+  anomaly-guard skips (a skipped step is a failed step).
+
+Objectives whose metric has no observations report null burn and do
+NOT fail the gate by themselves (no traffic is not an outage) unless
+``--require-traffic`` is set. Stdlib only, no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from differential_transformer_replication_tpu.obs.registry import (  # noqa: E402
+    parse_exposition,
+)
+from differential_transformer_replication_tpu.obs.slo import (  # noqa: E402
+    burn_rate,
+    histogram_from_samples,
+    latency_error_ratio,
+)
+
+
+def _counter_value(samples, name: str) -> float:
+    return sum(v for n, labels, v in samples if n == name)
+
+
+def report_from_exposition(text: str, args) -> dict:
+    """Objectives over a scraped/saved text exposition."""
+    _, samples = parse_exposition(text)
+    out = {}
+    for objective, hist_name, threshold in (
+        ("ttft", "serving_ttft_seconds", args.ttft),
+        ("itl", "serving_itl_seconds", args.itl),
+    ):
+        bounds, cumulative, count = histogram_from_samples(
+            samples, hist_name
+        )
+        err = latency_error_ratio(bounds, cumulative, count, threshold)
+        out[objective] = {
+            "threshold_s": threshold,
+            "target": args.target,
+            "count": count,
+            "error_ratio": err,
+            "burn_rate": burn_rate(err, args.target),
+        }
+    good = _counter_value(samples, "serving_requests_completed_total")
+    bad = (
+        _counter_value(samples, "serving_requests_rejected_total")
+        + _counter_value(
+            samples, "serving_requests_deadline_expired_total"
+        )
+    )
+    total = good + bad
+    err = None if total <= 0 else bad / total
+    out["availability"] = {
+        "target": args.availability_target,
+        "count": total,
+        "error_ratio": err,
+        "burn_rate": burn_rate(err, args.availability_target),
+    }
+    # pre-computed burn gauges (obs/slo.py via each server) ride along
+    # verbatim when present, so the report shows the servers' own view
+    # — keyed per replica on a fleet body (aggregate_fleet_metrics
+    # labels gauges `replica=`), so one hot replica cannot be hidden
+    # behind a healthy one that happens to render later
+    live = {}
+    for n, labels, v in samples:
+        if n != "slo_burn_rate":
+            continue
+        key = labels.get("objective", "unknown")
+        if labels.get("replica"):
+            key = f'{key}@{labels["replica"]}'
+        live[key] = v
+    if live:
+        out["server_reported_burn_rates"] = live
+    return out
+
+
+def report_from_jsonl(path: str, args) -> dict:
+    """Training-stream objectives (shared --from-metrics-jsonl input
+    with metrics_report.py): step-latency + anomaly availability."""
+    steps = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a killed run
+            if "loss" in rec and "val_loss" not in rec:
+                steps.append(rec)
+    step_ms = [r["step_time_ms"] for r in steps if "step_time_ms" in r]
+    out = {}
+    err = (
+        None if not step_ms
+        else sum(1 for v in step_ms if v > args.step_time_ms)
+        / len(step_ms)
+    )
+    out["step_time"] = {
+        "threshold_ms": args.step_time_ms,
+        "target": args.target,
+        "count": len(step_ms),
+        "error_ratio": err,
+        "burn_rate": burn_rate(err, args.target),
+    }
+    iters = len(steps)
+    skipped = max(
+        (r.get("skipped_steps", 0) for r in steps), default=0
+    )
+    err = None if iters == 0 else min(1.0, skipped / iters)
+    out["step_availability"] = {
+        "target": args.availability_target,
+        "count": iters,
+        "error_ratio": err,
+        "burn_rate": burn_rate(err, args.availability_target),
+    }
+    return out
+
+
+def check(objectives: dict, args) -> list:
+    """Gate violations; empty = inside every error budget."""
+    bad = []
+    for name, o in objectives.items():
+        if not isinstance(o, dict) or "burn_rate" not in o:
+            continue
+        burn = o["burn_rate"]
+        if burn is None:
+            if args.require_traffic:
+                bad.append(f"objective {name}: no observations")
+            continue
+        if burn > args.max_burn:
+            bad.append(
+                f"objective {name}: burn rate {round(burn, 3)} > "
+                f"{args.max_burn} (error ratio "
+                f"{round(o['error_ratio'], 5)} vs target {o['target']})"
+            )
+    return bad
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("exposition", nargs="?", default=None,
+                   help="path to a saved Prometheus text exposition")
+    p.add_argument("--url", default=None,
+                   help="scrape this /metrics or /fleet/metrics URL")
+    p.add_argument("--from-metrics-jsonl", default=None,
+                   help="judge a trainer metrics.jsonl instead (same "
+                        "input path as tools/metrics_report.py)")
+    p.add_argument("--ttft", type=float, default=1.0,
+                   help="TTFT objective bound in seconds")
+    p.add_argument("--itl", type=float, default=0.25,
+                   help="inter-token latency objective bound in seconds")
+    p.add_argument("--target", type=float, default=0.99,
+                   help="latency objectives' target fraction under "
+                        "the bound")
+    p.add_argument("--availability-target", type=float, default=0.999)
+    p.add_argument("--step-time-ms", type=float, default=1000.0,
+                   help="step-latency bound for --from-metrics-jsonl")
+    p.add_argument("--max-burn", type=float, default=1.0,
+                   help="gate: fail --check when any burn rate "
+                        "exceeds this")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when any objective burns past "
+                        "--max-burn")
+    p.add_argument("--require-traffic", action="store_true",
+                   help="gate: an objective with zero observations "
+                        "also fails --check")
+    args = p.parse_args()
+
+    sources = [
+        s for s in (args.exposition, args.url, args.from_metrics_jsonl)
+        if s
+    ]
+    if len(sources) != 1:
+        p.error("give exactly one of: an exposition file, --url, "
+                "--from-metrics-jsonl")
+    if args.from_metrics_jsonl:
+        objectives = report_from_jsonl(args.from_metrics_jsonl, args)
+        source = args.from_metrics_jsonl
+    else:
+        if args.url:
+            with urllib.request.urlopen(args.url, timeout=30) as r:
+                text = r.read().decode("utf-8", "replace")
+            source = args.url
+        else:
+            text = open(args.exposition, encoding="utf-8").read()
+            source = args.exposition
+        objectives = report_from_exposition(text, args)
+
+    violations = check(objectives, args) if args.check else []
+    summary = {
+        "metric": "slo_report",
+        "source": source,
+        "ok": not violations,
+        **objectives,
+    }
+    print(json.dumps(summary))
+    for v in violations:
+        print(f"CHECK FAILED: {v}", file=sys.stderr)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Stitch per-process Chrome trace files into one fleet timeline.
+
+"Why was this request slow?" needs ONE picture: the router's
+``pick``/``forward``/``retry``/``hedge`` spans and every replica's
+``request``/``admit``/``first_token``/``decode`` spans, on a shared
+clock, filterable by ``trace_id``. Each process writes its own trace
+file (``--trace-path`` on serving/server.py, serving/router.py,
+train.py); this tool merges them::
+
+    python tools/trace_stitch.py router.trace.json \
+        replica-*.trace.json -o stitched.trace.json
+    # one request only:
+    python tools/trace_stitch.py ... -o slow.trace.json \
+        --trace-id 4bf92f3577b34da6a3ce929d0e0e4736
+
+Open the output at https://ui.perfetto.dev — each input file becomes
+its own process lane (pids are reassigned per file, so two processes
+that happened to share an OS pid do not collide).
+
+**Clock alignment.** Trace timestamps anchor ``perf_counter`` to each
+process's wall clock once at tracer construction, so cross-process
+skew (NTP drift, clocks stepped between launches) shows up as replica
+spans sliding outside the router span that caused them. The stitcher
+re-aligns from the round-trips the traces already contain: a router
+``forward`` span (one HTTP round-trip) must ENCLOSE every replica
+span parented to it (matched by the propagated ``span_id`` →
+``parent_id`` link, obs/trace.py). Each non-reference file's offset is
+the median of the per-pair shifts that restore that enclosure —
+0 when the clocks already agree. ``--no-align`` keeps raw clocks.
+
+Also prints one JSON summary line (file count, event count, applied
+offsets, distinct trace ids) in the style of the other tools. Stdlib
+only; tolerant of truncated inputs (a crashed process's unterminated
+JSON array is repaired by dropping the torn tail line).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def load_trace(path: str) -> List[dict]:
+    """Load one Chrome trace JSON array; repair a missing terminator
+    (a process that died before close() leaves ``[`` + event lines)."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        events = json.loads(text)
+    except json.JSONDecodeError:
+        # drop the torn tail line and close the array
+        # events stream one per line, comma-separated ("," prefix on
+        # every line after the first) — strip both edges
+        lines = [
+            ln.strip().strip(",") for ln in text.splitlines()
+            if ln.strip() and ln.strip() not in ("[", "]")
+        ]
+        events = []
+        for ln in lines:
+            try:
+                events.append(json.loads(ln))
+            except json.JSONDecodeError:
+                continue
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a Chrome trace event array")
+    return [e for e in events if isinstance(e, dict)]
+
+
+def _spans_by_span_id(events: List[dict]) -> Dict[str, Tuple[float, float]]:
+    """span_id -> (ts, ts+dur) for complete events carrying trace args."""
+    out: Dict[str, Tuple[float, float]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        sid = args.get("span_id")
+        if sid:
+            ts = float(e.get("ts", 0.0))
+            out[sid] = (ts, ts + float(e.get("dur", 0.0)))
+    return out
+
+
+def estimate_offset_us(reference: List[dict],
+                       other: List[dict]) -> float:
+    """Median shift (microseconds, added to ``other``) that places each
+    of ``other``'s parented spans inside the reference span that caused
+    it. Pairs come from the propagated trace context: an event in
+    ``other`` whose ``parent_id`` names a ``span_id`` in ``reference``
+    was, by construction, caused DURING that reference span."""
+    ref_spans = _spans_by_span_id(reference)
+    shifts: List[float] = []
+    for e in other:
+        if e.get("ph") not in ("X", "i"):
+            continue
+        args = e.get("args") or {}
+        parent = args.get("parent_id")
+        if not parent or parent not in ref_spans:
+            continue
+        lo, hi = ref_spans[parent]
+        ts = float(e.get("ts", 0.0))
+        te = ts + float(e.get("dur", 0.0))
+        # feasible offsets keep [ts, te] inside [lo, hi]; pick the
+        # smallest-magnitude feasible shift (0 when already inside)
+        min_off = lo - ts
+        max_off = hi - te
+        if min_off > max_off:  # child longer than parent: center it
+            shifts.append(((lo + hi) - (ts + te)) / 2.0)
+        elif min_off > 0:
+            shifts.append(min_off)
+        elif max_off < 0:
+            shifts.append(max_off)
+        else:
+            shifts.append(0.0)
+    if not shifts:
+        return 0.0
+    shifts.sort()
+    return shifts[len(shifts) // 2]
+
+
+def _matches_trace(event: dict, trace_id: str) -> bool:
+    args = event.get("args") or {}
+    if args.get("trace_id") == trace_id:
+        return True
+    tids = args.get("trace_ids")
+    return isinstance(tids, list) and trace_id in tids
+
+
+def stitch(paths: List[str], align: bool = True,
+           trace_id: Optional[str] = None) -> Tuple[List[dict], dict]:
+    """Merge trace files; returns ``(events, summary)``. The first
+    path is the clock reference (pass the router's trace first)."""
+    traces = [load_trace(p) for p in paths]
+    offsets = [0.0] * len(traces)
+    if align and len(traces) > 1:
+        for i in range(1, len(traces)):
+            offsets[i] = estimate_offset_us(traces[0], traces[i])
+    merged: List[dict] = []
+    trace_ids = set()
+    for i, (path, events) in enumerate(zip(paths, traces)):
+        for e in events:
+            e = dict(e)
+            e["pid"] = i  # one lane per input file, collision-free
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name":
+                    name = (e.get("args") or {}).get("name", "process")
+                    e["args"] = {"name": f"{name} [{path}]"}
+                    merged.append(e)
+                elif e.get("name") == "process_sort_index":
+                    e["args"] = {"sort_index": i}
+                    merged.append(e)
+                continue
+            args = e.get("args") or {}
+            tid = args.get("trace_id")
+            if tid:
+                trace_ids.add(tid)
+            if trace_id is not None and not _matches_trace(e, trace_id):
+                continue
+            if "ts" in e:
+                e["ts"] = float(e["ts"]) + offsets[i]
+            merged.append(e)
+    merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    summary = {
+        "metric": "trace_stitch",
+        "files": len(paths),
+        "events": len(merged),
+        "span_events": sum(1 for e in merged if e.get("ph") != "M"),
+        "offsets_us": [round(o, 1) for o in offsets],
+        "distinct_trace_ids": len(trace_ids),
+        "filtered_trace_id": trace_id,
+    }
+    return merged, summary
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("traces", nargs="+",
+                   help="per-process .trace.json files; the FIRST is "
+                        "the clock reference (use the router's)")
+    p.add_argument("-o", "--out", required=True,
+                   help="stitched Chrome trace output path")
+    p.add_argument("--trace-id", default=None,
+                   help="keep only events belonging to this trace id "
+                        "(one request's fleet-wide timeline)")
+    p.add_argument("--no-align", action="store_true",
+                   help="skip round-trip clock-offset alignment")
+    args = p.parse_args()
+
+    merged, summary = stitch(args.traces, align=not args.no_align,
+                             trace_id=args.trace_id)
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(merged, fh, separators=(",", ":"))
+    summary["out"] = args.out
+    print(json.dumps(summary))
+    if args.trace_id is not None and summary["span_events"] == 0:
+        print(f"CHECK FAILED: trace id {args.trace_id} not found in "
+              f"{args.traces}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
