@@ -117,12 +117,26 @@ def main() -> None:
     calls = max(1, steps // spc)
     steps = calls * spc  # what actually runs (and what the stderr reports)
     window_secs = []
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(calls):
-            state, metrics = step(state, batch)
-        _ = float(metrics["loss"][-1])
-        window_secs.append(time.perf_counter() - t0)
+    # Zero-recompile sentinel (analysis/sanitizers.py): warmup compiled
+    # everything this loop runs, so ANY compilation inside the measured
+    # windows means the bench is silently timing retraces — fail loudly
+    # (RecompileBudgetError) instead of reporting degraded tok/s.
+    # BENCH_ALLOW_RECOMPILES=N loosens the pin for experiments (-1
+    # disables it, like serve_bench's --allow-recompiles); the sentinel
+    # adds no device ops, so the loss trajectory is unchanged.
+    from differential_transformer_replication_tpu.analysis.sanitizers import (
+        RecompileSentinel,
+    )
+
+    allow = int(os.environ.get("BENCH_ALLOW_RECOMPILES", "0"))
+    budget = None if allow < 0 else allow
+    with RecompileSentinel(budget=budget, name="bench-measured-window"):
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                state, metrics = step(state, batch)
+            _ = float(metrics["loss"][-1])
+            window_secs.append(time.perf_counter() - t0)
     dt = min(window_secs)
     dt_median = statistics.median(window_secs)
 

@@ -1,0 +1,62 @@
+"""Static analysis + runtime sanitizers for the JAX hot paths.
+
+- :mod:`.rules` / :mod:`.lint` — the ``graftlint`` AST engine: JAX
+  hazard rules (host syncs, impure calls, recompile triggers, missing
+  donation, serving lock discipline) over the package's source. Pure
+  stdlib; importing them never imports jax.
+- :mod:`.sanitizers` — dynamic counterparts: a recompile sentinel that
+  counts real XLA compilations against a budget and a host-sync
+  sentinel over ``jax.transfer_guard``. Imports jax, so it is exposed
+  lazily here (PEP 562) — ``graftlint`` stays runnable on boxes where
+  jax cannot initialize.
+
+CLI: ``python tools/graftlint.py <paths>`` or the ``graftlint``
+console script (analysis/cli.py). Catalog + suppression syntax:
+ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from differential_transformer_replication_tpu.analysis.lint import (
+    Finding,
+    LintResult,
+    lint_paths,
+)
+from differential_transformer_replication_tpu.analysis.rules import (
+    RULES,
+    RULES_BY_ID,
+    Rule,
+)
+
+if TYPE_CHECKING:  # pragma: no cover — static analyzers only
+    from differential_transformer_replication_tpu.analysis.sanitizers import (  # noqa: F401
+        HostSyncError,
+        HostSyncSentinel,
+        RecompileBudgetError,
+        RecompileSentinel,
+        compile_count,
+    )
+
+_LAZY = {
+    "RecompileSentinel", "RecompileBudgetError", "HostSyncSentinel",
+    "HostSyncError", "compile_count",
+}
+
+__all__ = [
+    "Finding", "LintResult", "lint_paths", "Rule", "RULES",
+    "RULES_BY_ID", *sorted(_LAZY),
+]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        from differential_transformer_replication_tpu.analysis import (
+            sanitizers,
+        )
+
+        return getattr(sanitizers, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -1,0 +1,124 @@
+"""The ``graftlint`` command line: lint paths, report, gate CI.
+
+Exit status: 0 when no ACTIVE (unsuppressed) findings, 1 otherwise,
+2 on usage errors. ``--json`` prints one machine-parseable JSON object
+(stable key order, findings sorted by path/line/rule) — what
+tests/test_lint_clean.py and any CI gate consume. Suppressed findings
+are reported either way so a suppression stays an auditable decision.
+
+Examples::
+
+    graftlint differential_transformer_replication_tpu/
+    graftlint --json pkg/ | python -m json.tool
+    graftlint --rules GL101,GL202 pkg/train/trainer.py
+    graftlint --list-rules
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from differential_transformer_replication_tpu.analysis.lint import (
+    _iter_py_files,
+    lint_paths,
+)
+from differential_transformer_replication_tpu.analysis.rules import (
+    RULES,
+    RULES_BY_ID,
+    resolve_rule_token,
+)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX hazard linter: host syncs, impure jit regions, "
+                    "recompile triggers, missing donation, serving lock "
+                    "discipline. Rule catalog: ANALYSIS.md.",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to lint")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-parseable JSON report on stdout")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids/names to run "
+                        "(default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print suppressed findings in text mode")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.id} {r.name}\n    {r.summary}\n    hint: {r.hint}")
+        return 0
+    if not args.paths:
+        p.print_usage(sys.stderr)
+        print("graftlint: error: no paths given", file=sys.stderr)
+        return 2
+
+    rules = (
+        [t for t in args.rules.split(",") if t.strip()]
+        if args.rules else None
+    )
+    if rules:
+        # a typoed rule id would otherwise lint NOTHING and exit 0 —
+        # a misconfigured CI gate must fail loudly, not pass forever
+        unknown = [
+            t for t in rules if resolve_rule_token(t) not in RULES_BY_ID
+        ]
+        if unknown:
+            print(
+                f"graftlint: error: unknown rule(s) {', '.join(unknown)} "
+                "(see --list-rules)", file=sys.stderr,
+            )
+            return 2
+
+    # like the unknown-rule guard: a typoed/renamed path would lint
+    # NOTHING and exit 0 — a gate that scans zero files must fail
+    # loudly, not pass forever
+    enumerated = []
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"graftlint: error: path does not exist: {path}",
+                  file=sys.stderr)
+            return 2
+        found = _iter_py_files([path])
+        if not found:
+            print(f"graftlint: error: no .py files under: {path}",
+                  file=sys.stderr)
+            return 2
+        enumerated.extend(found)
+    result = lint_paths(args.paths, rules=rules, files=enumerated)
+
+    if args.as_json:
+        print(json.dumps(result.as_dict(), sort_keys=False))
+    else:
+        shown = (
+            result.findings if args.show_suppressed else result.active
+        )
+        for f in shown:
+            print(f.render())
+        for rel in result.parse_errors:
+            print(f"{rel}: parse error — file skipped (every rule "
+                  "silently exempt)", file=sys.stderr)
+        n_sup = len(result.findings) - len(result.active)
+        print(
+            f"graftlint: {result.files_scanned} files, "
+            f"{result.jit_regions} jit-region functions, "
+            f"{len(result.active)} finding(s)"
+            + (f" (+{n_sup} suppressed)" if n_sup else "")
+            + (f", {len(result.parse_errors)} parse error(s)"
+               if result.parse_errors else ""),
+            file=sys.stderr,
+        )
+    return 1 if result.active or result.parse_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

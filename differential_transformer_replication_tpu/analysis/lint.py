@@ -1,0 +1,1326 @@
+"""graftlint's AST engine: jit-region discovery + rule dispatch.
+
+Pure stdlib (ast + tokenize) — linting the package must not import jax
+(or the package itself), so the CI gate runs in milliseconds on a
+CPU-only box and can lint code that would fail to import.
+
+How a file is analyzed:
+
+1. **Parse + suppressions.** Each module is parsed once; ``# graftlint:``
+   comment directives are collected per line (see analysis/rules.py for
+   the syntax).
+2. **Function graph.** Every ``def``/``lambda`` becomes a node with its
+   lexical scope chain (for name resolution) and outgoing calls. Import
+   statements build an alias map so ``from x import f; f()`` and
+   ``import x as m; m.f()`` resolve to cross-module edges.
+3. **Jit roots.** A function is a *tracing root* when it is decorated
+   with (or passed to) a JAX tracing transform — ``jit``/``pjit``/
+   ``pmap``/``vmap``/``grad``/``value_and_grad``/``checkpoint``/
+   ``shard_map``/``lax.scan``/``cond``/``while_loop``/``fori_loop``/
+   ``switch`` — including ``partial(jax.jit, ...)`` decorator forms.
+   The maker idiom is followed one level: ``jax.jit(make_step(cfg))``
+   marks the local functions ``make_step`` *returns* as roots.
+4. **Reachability.** BFS over resolved call edges from the roots; every
+   reached function is a *jit region* — its body is (part of) a traced
+   program, so the GL1xx rules apply to it.
+5. **Taint.** Within a jit region, values produced by ``jnp.*`` /
+   ``jax.lax.*`` / ``jax.random.*`` / ``jax.nn.*`` calls (and
+   anything derived from them through arithmetic, comparisons,
+   subscripts and non-static attributes) are *traced*; ``.shape`` /
+   ``.dtype`` / ``.ndim`` / ``len()`` strip taint (static under jit).
+   The function's own parameters are *weak* taint seeds — they are the
+   primary traced values of a jit region, so ``if x > 0`` / ``float(x)``
+   on a bare parameter fires — but an attribute read on a bare
+   parameter stays static, so static-config branches
+   (``if cfg.dropout > 0``) stay clean. Parameters named by a constant
+   ``static_argnums``/``static_argnames`` on the jit decorator or call
+   site are not seeded at all.
+
+The engine deliberately under-approximates (no interprocedural taint,
+no aliasing): a finding means "this exact expression does the hazardous
+thing here", which keeps the clean-tree gate (tests/test_lint_clean.py)
+meaningful — suppressions mark the few deliberate exceptions instead of
+papering over noise.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from differential_transformer_replication_tpu.analysis.rules import (
+    RULES_BY_ID,
+    resolve_rule_token,
+)
+
+# -- suppressions -------------------------------------------------------
+
+_DIRECTIVE_RE = re.compile(r"#\s*graftlint:\s*([^#]*)")
+
+# tracing transforms: a function passed to (or decorated by) one of
+# these is traced — its body becomes part of a compiled program
+_TRACING_TRANSFORMS = frozenset({
+    "jit", "pjit", "pmap", "vmap", "xmap", "grad", "value_and_grad",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp", "scan", "cond",
+    "while_loop", "fori_loop", "switch", "associative_scan",
+    "shard_map", "named_call", "eval_shape",
+})
+
+# dotted prefixes whose call results are traced arrays inside a jit
+# region (the taint seeds)
+_ARRAY_NAMESPACES = (
+    "jnp.", "jax.numpy.", "jax.lax.", "lax.", "jax.nn.", "jax.random.",
+    "jax.scipy.", "jax.tree_util.tree_map", "jax.vmap", "jax.ops.",
+)
+
+# attribute reads that yield static (trace-time-concrete) metadata
+_STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "sharding"})
+
+# impure dotted-name prefixes for GL103 (checked against the RESOLVED
+# dotted name, so `from jax import random` does not read as stdlib
+# random)
+_IMPURE_PREFIXES = (
+    "time.", "np.random.", "numpy.random.", "logging.", "os.environ",
+    "os.getenv", "sys.stdout", "sys.stderr",
+)
+_IMPURE_BARE = frozenset({"print", "open", "input"})
+
+_DONATE_NAME_RE = re.compile(r"(step|decode|prefill|update)", re.I)
+_DONATE_EXEMPT_RE = re.compile(r"eval", re.I)
+
+_STEP_CALL_RE = re.compile(r"(^|_)step$")
+
+_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                             "BoundedSemaphore"})
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+    hint: str
+    suppressed: bool = False
+
+    @property
+    def name(self) -> str:
+        r = RULES_BY_ID.get(self.rule)
+        return r.name if r else self.rule
+
+    def as_dict(self) -> dict:
+        return {
+            "path": self.path, "line": self.line, "rule": self.rule,
+            "name": self.name, "message": self.message, "hint": self.hint,
+            "suppressed": self.suppressed,
+        }
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}: {self.rule} [{self.name}]"
+                f"{tag}: {self.message}\n    hint: {self.hint}")
+
+
+class _Suppressions:
+    """Per-line and per-file rule suppression, parsed from comments."""
+
+    def __init__(self, source: str) -> None:
+        self.by_line: Dict[int, Set[str]] = {}
+        self.file_wide: Set[str] = set()
+        self.file_all = False
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _DIRECTIVE_RE.search(tok.string)
+                if not m:
+                    continue
+                self._apply(m.group(1).strip(), tok.start[0])
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # a torn file: lint what parsed, skip its comments
+
+    def _apply(self, body: str, line: int) -> None:
+        for clause in body.split(";"):
+            # a trailing parenthetical is the documented spot for the
+            # why: `# graftlint: disable=GL202 (log-boundary sync)`
+            clause = clause.split("(")[0].strip()
+            if not clause:
+                continue
+            if clause == "threadsafe" or clause.startswith("threadsafe "):
+                self.by_line.setdefault(line, set()).add("GL301")
+            elif clause.startswith("disable-file"):
+                rest = clause[len("disable-file"):].lstrip("=").strip()
+                if not rest:
+                    self.file_all = True
+                else:
+                    for t in rest.split(","):
+                        if t.strip():
+                            self.file_wide.add(resolve_rule_token(t))
+            elif clause.startswith("disable"):
+                rest = clause[len("disable"):].lstrip("=").strip()
+                ids = {resolve_rule_token(t) for t in rest.split(",") if t.strip()}
+                self.by_line.setdefault(line, set()).update(ids)
+
+    def covers(self, rule: str, lines: Sequence[int]) -> bool:
+        if self.file_all or rule in self.file_wide:
+            return True
+        return any(rule in self.by_line.get(ln, ()) for ln in lines)
+
+
+# -- per-module collection ----------------------------------------------
+
+
+@dataclass
+class _Func:
+    module: "_Mod"
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    parent: Optional["_Func"]
+    cls: Optional[str] = None  # enclosing class name, for self.* calls
+    local_defs: Dict[str, "_Func"] = field(default_factory=dict)
+    calls: List[Tuple[str, int]] = field(default_factory=list)
+    is_root: bool = False
+    returns_jitted_probe: bool = False
+    static_params: Set[str] = field(default_factory=set)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module.modname, self.qualname)
+
+
+@dataclass
+class _Mod:
+    path: str
+    relpath: str
+    modname: str
+    tree: ast.Module
+    source: str
+    suppressions: _Suppressions
+    imports: Dict[str, str] = field(default_factory=dict)  # alias -> dotted
+    top_defs: Dict[str, _Func] = field(default_factory=dict)
+    funcs: List[_Func] = field(default_factory=list)
+    classes: Dict[str, Dict[str, _Func]] = field(default_factory=dict)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _collect_imports(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    out[head] = head
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+class _FuncCollector(ast.NodeVisitor):
+    """Build the function/scope tree for one module."""
+
+    def __init__(self, mod: _Mod) -> None:
+        self.mod = mod
+        self.stack: List[_Func] = []
+        self.class_stack: List[str] = []
+
+    def _add(self, node, name: str) -> _Func:
+        parent = self.stack[-1] if self.stack else None
+        qual = f"{parent.qualname}.{name}" if parent else (
+            f"{self.class_stack[-1]}.{name}" if self.class_stack else name
+        )
+        fn = _Func(module=self.mod, qualname=qual, node=node, parent=parent,
+                   cls=self.class_stack[-1] if self.class_stack else None)
+        self.mod.funcs.append(fn)
+        if parent is not None:
+            parent.local_defs[name] = fn
+        elif self.class_stack:
+            self.mod.classes.setdefault(
+                self.class_stack[-1], {}
+            )[name] = fn
+        else:
+            self.mod.top_defs[name] = fn
+        return fn
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node, name: str) -> None:
+        fn = self._add(node, name)
+        self.stack.append(fn)
+        # only descend into the body; decorators belong to the enclosing
+        # scope (handled by the root-marking pass)
+        for child in node.body if not isinstance(node, ast.Lambda) else [node.body]:
+            self.visit(child)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node, node.name)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_func(node, f"<lambda:{node.lineno}>")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.stack:
+            name = _dotted(node.func)
+            if name:
+                self.stack[-1].calls.append((name, node.lineno))
+        self.generic_visit(node)
+
+
+def _load_module(path: str, relpath: str, modname: str) -> Optional[_Mod]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    mod = _Mod(path=path, relpath=relpath, modname=modname, tree=tree,
+               source=source, suppressions=_Suppressions(source))
+    mod.imports = _collect_imports(tree)
+    _FuncCollector(mod).visit(tree)
+    return mod
+
+
+# -- jit-root marking + reachability ------------------------------------
+
+
+def _is_tracing_transform(dotted: Optional[str]) -> bool:
+    if not dotted:
+        return False
+    last = dotted.split(".")[-1]
+    if last not in _TRACING_TRANSFORMS:
+        return False
+    head = dotted.split(".")[0]
+    # bare `jit`/`vmap` (from jax import jit) or jax./lax./jnp.-rooted;
+    # anything else (e.g. self.scan) is not JAX
+    return head in _TRACING_TRANSFORMS or head in (
+        "jax", "lax", "jnp", "pjit", "functools"
+    )
+
+
+def _positional_params(node: ast.AST) -> List[str]:
+    a = node.args
+    return [p.arg for p in (a.posonlyargs + a.args)]
+
+
+def _const_seq(node: ast.AST) -> List[object]:
+    """Constant, or tuple/list of constants, as a Python list; []
+    when any element is non-constant (a dynamic static_argnums spec
+    makes NOTHING static — errs toward seeding, i.e. reporting)."""
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        if all(isinstance(e, ast.Constant) for e in node.elts):
+            return [e.value for e in node.elts]
+    return []
+
+
+def _collect_static_params(fn: _Func, keywords: List[ast.keyword]) -> None:
+    """Record params a jit call marks static via constant
+    static_argnums/static_argnames — they are trace-time concrete, so
+    they must not seed taint."""
+    pos = _positional_params(fn.node)
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            for i in _const_seq(kw.value):
+                if isinstance(i, int) and 0 <= i < len(pos):
+                    fn.static_params.add(pos[i])
+        elif kw.arg == "static_argnames":
+            for s in _const_seq(kw.value):
+                if isinstance(s, str):
+                    fn.static_params.add(s)
+
+
+def _scope_lookup(fn: Optional[_Func], mod: _Mod, name: str) -> Optional[_Func]:
+    cur = fn
+    while cur is not None:
+        if name in cur.local_defs:
+            return cur.local_defs[name]
+        cur = cur.parent
+    return mod.top_defs.get(name)
+
+
+def _mark_roots(mods: Dict[str, _Mod]) -> None:
+    for mod in mods.values():
+        # decorators
+        for fn in mod.funcs:
+            node = fn.node
+            if isinstance(node, ast.Lambda):
+                continue
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                name = _dotted(d)
+                if _is_tracing_transform(name):
+                    fn.is_root = True
+                    if isinstance(dec, ast.Call):
+                        _collect_static_params(fn, dec.keywords)
+                elif isinstance(dec, ast.Call) and name and (
+                    name.split(".")[-1] == "partial"
+                ):
+                    # @partial(jax.jit, ...) — first positional arg is
+                    # the transform
+                    if dec.args and _is_tracing_transform(_dotted(dec.args[0])):
+                        fn.is_root = True
+                        _collect_static_params(fn, dec.keywords)
+
+        # call-site transforms: jax.jit(f), lax.scan(body, ...),
+        # partial(jax.jit, ...)(f) is rare enough to skip
+        class RootVisitor(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.stack: List[Optional[_Func]] = [None]
+
+            def visit_FunctionDef(self, node):
+                self._push(node)
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                self._push(node)
+
+            def _push(self, node):
+                owner = next(
+                    (f for f in mod.funcs if f.node is node), None
+                )
+                self.stack.append(owner)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            def visit_Call(self, node: ast.Call):
+                name = _dotted(node.func)
+                if _is_tracing_transform(name):
+                    scope = self.stack[-1]
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if isinstance(arg, ast.Lambda):
+                            target = next(
+                                (f for f in mod.funcs if f.node is arg),
+                                None,
+                            )
+                            if target is not None:
+                                target.is_root = True
+                        elif isinstance(arg, ast.Name):
+                            target = _scope_lookup(scope, mod, arg.id)
+                            if target is not None:
+                                target.is_root = True
+                                _collect_static_params(
+                                    target, node.keywords
+                                )
+                        elif isinstance(arg, ast.Call):
+                            # jax.jit(make_step(cfg)) — the MAKER's
+                            # returned local functions are the roots
+                            inner = _dotted(arg.func)
+                            if inner and "." not in inner:
+                                maker = _scope_lookup(scope, mod, inner)
+                                if maker is not None:
+                                    maker.returns_jitted_probe = True
+                self.generic_visit(node)
+
+        RootVisitor().visit(mod.tree)
+
+        # maker idiom: functions whose RESULT is jitted — their returned
+        # local defs become roots
+        for fn in mod.funcs:
+            if not fn.returns_jitted_probe or isinstance(fn.node, ast.Lambda):
+                continue
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Return) and isinstance(
+                    node.value, ast.Name
+                ):
+                    target = fn.local_defs.get(node.value.id)
+                    if target is not None:
+                        target.is_root = True
+
+
+def _find_module(mods: Dict[str, _Mod], name: str) -> Optional[_Mod]:
+    """Exact modname match, else a unique suffix match — import
+    statements name modules by their import path, which may be shorter
+    than the lint-root-relative modname (fixture dirs, relative
+    imports)."""
+    m = mods.get(name)
+    if m is not None:
+        return m
+    suffix = "." + name
+    cands = [mm for k, mm in mods.items() if k.endswith(suffix)]
+    return cands[0] if len(cands) == 1 else None
+
+
+def _resolve_dotted_func(
+    full: str, mods: Dict[str, _Mod], depth: int = 0
+) -> Optional[_Func]:
+    """``pkg.mod.f`` -> the _Func, following re-export chains (a name
+    imported by ``pkg/__init__.py`` from a submodule resolves through
+    that module's own import aliases)."""
+    if depth > 8:
+        return None
+    target_mod, _, func_name = full.rpartition(".")
+    target = _find_module(mods, target_mod) if target_mod else None
+    if target is None:
+        return None
+    fn = target.top_defs.get(func_name)
+    if fn is not None:
+        return fn
+    # re-export: `from .sub import f` in the target module
+    alias = target.imports.get(func_name)
+    if alias is not None and alias != full:
+        return _resolve_dotted_func(alias, mods, depth + 1)
+    return None
+
+
+def _resolve_call(
+    fn: _Func, name: str, mods: Dict[str, _Mod]
+) -> Optional[_Func]:
+    mod = fn.module
+    if "." not in name:
+        local = _scope_lookup(fn, mod, name)
+        if local is not None:
+            return local
+        # `from pkg.mod import f; f()` — the alias points at a
+        # cross-module function
+        alias = mod.imports.get(name)
+        if alias is not None:
+            return _resolve_dotted_func(alias, mods)
+        return None
+    head, _, rest = name.partition(".")
+    if head == "self" and fn.cls and "." not in rest:
+        return mod.classes.get(fn.cls, {}).get(rest)
+    dotted_head = mod.imports.get(head)
+    if dotted_head is None:
+        return None
+    full = f"{dotted_head}.{rest}" if rest else dotted_head
+    return _resolve_dotted_func(full, mods)
+
+
+def _reachable_jit_regions(mods: Dict[str, _Mod]) -> Set[Tuple[str, str]]:
+    # `from mod import f` aliases: imports map may point directly at a
+    # function (pkg.mod.f) — _resolve_call handles both layouts
+    work: List[_Func] = [
+        f for m in mods.values() for f in m.funcs if f.is_root
+    ]
+    seen: Set[Tuple[str, str]] = {f.key for f in work}
+    by_key = {
+        f.key: f for m in mods.values() for f in m.funcs
+    }
+    while work:
+        fn = work.pop()
+        for name, _line in fn.calls:
+            callee = _resolve_call(fn, name, mods)
+            if callee is not None and callee.key not in seen:
+                seen.add(callee.key)
+                work.append(callee)
+    return seen & set(by_key)
+
+
+# -- taint + jit-region rules -------------------------------------------
+
+
+def _call_dotted_resolved(mod: _Mod, name: str) -> str:
+    """Rewrite the leading alias of a dotted call through the import
+    map, so `np.x` in a module that did `import numpy as np` resolves
+    to `numpy.x` and `random.x` after `from jax import random` resolves
+    to `jax.random.x`."""
+    head, dot, rest = name.partition(".")
+    full_head = mod.imports.get(head)
+    if full_head is None:
+        return name
+    return f"{full_head}{dot}{rest}" if rest else full_head
+
+
+def _is_array_call(mod: _Mod, name: str) -> bool:
+    resolved = _call_dotted_resolved(mod, name)
+    for cand in (name, resolved):
+        for ns in _ARRAY_NAMESPACES:
+            if cand == ns.rstrip(".") or cand.startswith(ns):
+                return True
+        if cand.startswith("numpy.") and not cand.startswith("numpy.random"):
+            # numpy ops on traced values error; on host constants they
+            # are static — numpy calls do not SEED taint, but they also
+            # do not strip it (handled by expr taint propagation)
+            return False
+    return False
+
+
+class _Taint:
+    """One function's forward-pass taint state.
+
+    Two tiers: *strong* names (``names``) are known array values —
+    results of jnp/lax/random calls and anything assigned from a
+    tainted expression; *weak* names (``weak``) are the function's own
+    parameters. A weak name is traced when used bare (``if x > 0``,
+    ``float(x)``, ``x.sum()`` — the canonical jit-region hazards) but
+    an attribute read on it stays static, so config-object parameters
+    (``if cfg.dropout > 0``) do not poison the clean-tree gate."""
+
+    def __init__(self, mod: _Mod, weak: Set[str] = frozenset()) -> None:
+        self.mod = mod
+        self.names: Set[str] = set()
+        self.weak: Set[str] = set(weak)
+
+    def expr(self, node: ast.AST) -> bool:
+        """Does evaluating ``node`` yield a traced value?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.names or node.id in self.weak
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name and _is_array_call(self.mod, name):
+                return True
+            # method call on a traced value: x.sum(), x.astype(...)
+            if isinstance(node.func, ast.Attribute):
+                return self.expr(node.func.value)
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id in self.weak
+                and node.value.id not in self.names
+            ):
+                return False  # cfg.foo on a parameter: static config
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # identity tests never boolify a tracer — `x is None` /
+            # `cos is not None` are core JAX idioms on traced values
+            if all(isinstance(o, (ast.Is, ast.IsNot)) for o in node.ops):
+                return False
+            return self.expr(node.left) or any(
+                self.expr(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        return False
+
+    def assign(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.names.add(target.id)
+            else:
+                self.names.discard(target.id)
+                self.weak.discard(target.id)  # param rebound to host value
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e, tainted)
+        # attribute/subscript targets: no tracked state
+
+
+def _weak_param_seeds(fn: _Func) -> Set[str]:
+    """The function's parameter names, minus ``self``/``cls`` and any
+    param a constant static_argnums/static_argnames made trace-time
+    static — the weak taint seeds for its jit region.
+
+    Only tracing ROOTS get seeded: a root's params are by construction
+    the traced arguments of a compiled program (the canonical hazard is
+    `if loss > thresh` inside a @jax.jit step), while transitively
+    reached helpers routinely take host-static params (chunk sizes,
+    positions, flags) that would drown the gate in false positives."""
+    if not fn.is_root:
+        return set()
+    a = fn.node.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            names.append(extra.arg)
+    return {
+        n for n in names if n not in ("self", "cls")
+    } - fn.static_params
+
+
+class _JitRegionChecker(ast.NodeVisitor):
+    """GL101-GL107 over one jit-region function body (nested function
+    bodies are their own jit regions and are skipped here)."""
+
+    def __init__(self, fn: _Func, enabled: Set[str],
+                 emit) -> None:
+        self.fn = fn
+        self.mod = fn.module
+        self.enabled = enabled
+        self.emit = emit
+        self.taint = _Taint(fn.module, weak=_weak_param_seeds(fn))
+        self.raise_depth = 0
+        self._body_owner = fn.node
+
+    # -- scope boundaries ---------------------------------------------
+    def visit_FunctionDef(self, node):
+        if node is self._body_owner:
+            self.generic_visit(node)
+        # nested defs: separate jit regions, checked on their own
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if node is self._body_owner:
+            self.visit(node.body)
+
+    # -- taint bookkeeping --------------------------------------------
+    def visit_Assign(self, node: ast.Assign):
+        self.generic_visit(node)
+        t = self.taint.expr(node.value)
+        for target in node.targets:
+            self.taint.assign(target, t)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.generic_visit(node)
+        if self.taint.expr(node.value):
+            self.taint.assign(node.target, True)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        self.generic_visit(node)
+        if node.value is not None:
+            self.taint.assign(node.target, self.taint.expr(node.value))
+
+    # -- GL104: traced branch -----------------------------------------
+    def _check_branch(self, test: ast.AST, kind: str) -> None:
+        if "GL104" in self.enabled and self.taint.expr(test):
+            self.emit(
+                "GL104", test.lineno,
+                f"Python `{kind}` on a traced value in jit region "
+                f"`{self.fn.qualname}`",
+            )
+
+    def visit_If(self, node: ast.If):
+        self._check_branch(node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        self._check_branch(node.test, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert):
+        self._check_branch(node.test, "assert")
+        # the assert MESSAGE runs on static data (GL105 exemption)
+        self.raise_depth += 1
+        self.generic_visit(node)
+        self.raise_depth -= 1
+
+    def visit_Raise(self, node: ast.Raise):
+        self.raise_depth += 1
+        self.generic_visit(node)
+        self.raise_depth -= 1
+
+    # -- GL106: set iteration -----------------------------------------
+    def visit_For(self, node: ast.For):
+        if "GL106" in self.enabled and isinstance(
+            node.iter, (ast.Set, ast.SetComp)
+        ):
+            self.emit(
+                "GL106", node.iter.lineno,
+                f"iteration over a set in jit region "
+                f"`{self.fn.qualname}` — trace order is hash-dependent",
+            )
+        self.generic_visit(node)
+
+    def _check_comp(self, node):
+        if "GL106" in self.enabled:
+            for gen in node.generators:
+                if isinstance(gen.iter, (ast.Set, ast.SetComp)):
+                    self.emit(
+                        "GL106", gen.iter.lineno,
+                        f"comprehension over a set in jit region "
+                        f"`{self.fn.qualname}` — trace order is "
+                        "hash-dependent",
+                    )
+        self.generic_visit(node)
+
+    visit_ListComp = _check_comp
+    visit_SetComp = _check_comp
+    visit_DictComp = _check_comp
+    visit_GeneratorExp = _check_comp
+
+    # -- GL107: global/nonlocal ---------------------------------------
+    def visit_Global(self, node: ast.Global):
+        if "GL107" in self.enabled:
+            self.emit(
+                "GL107", node.lineno,
+                f"`global {', '.join(node.names)}` in jit region "
+                f"`{self.fn.qualname}`",
+            )
+
+    def visit_Nonlocal(self, node: ast.Nonlocal):
+        if "GL107" in self.enabled:
+            self.emit(
+                "GL107", node.lineno,
+                f"`nonlocal {', '.join(node.names)}` in jit region "
+                f"`{self.fn.qualname}`",
+            )
+
+    # -- GL105: f-strings ---------------------------------------------
+    def visit_JoinedStr(self, node: ast.JoinedStr):
+        if (
+            "GL105" in self.enabled
+            and self.raise_depth == 0
+            and any(
+                isinstance(v, ast.FormattedValue) for v in node.values
+            )
+        ):
+            self.emit(
+                "GL105", node.lineno,
+                f"f-string in jit region `{self.fn.qualname}` "
+                "(outside raise/assert)",
+            )
+        self.generic_visit(node)
+
+    # -- GL101/GL102/GL103: calls -------------------------------------
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        name = _dotted(node.func)
+
+        # attribute-form host syncs fire regardless of taint: these
+        # methods have no legitimate trace-time use on array-like values
+        if isinstance(node.func, ast.Attribute) and "GL101" in self.enabled:
+            if node.func.attr in ("item", "tolist", "block_until_ready"):
+                self.emit(
+                    "GL101", node.lineno,
+                    f".{node.func.attr}() in jit region "
+                    f"`{self.fn.qualname}`",
+                )
+                return
+
+        if not name:
+            return
+        resolved = _call_dotted_resolved(self.mod, name)
+
+        if "GL101" in self.enabled:
+            if resolved.endswith("jax.device_get") or name == "jax.device_get":
+                self.emit(
+                    "GL101", node.lineno,
+                    f"jax.device_get() in jit region `{self.fn.qualname}`",
+                )
+                return
+            if resolved.split(".")[0] in ("numpy",) and resolved.split(".")[-1] in (
+                "asarray", "array"
+            ):
+                if any(self.taint.expr(a) for a in node.args):
+                    self.emit(
+                        "GL101", node.lineno,
+                        f"{name}() on a traced value in jit region "
+                        f"`{self.fn.qualname}`",
+                    )
+                    return
+
+        if "GL102" in self.enabled and name in ("float", "int", "bool",
+                                                "complex"):
+            if node.args and self.taint.expr(node.args[0]):
+                self.emit(
+                    "GL102", node.lineno,
+                    f"{name}() on a traced value in jit region "
+                    f"`{self.fn.qualname}`",
+                )
+                return
+
+        if "GL105" in self.enabled and name == "str" and self.raise_depth == 0:
+            if node.args and self.taint.expr(node.args[0]):
+                self.emit(
+                    "GL105", node.lineno,
+                    f"str() of a traced value in jit region "
+                    f"`{self.fn.qualname}`",
+                )
+                return
+
+        if "GL103" in self.enabled:
+            if name in _IMPURE_BARE and name not in self.mod.top_defs:
+                self.emit(
+                    "GL103", node.lineno,
+                    f"impure call {name}() in jit region "
+                    f"`{self.fn.qualname}`",
+                )
+                return
+            for cand in {name, resolved}:
+                if any(cand.startswith(p) for p in _IMPURE_PREFIXES):
+                    self.emit(
+                        "GL103", node.lineno,
+                        f"impure call {name}() in jit region "
+                        f"`{self.fn.qualname}`",
+                    )
+                    return
+                # stdlib `random.` — only when `random` is not an alias
+                # for jax.random
+                if cand.startswith("random.") and not resolved.startswith(
+                    "jax.random"
+                ):
+                    self.emit(
+                        "GL103", node.lineno,
+                        f"host RNG call {name}() in jit region "
+                        f"`{self.fn.qualname}`",
+                    )
+                    return
+
+
+# -- GL201: donation on step-like jit entry points ----------------------
+
+
+class _DonateChecker(ast.NodeVisitor):
+    def __init__(self, mod: _Mod, enabled: Set[str], emit) -> None:
+        self.mod = mod
+        self.enabled = enabled
+        self.emit = emit
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        if "GL201" not in self.enabled:
+            return
+        name = _dotted(node.func)
+        if not name or name.split(".")[-1] not in ("jit", "pjit"):
+            return
+        if name.split(".")[0] not in ("jax", "jit", "pjit"):
+            return
+        if not node.args:
+            return
+        target = node.args[0]
+        tname = None
+        if isinstance(target, ast.Name):
+            tname = target.id
+        elif isinstance(target, ast.Call):
+            tname = _dotted(target.func)
+        elif isinstance(target, ast.Attribute):
+            tname = _dotted(target)
+        if not tname:
+            return  # lambdas etc.: nothing nameable to hold a policy on
+        short = tname.split(".")[-1]
+        if not _DONATE_NAME_RE.search(short) or _DONATE_EXEMPT_RE.search(short):
+            return
+        kws = {kw.arg for kw in node.keywords}
+        if not ({"donate_argnums", "donate_argnames"} & kws):
+            self.emit(
+                "GL201", node.lineno,
+                f"jax.jit({tname}, ...) — a step-like entry point "
+                "jitted without donate_argnums",
+            )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self.generic_visit(node)
+        if "GL201" not in self.enabled:
+            return
+        short = node.name
+        if not _DONATE_NAME_RE.search(short) or _DONATE_EXEMPT_RE.search(short):
+            return
+        for dec in node.decorator_list:
+            d = dec.func if isinstance(dec, ast.Call) else dec
+            dname = _dotted(d) or ""
+            if dname.split(".")[-1] in ("jit", "pjit") and dname.split(
+                "."
+            )[0] in ("jax", "jit", "pjit"):
+                has_donate = isinstance(dec, ast.Call) and any(
+                    kw.arg in ("donate_argnums", "donate_argnames")
+                    for kw in dec.keywords
+                )
+                if not has_donate:
+                    self.emit(
+                        "GL201", dec.lineno,
+                        f"@{dname} on step-like `{node.name}` without "
+                        "donate_argnums",
+                    )
+            elif isinstance(dec, ast.Call) and dname.split(".")[-1] == "partial":
+                if dec.args and (_dotted(dec.args[0]) or "").split(".")[-1] in (
+                    "jit", "pjit"
+                ):
+                    if not any(
+                        kw.arg in ("donate_argnums", "donate_argnames")
+                        for kw in dec.keywords
+                    ):
+                        self.emit(
+                            "GL201", dec.lineno,
+                            f"@partial(jax.jit, ...) on step-like "
+                            f"`{node.name}` without donate_argnums",
+                        )
+
+
+# -- GL202: host syncs inside step-dispatch loops -----------------------
+
+
+class _StepLoopChecker(ast.NodeVisitor):
+    """Flags blocking syncs in loops that drive a jitted step. Applies
+    to HOST functions only (jit regions get the stricter GL1xx)."""
+
+    def __init__(self, fn: _Func, enabled: Set[str], emit) -> None:
+        self.fn = fn
+        self.enabled = enabled
+        self.emit = emit
+        self.loop_depth = 0  # inside a step-dispatching loop?
+        self._body_owner = fn.node
+
+    def visit_FunctionDef(self, node):
+        if node is self._body_owner:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if node is self._body_owner:
+            self.visit(node.body)
+
+    @staticmethod
+    def _loop_dispatches_step(node) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _dotted(sub.func)
+                if name and _STEP_CALL_RE.search(name.split(".")[-1]):
+                    return True
+        return False
+
+    def _visit_loop(self, node) -> None:
+        dispatches = self._loop_dispatches_step(node)
+        if dispatches:
+            self.loop_depth += 1
+        self.generic_visit(node)
+        if dispatches:
+            self.loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        if "GL202" not in self.enabled or self.loop_depth == 0:
+            return
+        name = _dotted(node.func)
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self.emit(
+                "GL202", node.lineno,
+                f".item() inside the step loop of `{self.fn.qualname}`",
+            )
+            return
+        if not name:
+            return
+        if name in ("float", "int") and node.args and not isinstance(
+            node.args[0], ast.Constant
+        ):
+            self.emit(
+                "GL202", node.lineno,
+                f"{name}() host sync inside the step loop of "
+                f"`{self.fn.qualname}`",
+            )
+            return
+        resolved = _call_dotted_resolved(self.fn.module, name)
+        if name == "jax.device_get" or resolved == "jax.device_get":
+            self.emit(
+                "GL202", node.lineno,
+                f"jax.device_get() inside the step loop of "
+                f"`{self.fn.qualname}`",
+            )
+
+
+# -- GL301: serving lock discipline -------------------------------------
+
+
+class _LockDisciplineChecker:
+    """Per-class: find lock attributes created in __init__, then flag
+    attribute mutations outside `with self.<lock>` when the attribute
+    is shared across methods."""
+
+    def __init__(self, mod: _Mod, enabled: Set[str], emit) -> None:
+        self.mod = mod
+        self.enabled = enabled
+        self.emit = emit
+
+    def run(self) -> None:
+        if "GL301" not in self.enabled:
+            return
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(node)
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        locks: Set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            vname = _dotted(node.value.func) or ""
+            if vname.split(".")[-1] not in _LOCK_FACTORIES:
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    locks.add(t.attr)
+        return locks
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _check_class(self, cls: ast.ClassDef) -> None:
+        locks = self._lock_attrs(cls)
+        if not locks:
+            return
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        # which methods touch which self attributes (read or write)
+        touched_by: Dict[str, Set[str]] = {}
+        writes: List[Tuple[str, ast.AST, int, bool]] = []
+        for meth in methods:
+            guarded_lines = self._guarded_lines(meth, locks)
+            for node in ast.walk(meth):
+                attr = None
+                is_write = False
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        a = self._self_attr(t)
+                        if a:
+                            attr, is_write = a, True
+                            break
+                elif isinstance(node, ast.AugAssign):
+                    a = self._self_attr(node.target)
+                    if a:
+                        attr, is_write = a, True
+                elif isinstance(node, ast.Attribute):
+                    attr = self._self_attr(node)
+                if attr is None or attr in locks:
+                    continue
+                touched_by.setdefault(attr, set()).add(meth.name)
+                if is_write and meth.name != "__init__":
+                    writes.append((
+                        attr, node, node.lineno,
+                        node.lineno in guarded_lines,
+                    ))
+        for attr, _node, line, guarded in writes:
+            if guarded:
+                continue
+            if len(touched_by.get(attr, ())) < 2:
+                continue  # single-method private state: not shared
+            lock_names = " / ".join(
+                f"self.{name}" for name in sorted(locks)
+            )
+            self.emit(
+                "GL301", line,
+                f"`self.{attr}` mutated outside `with {lock_names}` in "
+                f"{cls.name} (attribute is shared across "
+                f"{len(touched_by[attr])} methods)",
+            )
+
+    def _guarded_lines(self, meth, locks: Set[str]) -> Set[int]:
+        """Line numbers lexically inside `with self.<lock>:` blocks."""
+        out: Set[int] = set()
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                a = self._self_attr(item.context_expr)
+                if a in locks:
+                    end = getattr(node, "end_lineno", node.lineno)
+                    out.update(range(node.lineno, end + 1))
+                    break
+        return out
+
+
+# -- driver -------------------------------------------------------------
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[Tuple[str, str, str]]:
+    """(abspath, display_relpath, modname) for every .py under paths."""
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            # keep ONE parent component so directory-scoped rules
+            # (GL301: serving/) apply identically when a file is
+            # spot-linted (`graftlint pkg/serving/server.py`) — and
+            # same-basename file args stay distinguishable
+            parent = os.path.basename(os.path.dirname(p))
+            rel = (
+                os.path.join(parent, os.path.basename(p))
+                if parent else os.path.basename(p)
+            )
+            out.append((p, rel, rel[:-3].replace(os.sep, ".")))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            for f in sorted(filenames):
+                if not f.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, f)
+                rel = os.path.relpath(full, os.path.dirname(p))
+                out.append((full, rel, _modname_for(os.path.dirname(p), full)))
+    return out
+
+
+def _modname_for(root: str, path: str) -> str:
+    rel = os.path.relpath(path, root)
+    mod = rel[:-3].replace(os.sep, ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    files_scanned: int
+    jit_regions: int
+    parse_errors: List[str] = field(default_factory=list)
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    def as_dict(self) -> dict:
+        return {
+            "graftlint": 1,
+            "files_scanned": self.files_scanned,
+            "jit_regions": self.jit_regions,
+            "parse_errors": list(self.parse_errors),
+            "rules": sorted(RULES_BY_ID),
+            "summary": {
+                "total": len(self.findings),
+                "active": len(self.active),
+                "suppressed": len(self.findings) - len(self.active),
+            },
+            "findings": [f.as_dict() for f in self.findings],
+        }
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+    *,
+    files: Optional[Sequence[Tuple[str, str, str]]] = None,
+) -> LintResult:
+    """Lint every .py file under ``paths``; returns all findings
+    (suppressed ones flagged, not dropped — the JSON output shows
+    them so a suppression is an auditable decision, not a deletion).
+
+    ``files`` (pre-enumerated ``_iter_py_files`` tuples) skips the
+    directory walk — the CLI already walked each path for its
+    empty-path guard and must not do the I/O twice."""
+    enabled: Set[str] = (
+        {resolve_rule_token(r) for r in rules}
+        if rules else set(RULES_BY_ID)
+    )
+    files = list(files) if files is not None else _iter_py_files(paths)
+    mods: Dict[str, _Mod] = {}
+    parse_errors: List[str] = []
+    for full, rel, modname in files:
+        m = _load_module(full, rel, modname)
+        if m is not None:
+            # same-basename spot-lint args must BOTH be scanned, not
+            # last-writer-wins (an order-dependent silent lint gap);
+            # disambiguated keys make cross-module resolution of the
+            # colliding name ambiguous, which _find_module treats as
+            # unresolvable — safe under-approximation
+            key, i = modname, 2
+            while key in mods:
+                key, i = f"{modname}#{i}", i + 1
+            m.modname = key
+            mods[key] = m
+        else:
+            # an unparseable file would otherwise be SILENTLY exempt
+            # from every rule — surface it (callers decide severity)
+            parse_errors.append(rel)
+
+    _mark_roots(mods)
+    regions = _reachable_jit_regions(mods)
+
+    findings: List[Finding] = []
+
+    def make_emit(mod: _Mod):
+        def emit(rule: str, line: int, message: str) -> None:
+            r = RULES_BY_ID[rule]
+            # a suppression may sit on the reported line or anywhere in
+            # the enclosing statement (multi-line calls)
+            lines = _statement_lines(mod, line)
+            findings.append(Finding(
+                path=mod.relpath, line=line, rule=rule,
+                message=message, hint=r.hint,
+                suppressed=mod.suppressions.covers(rule, lines),
+            ))
+        return emit
+
+    stmt_cache: Dict[str, List[Tuple[int, int]]] = {}
+
+    def _statement_lines(mod: _Mod, line: int) -> List[int]:
+        # keyed by ABSOLUTE path: two same-basename file args share a
+        # display relpath (serving/x.py) but must not share spans, or
+        # one file's suppression coverage silently applies the other's
+        # statement extents
+        spans = stmt_cache.get(mod.path)
+        if spans is None:
+            spans = []
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.stmt):
+                    spans.append(
+                        (node.lineno, getattr(node, "end_lineno", node.lineno))
+                    )
+            stmt_cache[mod.path] = spans
+        best: Optional[Tuple[int, int]] = None
+        for lo, hi in spans:
+            if lo <= line <= hi and (
+                best is None or (hi - lo) < (best[1] - best[0])
+            ):
+                best = (lo, hi)
+        if best is None:
+            return [line]
+        return list(range(best[0], best[1] + 1))
+
+    for mod in mods.values():
+        emit = make_emit(mod)
+        for fn in mod.funcs:
+            if fn.key in regions:
+                _JitRegionChecker(fn, enabled, emit).visit(fn.node)
+            else:
+                _StepLoopChecker(fn, enabled, emit).visit(fn.node)
+        _DonateChecker(mod, enabled, emit).visit(mod.tree)
+        # membership keyed on the lint-root-RELATIVE path (file args
+        # keep one parent component, so spot-linting serving/server.py
+        # still applies the rule) — never the absolute path, which
+        # would drag a whole checkout under /home/serving/... into the
+        # serving-only rules
+        if "serving" in mod.relpath.split(os.sep):
+            _LockDisciplineChecker(mod, enabled, emit).run()
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return LintResult(
+        findings=findings, files_scanned=len(mods),
+        jit_regions=len(regions), parse_errors=sorted(parse_errors),
+    )

@@ -1,0 +1,223 @@
+"""The graftlint rule catalog.
+
+Every rule is a named, documented invariant of this codebase's JAX hot
+paths — the things the ROADMAP asserts in prose ("zero recompiles as
+requests come and go", "one jitted full-pool decode step", "no exposed
+host syncs in the timed window") turned into machine-checked facts.
+The AST engine (analysis/lint.py) walks the package, classifies each
+function as inside or outside a *jit region* (reachable from a
+``jax.jit`` / ``pmap`` / ``vmap`` / ``lax.scan``-style tracing root
+through the call graph), and dispatches the checks below.
+
+Rule id families:
+
+- ``GL1xx`` — checks that apply INSIDE jit regions (the traced code a
+  compiled XLA program is built from).
+- ``GL2xx`` — checks on how jitted entry points are built and driven
+  from host code (donation, step-loop sync discipline).
+- ``GL3xx`` — thread-discipline checks for the serving layer (host
+  threads sharing one engine).
+
+Suppressions (analysis/lint.py parses them from comments):
+
+- ``# graftlint: disable=GL101`` — suppress listed rule ids (or rule
+  names) on this line / this statement.
+- ``# graftlint: threadsafe`` — alias for ``disable=GL301``; the
+  documented marker for attributes that are mutated cross-thread by
+  design (e.g. monotonic floats safely published via the GIL).
+- ``# graftlint: disable-file=GL105,GL106`` — suppress for the whole
+  file (``disable-file`` alone disables every rule).
+
+Adding a rule: append a :class:`Rule` here with a fresh id in the
+right family, implement its check in analysis/lint.py (grep for the
+rule id — each id has exactly one emit site), and add a positive +
+negative + suppressed fixture to tests/test_analysis/test_rules.py.
+ANALYSIS.md carries the human-readable catalog; keep it in sync.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    summary: str
+    hint: str
+
+
+RULES: Tuple[Rule, ...] = (
+    Rule(
+        id="GL101",
+        name="host-sync-in-jit",
+        summary=(
+            "Blocking device->host transfer inside a jit region: "
+            ".item() / .tolist() / .block_until_ready() / "
+            "jax.device_get() / np.asarray() on a traced value. Under "
+            "trace these either fail or silently bake a concrete value "
+            "into the compiled program; on the hot path they serialize "
+            "the device pipeline."
+        ),
+        hint=(
+            "Keep the value on device (jnp ops) and return it; sync "
+            "once at the host boundary, outside the jitted function."
+        ),
+    ),
+    Rule(
+        id="GL102",
+        name="host-cast-in-jit",
+        summary=(
+            "float()/int()/bool() applied to a traced value inside a "
+            "jit region — concretizes the tracer (TracerConversionError "
+            "at best, a silent trace-time constant at worst)."
+        ),
+        hint=(
+            "Use jnp.astype / lax.convert_element_type for dtype "
+            "changes, jnp.where / lax.cond for value-dependent logic."
+        ),
+    ),
+    Rule(
+        id="GL103",
+        name="impure-call-in-jit",
+        summary=(
+            "Impure call inside a jit region (time.*, random.*, "
+            "np.random.*, print/open/input, logging, os.environ). It "
+            "runs ONCE at trace time and its result is frozen into the "
+            "compiled program — wall clocks stop ticking, host RNG "
+            "stops advancing, logs fire once per compile, not per step."
+        ),
+        hint=(
+            "Thread randomness through jax.random keys; move clocks, "
+            "I/O and logging to the host loop around the jitted call "
+            "(or jax.debug.print / io_callback when it must be inside)."
+        ),
+    ),
+    Rule(
+        id="GL104",
+        name="traced-branch",
+        summary=(
+            "Python `if`/`while`/`assert` on a traced value inside a "
+            "jit region. Either it raises TracerBoolConversionError, "
+            "or — when the operand happens to be concrete at trace "
+            "time — it silently becomes a shape/value-specialized "
+            "recompile trigger."
+        ),
+        hint=(
+            "Use jnp.where for selects, lax.cond / lax.select for "
+            "branches, lax.while_loop for loops on traced values."
+        ),
+    ),
+    Rule(
+        id="GL105",
+        name="fstring-in-jit",
+        summary=(
+            "String formatting (f-string / str() of a runtime value) "
+            "inside a jit region, outside raise/assert. Formatting a "
+            "tracer concretizes it, and shape-dependent strings passed "
+            "as static args force one recompile per distinct string."
+        ),
+        hint=(
+            "Format on the host after the sync point; for in-trace "
+            "debugging use jax.debug.print. (Messages inside `raise` / "
+            "`assert` run at trace time on static data and are exempt.)"
+        ),
+    ),
+    Rule(
+        id="GL106",
+        name="set-iteration-in-jit",
+        summary=(
+            "Iteration over a set inside a jit region. Set order "
+            "depends on hashes (and for str keys on interpreter hash "
+            "randomization), so the traced op order — and any pytree "
+            "built from it — can differ between processes: collective "
+            "mismatches on pods, cache misses across restarts."
+        ),
+        hint=(
+            "Iterate a sorted() or a tuple/list with a fixed order; "
+            "pytrees keyed by dicts are fine (insertion order)."
+        ),
+    ),
+    Rule(
+        id="GL107",
+        name="global-state-in-jit",
+        summary=(
+            "`global`/`nonlocal` rebinding inside a jit region. The "
+            "write happens once at trace time, and rebinding a name to "
+            "a tracer leaks it out of the trace — a classic source of "
+            "UnexpectedTracerError far from the cause."
+        ),
+        hint=(
+            "Return the value from the jitted function and rebind on "
+            "the host; carry loop state through scan/while_loop "
+            "carries."
+        ),
+    ),
+    Rule(
+        id="GL201",
+        name="missing-donate",
+        summary=(
+            "A step/decode/prefill/update entry point is jitted "
+            "without donate_argnums/donate_argnames. State-in/state-"
+            "out calls that do not donate keep TWO copies of the "
+            "train state / KV pool live across the call — roughly "
+            "doubling peak HBM for the update."
+        ),
+        hint=(
+            "jax.jit(fn, donate_argnums=(0,)) (the state argument); "
+            "decorator form: @partial(jax.jit, donate_argnums=(0,)). "
+            "Suppress for genuinely non-consuming entry points."
+        ),
+    ),
+    Rule(
+        id="GL202",
+        name="sync-in-step-loop",
+        summary=(
+            "Blocking device->host sync (float()/int()/.item()/"
+            "jax.device_get) inside a loop that dispatches a jitted "
+            "step. Every sync stalls the host until the device "
+            "catches up, breaking async-dispatch pipelining — the "
+            "difference between overlapped and serialized step time."
+        ),
+        hint=(
+            "Batch the fetches (ONE jax.device_get of a tuple), "
+            "amortize over an interval (log/eval cadence), and mark "
+            "deliberate sync points with a suppression explaining the "
+            "cadence."
+        ),
+    ),
+    Rule(
+        id="GL301",
+        name="unguarded-shared-mutation",
+        summary=(
+            "serving/: an attribute of a lock-owning class is mutated "
+            "outside `with self.<lock>` while other methods also touch "
+            "it. The HTTP handler threads and the engine loop share "
+            "these objects; unguarded read-modify-writes tear, and "
+            "even plain stores can publish half-updated state to "
+            "/health readers."
+        ),
+        hint=(
+            "Mutate under the class's lock/condition, or — for "
+            "deliberately lock-free monotonic publishes — annotate the "
+            "line with `# graftlint: threadsafe` and say why."
+        ),
+    ),
+)
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES}
+RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULES}
+
+
+def resolve_rule_token(token: str) -> str:
+    """Map a suppression/CLI token (id or name, any case) to a rule id;
+    returns the token unchanged when unknown (unknown ids simply never
+    match a finding — a stale suppression must not crash the lint)."""
+    t = token.strip()
+    if t.upper() in RULES_BY_ID:
+        return t.upper()
+    if t.lower() in RULES_BY_NAME:
+        return RULES_BY_NAME[t.lower()].id
+    return t

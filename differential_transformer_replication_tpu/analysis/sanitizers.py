@@ -1,0 +1,228 @@
+"""Runtime sanitizers: the dynamic half of graftlint.
+
+The AST rules (analysis/lint.py) catch hazards the source spells out;
+these sentinels catch the ones only the runtime can see — an XLA
+recompilation triggered by a shape that slipped through, a blocking
+device->host transfer inside a timed window. Both are context managers
+designed to wrap exactly the region whose invariant the ROADMAP states:
+
+- :class:`RecompileSentinel` pins "this window compiles at most N
+  programs": the engine decode step compiles exactly once across any
+  mix of requests, ``dp_step`` compiles once across M steps, a bench's
+  measured window compiles zero. Counting rides jax.monitoring's
+  ``/jax/core/compile/backend_compile_duration`` event — one event per
+  real backend compilation, none on cache hits — so the sentinel sees
+  every compile in the process, whichever thread triggered it.
+- :class:`HostSyncSentinel` turns ``jax.transfer_guard_device_to_host``
+  into a scoped assertion: any blocking device->host transfer inside
+  the window raises (mode="disallow") or is logged by the runtime
+  (mode="log"). Explicit ``jax.device_get`` calls are intercepted at
+  the Python layer too, because some backends (CPU) service them
+  without tripping the C++ guard. Sanctioned syncs (the log-boundary
+  fetch) go through :meth:`HostSyncSentinel.allow`.
+
+Violations are reported through the obs/ registry when one is passed
+(``analysis_recompile_violations_total`` /
+``analysis_host_sync_violations_total`` counters and the
+``analysis_compiles_in_window`` gauge), so a fleet scrape shows
+sanitizer trips next to the latency histograms they explain.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+# one process-wide listener, installed on first use and never removed
+# (jax.monitoring has no single-listener deregistration; the counter is
+# a few adds per compile, nothing at steady state)
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_lock = threading.Lock()
+_compiles = 0
+_listener_installed = False
+
+
+def _on_event(event: str, duration: float, **_kw) -> None:
+    global _compiles
+    if event == _COMPILE_EVENT:
+        with _lock:
+            _compiles += 1
+
+
+def _ensure_listener() -> None:
+    global _listener_installed
+    with _lock:
+        if _listener_installed:
+            return
+        # register BEFORE publishing the flag (and under the lock): a
+        # flag set early would let a concurrent sentinel window open
+        # against a listener that is not live yet and silently count
+        # zero — the exact failure this tool exists to catch. A
+        # registration error leaves the flag unset so the next caller
+        # retries instead of counting nothing forever. (_on_event
+        # cannot deadlock here: registration never fires events.)
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        _listener_installed = True
+
+
+def compile_count() -> int:
+    """Process-wide backend compilations observed since the listener
+    was installed. Deltas between two reads bound a window's compiles;
+    :class:`RecompileSentinel` packages exactly that."""
+    _ensure_listener()
+    with _lock:
+        return _compiles
+
+
+class RecompileBudgetError(AssertionError):
+    """A sentinel window compiled more XLA programs than its budget.
+    An AssertionError on purpose: benches and tests treat it as a hard
+    failure, never a warning to scroll past."""
+
+
+class RecompileSentinel:
+    """``with RecompileSentinel(budget=0, name="decode"):`` — assert at
+    exit that the window triggered at most ``budget`` backend
+    compilations. ``budget=None`` disables the assertion (count-only
+    mode; read :attr:`count`). The check is skipped when the body
+    raised — the original error is always the more useful one."""
+
+    def __init__(self, budget: Optional[int] = 0, name: str = "window",
+                 registry=None) -> None:
+        self.budget = budget
+        self.name = name
+        self.count = 0
+        self._registry = registry
+        self._start = 0
+
+    def __enter__(self) -> "RecompileSentinel":
+        _ensure_listener()
+        self._start = compile_count()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.count = compile_count() - self._start
+        if self._registry is not None:
+            self._registry.gauge(
+                "analysis_compiles_in_window",
+                "XLA compilations counted inside the most recent "
+                "RecompileSentinel window.", labelnames=("window",),
+            ).set(self.count, window=self.name)
+        if exc_type is not None or self.budget is None:
+            return
+        if self.count > self.budget:
+            if self._registry is not None:
+                self._registry.counter(
+                    "analysis_recompile_violations_total",
+                    "RecompileSentinel windows that exceeded their "
+                    "compile budget.", labelnames=("window",),
+                ).inc(window=self.name)
+            raise RecompileBudgetError(
+                f"recompile sentinel '{self.name}': {self.count} XLA "
+                f"compilation(s) inside a window budgeted for "
+                f"{self.budget}. Something in this window retraces — "
+                "check for shape-varying inputs, python-value statics, "
+                "or a cold cache (warm up before entering the sentinel)."
+            )
+
+
+class HostSyncError(RuntimeError):
+    """A blocking device->host transfer happened inside a
+    HostSyncSentinel window that disallows them."""
+
+
+class HostSyncSentinel:
+    """Scoped no-host-sync assertion over the timed window.
+
+    ``mode="disallow"`` (default) makes any blocking device->host
+    transfer raise; ``mode="log"`` lets the runtime report without
+    failing. The C++ transfer guard does not see every path on every
+    backend (CPU services ``jax.device_get`` / ``np.asarray`` from
+    host-shared buffers), so the sentinel ALSO patches
+    ``jax.device_get`` for the window — between the two, ``.item()``,
+    implicit ``bool()``, ``np.asarray`` and explicit ``device_get``
+    are all caught on TPU, and the explicit paths everywhere.
+
+    Sanctioned syncs nest an :meth:`allow` window::
+
+        with HostSyncSentinel(registry=reg) as guard:
+            run_steps()
+            with guard.allow():     # the deliberate log-boundary fetch
+                loss = float(jax.device_get(metrics["loss"]))
+
+    Patching is process-global for the window's duration — wrap
+    single-driver regions (a bench's measured loop, one engine step),
+    not code concurrent with other jax drivers.
+    """
+
+    def __init__(self, mode: str = "disallow", registry=None,
+                 name: str = "window") -> None:
+        if mode not in ("disallow", "log"):
+            raise ValueError(f"mode must be disallow|log, got {mode!r}")
+        self.mode = mode
+        self.name = name
+        self.violations = 0
+        self._registry = registry
+        self._guard_ctx = None
+        self._orig_device_get = None
+        self._allow_depth = 0
+
+    # -- plumbing ------------------------------------------------------
+
+    def _record(self) -> None:
+        self.violations += 1
+        if self._registry is not None:
+            self._registry.counter(
+                "analysis_host_sync_violations_total",
+                "Blocking device->host transfers flagged inside "
+                "HostSyncSentinel windows.", labelnames=("window",),
+            ).inc(window=self.name)
+
+    def __enter__(self) -> "HostSyncSentinel":
+        self._guard_ctx = jax.transfer_guard_device_to_host(self.mode)
+        self._guard_ctx.__enter__()
+        self._orig_device_get = jax.device_get
+        sentinel = self
+
+        def guarded_device_get(x):
+            if sentinel._allow_depth == 0:
+                sentinel._record()
+                if sentinel.mode == "disallow":
+                    raise HostSyncError(
+                        f"host-sync sentinel '{sentinel.name}': "
+                        "jax.device_get() inside a no-sync window. "
+                        "Move the fetch outside the timed region or "
+                        "wrap it in sentinel.allow()."
+                    )
+            return sentinel._orig_device_get(x)
+
+        jax.device_get = guarded_device_get
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        jax.device_get = self._orig_device_get
+        self._guard_ctx.__exit__(exc_type, exc, tb)
+        if exc_type is not None and issubclass(exc_type, Exception):
+            # the C++ guard raises its own error type; count it so the
+            # registry sees guard trips, not just device_get ones
+            if "transfer" in str(exc).lower() and exc_type is not HostSyncError:
+                self._record()
+
+    def allow(self):
+        """Context manager sanctioning syncs inside the window."""
+        sentinel = self
+
+        class _Allow:
+            def __enter__(self_inner):
+                sentinel._allow_depth += 1
+                self_inner._ctx = jax.transfer_guard_device_to_host("allow")
+                self_inner._ctx.__enter__()
+                return self_inner
+
+            def __exit__(self_inner, *exc):
+                self_inner._ctx.__exit__(*exc)
+                sentinel._allow_depth -= 1
+
+        return _Allow()

@@ -474,7 +474,9 @@ class Router:
             t.start()
         for t in initial:
             t.join(self.cfg.probe_timeout_s * 2 + 1.0)
-        self._probe_thread = threading.Thread(
+        # start()/close() are owner-lifecycle calls (single-threaded by
+        # contract); _probe_thread is never touched from request paths
+        self._probe_thread = threading.Thread(  # graftlint: threadsafe
             target=self._probe_loop, name="router-prober", daemon=True
         )
         self._probe_thread.start()
@@ -484,7 +486,7 @@ class Router:
         self._stop.set()
         if self._probe_thread is not None:
             self._probe_thread.join(5.0)
-            self._probe_thread = None
+            self._probe_thread = None  # graftlint: threadsafe (lifecycle)
         # land buffered telemetry; closing is the creator's call (the
         # CLI closes in its finally, atexit is the safety net)
         self.tracer.flush()

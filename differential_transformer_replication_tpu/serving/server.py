@@ -370,7 +370,10 @@ class EngineRunner:
         take = getattr(self.engine, "take_finished", None)
         if take is not None:
             self._deliver(take(), waiters)
-        self.restarts += 1
+        with self._cond:
+            # /health reads restarts from HTTP handler threads; publish
+            # the bump under the runner lock like every other state bit
+            self.restarts += 1
         rebuild = getattr(self.engine, "reset_after_crash", None)
         fatal = rebuild is None or self.restarts > self.max_restarts
         lost: List[int] = []
@@ -493,23 +496,32 @@ class EngineRunner:
                     self._settle(pending, error=e)
             try:
                 t0 = time.perf_counter()
-                self._step_started = t0
+                # the watchdog state is read by status() from HTTP
+                # handler threads — publish every transition under the
+                # runner lock (the engine step itself runs unlocked)
+                with self._cond:
+                    self._step_started = t0
                 outs = self.engine.step()
                 dt = time.perf_counter() - t0
-                self._step_started = None
-                self.last_step_s = dt
-                if self._step_budget > 0:
-                    if dt > self._step_budget and not self._degraded:
-                        self._degraded = True
-                        print(
-                            f"[serving] watchdog: engine iteration took "
-                            f"{dt:.3f}s (budget {self._step_budget}s) — "
-                            "marking degraded", file=sys.stderr,
-                        )
-                    elif dt <= self._step_budget and self._degraded:
-                        self._degraded = False
+                announce_degraded = False
+                with self._cond:
+                    self._step_started = None
+                    self.last_step_s = dt
+                    if self._step_budget > 0:
+                        if dt > self._step_budget and not self._degraded:
+                            self._degraded = True
+                            announce_degraded = True
+                        elif dt <= self._step_budget and self._degraded:
+                            self._degraded = False
+                if announce_degraded:
+                    print(
+                        f"[serving] watchdog: engine iteration took "
+                        f"{dt:.3f}s (budget {self._step_budget}s) — "
+                        "marking degraded", file=sys.stderr,
+                    )
             except Exception as e:
-                self._step_started = None
+                with self._cond:
+                    self._step_started = None
                 if not self._handle_engine_crash(e, waiters):
                     return
                 continue

@@ -1,0 +1,895 @@
+"""graftlint rule-engine tests: per-rule positive + negative +
+suppressed fixtures, jit-region discovery (decorators, call sites,
+maker idiom, cross-module reachability through re-exports), and the
+CLI's machine-parseable ``--json`` contract.
+
+Every rule in analysis/rules.py has a POSITIVE fixture here proving it
+fires — the acceptance contract: a rule that cannot fire is dead
+weight, and a rule that fires on clean idioms would poison the
+clean-tree gate (tests/test_lint_clean.py)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from differential_transformer_replication_tpu.analysis import (
+    RULES,
+    RULES_BY_ID,
+    lint_paths,
+)
+
+REPO = Path(__file__).resolve().parents[2]
+GRAFTLINT = REPO / "tools" / "graftlint.py"
+
+
+def lint_src(tmp_path, src, filename="mod.py", rules=None):
+    """Write one fixture module and lint the directory; returns the
+    list of ACTIVE finding rule ids (sorted, duplicates kept)."""
+    path = tmp_path / filename
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(src)
+    result = lint_paths([str(tmp_path)], rules=rules)
+    return result
+
+
+def active_ids(result):
+    return sorted(f.rule for f in result.active)
+
+
+def all_ids(result):
+    return sorted(f.rule for f in result.findings)
+
+
+JIT_HEADER = "import jax\nimport jax.numpy as jnp\n"
+
+
+class TestRuleCatalog:
+    def test_at_least_eight_distinct_rules(self):
+        assert len(RULES) >= 8
+        assert len({r.id for r in RULES}) == len(RULES)
+
+    def test_every_rule_documented(self):
+        for r in RULES:
+            assert r.summary and r.hint, f"{r.id} missing docs"
+
+
+class TestGL101HostSync:
+    def test_positive_item(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    v = jnp.sum(x)\n"
+            "    return v.item()\n"
+        ))
+        assert "GL101" in active_ids(res)
+
+    def test_positive_device_get(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return jax.device_get(x)\n"
+        ))
+        assert "GL101" in active_ids(res)
+
+    def test_positive_np_asarray_on_traced(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    s = jnp.sum(x)\n"
+            "    return np.asarray(s)\n"
+        ))
+        assert "GL101" in active_ids(res)
+
+    def test_negative_outside_jit(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def host(x):\n"
+            "    return x.item()\n"
+        ))
+        assert "GL101" not in active_ids(res)
+
+    def test_negative_np_asarray_on_host_value(self, tmp_path):
+        # np.asarray of an untraced (host) value in a jit region is a
+        # trace-time constant, not a sync
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x, lens):\n"
+            "    table = np.asarray([1, 2, 3])\n"
+            "    return x + table\n"
+        ))
+        assert "GL101" not in active_ids(res)
+
+    def test_suppressed(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    v = jnp.sum(x)\n"
+            "    return v.item()  # graftlint: disable=GL101\n"
+        ))
+        assert "GL101" not in active_ids(res)
+        assert "GL101" in all_ids(res)  # reported, flagged suppressed
+
+
+class TestGL102HostCast:
+    def test_positive(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    s = jnp.sum(x)\n"
+            "    return float(s)\n"
+        ))
+        assert "GL102" in active_ids(res)
+
+    def test_negative_static_cast(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x, cfg_scale):\n"
+            "    n = float(x.shape[0])\n"  # shapes are static
+            "    return x * n\n"
+        ))
+        assert "GL102" not in active_ids(res)
+
+
+class TestGL103ImpureCall:
+    def test_positive_time(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "import time\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * time.time()\n"
+        ))
+        assert "GL103" in active_ids(res)
+
+    def test_positive_np_random(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x + np.random.rand()\n"
+        ))
+        assert "GL103" in active_ids(res)
+
+    def test_positive_print(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    print(x)\n"
+            "    return x\n"
+        ))
+        assert "GL103" in active_ids(res)
+
+    def test_negative_jax_random(self, tmp_path):
+        # `from jax import random; random.normal(...)` is pure — the
+        # alias must resolve to jax.random, not stdlib random
+        res = lint_src(tmp_path, (
+            "import jax\nfrom jax import random\n"
+            "@jax.jit\n"
+            "def f(key, x):\n"
+            "    return x + random.normal(key, x.shape)\n"
+        ))
+        assert "GL103" not in active_ids(res)
+
+    def test_negative_host_print(self, tmp_path):
+        res = lint_src(tmp_path, (
+            "def host():\n"
+            "    print('hello')\n"
+        ))
+        assert "GL103" not in active_ids(res)
+
+
+class TestGL104TracedBranch:
+    def test_positive_if(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    s = jnp.sum(x)\n"
+            "    if s > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+        ))
+        assert "GL104" in active_ids(res)
+
+    def test_positive_while(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    s = jnp.max(x)\n"
+            "    while s > 0:\n"
+            "        s = s - 1\n"
+            "    return s\n"
+        ))
+        assert "GL104" in active_ids(res)
+
+    def test_negative_static_config_branch(self, tmp_path):
+        # branching on config/static values is the normal jit idiom
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x, n_micro=1):\n"
+            "    if x.shape[0] == 1:\n"
+            "        return x\n"
+            "    return x * 2\n"
+        ))
+        assert "GL104" not in active_ids(res)
+
+    def test_taint_propagates_through_arithmetic(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    s = jnp.sum(x)\n"
+            "    t = s * 2 + 1\n"
+            "    if t > 3:\n"
+            "        return x\n"
+            "    return -x\n"
+        ))
+        assert "GL104" in active_ids(res)
+
+    def test_shape_access_strips_taint(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    h = jnp.reshape(x, (-1,))\n"
+            "    if h.shape[0] > 4:\n"
+            "        return h\n"
+            "    return -h\n"
+        ))
+        assert "GL104" not in active_ids(res)
+
+    def test_positive_branch_on_bare_parameter(self, tmp_path):
+        # a jit root's params ARE the traced values — the canonical
+        # hazard form must fire without any jnp call seeding taint
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def step_fn(x, y):\n"
+            "    if x > 0:\n"
+            "        return float(x)\n"
+            "    return y\n"
+        ))
+        assert "GL104" in active_ids(res)
+        assert "GL102" in active_ids(res)
+
+    def test_positive_scan_body_param_while(self, tmp_path):
+        # call-site roots (lax.scan body) get param seeding too
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def body(carry, t):\n"
+            "    s = carry + t\n"
+            "    while s > 0:\n"
+            "        s = s - 1\n"
+            "    return s, s\n"
+            "out = jax.lax.scan(body, 0, None)\n"
+        ))
+        assert "GL104" in active_ids(res)
+
+    def test_negative_attr_read_on_parameter(self, tmp_path):
+        # config objects arrive as params; attribute reads on a bare
+        # param stay static (if cfg.dropout > 0 is the normal idiom)
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x, cfg):\n"
+            "    if cfg.dropout > 0:\n"
+            "        return x * cfg.scale\n"
+            "    return x\n"
+        ))
+        assert "GL104" not in active_ids(res)
+
+    def test_negative_is_none_on_parameter(self, tmp_path):
+        # identity tests never boolify a tracer
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x, mask):\n"
+            "    if mask is not None:\n"
+            "        x = x + mask\n"
+            "    s = jnp.sum(x)\n"
+            "    if s is None:\n"
+            "        return x\n"
+            "    return s\n"
+        ))
+        assert "GL104" not in active_ids(res)
+
+    def test_negative_static_argnums_param(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnums=(1,))\n"
+            "def f(x, n):\n"
+            "    if n > 4:\n"
+            "        return x * n\n"
+            "    return x\n"
+        ))
+        assert "GL104" not in active_ids(res)
+
+    def test_negative_static_argnames_call_site(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def f(x, n):\n"
+            "    if n > 4:\n"
+            "        return x * n\n"
+            "    return x\n"
+            "g = jax.jit(f, static_argnames=('n',))\n"
+        ))
+        assert "GL104" not in active_ids(res)
+
+    def test_negative_helper_params_not_seeded(self, tmp_path):
+        # transitively-reached helpers take host-static params (chunk
+        # sizes, positions); only ROOT params are seeded
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def helper(x, chunk):\n"
+            "    if chunk > 4:\n"
+            "        return x[:chunk]\n"
+            "    return x\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return helper(x, 8)\n"
+        ))
+        assert "GL104" not in active_ids(res)
+
+    def test_param_rebound_to_host_value_drops_seed(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x, w):\n"
+            "    w = 4\n"
+            "    if w > 2:\n"
+            "        return x * w\n"
+            "    return x\n"
+        ))
+        assert "GL104" not in active_ids(res)
+
+
+class TestGL105FString:
+    def test_positive(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    s = jnp.max(x)\n"
+            "    label = f'max={s}'\n"
+            "    return x\n"
+        ))
+        assert "GL105" in active_ids(res)
+
+    def test_negative_in_raise(self, tmp_path):
+        # error messages at trace time run on static data — exempt
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x.shape[0] == 0:\n"
+            "        raise ValueError(f'empty input {x.shape}')\n"
+            "    return x\n"
+        ))
+        assert "GL105" not in active_ids(res)
+
+    def test_negative_in_assert(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x, k):\n"
+            "    assert x.shape[0] == k, f'bad shape {x.shape}'\n"
+            "    return x\n"
+        ))
+        assert "GL105" not in active_ids(res)
+
+
+class TestGL106SetIteration:
+    def test_positive(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(params):\n"
+            "    total = 0.0\n"
+            "    for k in {'wq', 'wk', 'wv'}:\n"
+            "        total = total + jnp.sum(params[k])\n"
+            "    return total\n"
+        ))
+        assert "GL106" in active_ids(res)
+
+    def test_positive_comprehension(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(params):\n"
+            "    vals = [params[k] for k in {'a', 'b'}]\n"
+            "    return vals[0]\n"
+        ))
+        assert "GL106" in active_ids(res)
+
+    def test_negative_sorted_iteration(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(params):\n"
+            "    total = 0.0\n"
+            "    for k in ('wq', 'wk', 'wv'):\n"
+            "        total = total + jnp.sum(params[k])\n"
+            "    return total\n"
+        ))
+        assert "GL106" not in active_ids(res)
+
+
+class TestGL107GlobalState:
+    def test_positive(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "_cache = None\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    global _cache\n"
+            "    _cache = x\n"
+            "    return x\n"
+        ))
+        assert "GL107" in active_ids(res)
+
+    def test_negative_host_global(self, tmp_path):
+        res = lint_src(tmp_path, (
+            "_cache = None\n"
+            "def host(x):\n"
+            "    global _cache\n"
+            "    _cache = x\n"
+        ))
+        assert "GL107" not in active_ids(res)
+
+
+class TestGL201MissingDonate:
+    def test_positive_call_form(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def train_step(state, batch):\n"
+            "    return state\n"
+            "jitted = jax.jit(train_step)\n"
+        ))
+        assert "GL201" in active_ids(res)
+
+    def test_positive_decorator_form(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def decode_step(pool, tokens):\n"
+            "    return pool\n"
+        ))
+        assert "GL201" in active_ids(res)
+
+    def test_negative_with_donate(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "from functools import partial\n"
+            "def train_step(state, batch):\n"
+            "    return state\n"
+            "jitted = jax.jit(train_step, donate_argnums=(0,))\n"
+            "@partial(jax.jit, donate_argnums=(0,))\n"
+            "def update_step(state):\n"
+            "    return state\n"
+        ))
+        assert "GL201" not in active_ids(res)
+
+    def test_negative_eval_exempt(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def eval_step(params, x):\n"
+            "    return params\n"
+        ))
+        assert "GL201" not in active_ids(res)
+
+    def test_negative_maker_call_with_donate(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def make_step_fn(cfg):\n"
+            "    def step(state, batch):\n"
+            "        return state\n"
+            "    return step\n"
+            "jitted = jax.jit(make_step_fn(None), donate_argnums=(0,))\n"
+        ))
+        assert "GL201" not in active_ids(res)
+
+
+class TestGL202SyncInStepLoop:
+    def test_positive(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def train(step, state, batch):\n"
+            "    for i in range(100):\n"
+            "        state, metrics = step(state, batch)\n"
+            "        loss = float(metrics['loss'])\n"
+            "    return loss\n"
+        ))
+        assert "GL202" in active_ids(res)
+
+    def test_positive_device_get(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def train(train_step, state, batch):\n"
+            "    while True:\n"
+            "        state, metrics = train_step(state, batch)\n"
+            "        m = jax.device_get(metrics)\n"
+        ))
+        assert "GL202" in active_ids(res)
+
+    def test_negative_outside_loop(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def train(step, state, batch):\n"
+            "    for i in range(100):\n"
+            "        state, metrics = step(state, batch)\n"
+            "    return float(metrics['loss'])\n"
+        ))
+        assert "GL202" not in active_ids(res)
+
+    def test_negative_loop_without_step(self, tmp_path):
+        res = lint_src(tmp_path, (
+            "def tally(xs):\n"
+            "    total = 0.0\n"
+            "    for x in xs:\n"
+            "        total += float(x)\n"
+            "    return total\n"
+        ))
+        assert "GL202" not in active_ids(res)
+
+    def test_suppressed_with_trailing_why(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def train(step, state, batch):\n"
+            "    for i in range(100):\n"
+            "        state, metrics = step(state, batch)\n"
+            "        if i % 50 == 0:\n"
+            "            loss = float(metrics['loss'])  "
+            "# graftlint: disable=GL202 (log-boundary sync)\n"
+        ))
+        assert "GL202" not in active_ids(res)
+        assert "GL202" in all_ids(res)
+
+
+class TestGL301LockDiscipline:
+    POS = (
+        "import threading\n"
+        "class Runner:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "    def bump(self):\n"
+        "        self.count += 1\n"
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return self.count\n"
+    )
+
+    def test_positive_in_serving_dir(self, tmp_path):
+        res = lint_src(tmp_path, self.POS, filename="serving/runner.py")
+        assert "GL301" in active_ids(res)
+
+    def test_negative_outside_serving(self, tmp_path):
+        res = lint_src(tmp_path, self.POS, filename="train/runner.py")
+        assert "GL301" not in active_ids(res)
+
+    def test_positive_direct_file_invocation(self, tmp_path):
+        # spot-linting ONE serving file must apply the same rules as
+        # linting the directory (file args keep one parent component)
+        path = tmp_path / "serving" / "runner.py"
+        path.parent.mkdir(parents=True)
+        path.write_text(self.POS)
+        res = lint_paths([str(path)])
+        assert "GL301" in active_ids(res)
+
+    def test_negative_checkout_under_serving_parent(self, tmp_path):
+        # a repo cloned at /somewhere/serving/repo must NOT have the
+        # serving-only rule applied to its whole tree — membership is
+        # lint-root-relative, never absolute
+        root = tmp_path / "serving" / "repo"
+        (root / "train").mkdir(parents=True)
+        (root / "train" / "runner.py").write_text(self.POS)
+        res = lint_paths([str(root)])
+        assert "GL301" not in active_ids(res)
+
+    def test_negative_guarded_write(self, tmp_path):
+        res = lint_src(tmp_path, (
+            "import threading\n"
+            "class Runner:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.count = 0\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self.count += 1\n"
+            "    def read(self):\n"
+            "        with self._lock:\n"
+            "            return self.count\n"
+        ), filename="serving/runner.py")
+        assert "GL301" not in active_ids(res)
+
+    def test_negative_lockless_class_exempt(self, tmp_path):
+        # classes that own no lock are single-threaded by design here
+        res = lint_src(tmp_path, (
+            "class Plain:\n"
+            "    def __init__(self):\n"
+            "        self.count = 0\n"
+            "    def bump(self):\n"
+            "        self.count += 1\n"
+        ), filename="serving/plain.py")
+        assert "GL301" not in active_ids(res)
+
+    def test_threadsafe_alias_suppression(self, tmp_path):
+        res = lint_src(tmp_path, (
+            "import threading\n"
+            "class Runner:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.count = 0\n"
+            "    def bump(self):\n"
+            "        self.count += 1  # graftlint: threadsafe (GIL pub)\n"
+            "    def read(self):\n"
+            "        with self._lock:\n"
+            "            return self.count\n"
+        ), filename="serving/runner.py")
+        assert "GL301" not in active_ids(res)
+        assert "GL301" in all_ids(res)
+
+
+class TestJitRegionDiscovery:
+    def test_call_site_transform_marks_root(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def body(x):\n"
+            "    return x.item()\n"
+            "jitted = jax.jit(body)\n"
+        ))
+        assert "GL101" in active_ids(res)
+
+    def test_lax_scan_body_is_jit_region(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "from jax import lax\n"
+            "def outer(xs):\n"
+            "    def body(carry, x):\n"
+            "        return carry, x.item()\n"
+            "    return lax.scan(body, 0.0, xs)\n"
+        ))
+        assert "GL101" in active_ids(res)
+
+    def test_maker_idiom_marks_returned_fn(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def make_step(cfg):\n"
+            "    def step(state, batch):\n"
+            "        s = jnp.sum(batch)\n"
+            "        return state, float(s)\n"
+            "    return step\n"
+            "jitted = jax.jit(make_step(None), donate_argnums=(0,))\n"
+        ))
+        assert "GL102" in active_ids(res)
+
+    def test_callee_reached_through_call_graph(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def helper(x):\n"
+            "    return x.item()\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return helper(x)\n"
+        ))
+        assert "GL101" in active_ids(res)
+
+    def test_cross_module_reachability(self, tmp_path):
+        (tmp_path / "impl.py").write_text(
+            "def deep_helper(x):\n"
+            "    return x.item()\n"
+        )
+        res = lint_src(tmp_path, (
+            "import jax\n"
+            "from impl import deep_helper\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return deep_helper(x)\n"
+        ), filename="main.py")
+        assert "GL101" in active_ids(res)
+        # the finding lands in the CALLEE's file
+        f = next(x for x in res.active if x.rule == "GL101")
+        assert f.path.endswith("impl.py")
+
+    def test_unreached_helper_is_host_code(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def helper(x):\n"
+            "    return x.item()\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * 2\n"
+        ))
+        assert "GL101" not in active_ids(res)
+
+
+class TestSuppressionSyntax:
+    def test_disable_file(self, tmp_path):
+        res = lint_src(tmp_path, (
+            "# graftlint: disable-file=GL101\n"
+        ) + JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.item()\n"
+        ))
+        assert "GL101" not in active_ids(res)
+        assert "GL101" in all_ids(res)
+
+    def test_disable_file_all(self, tmp_path):
+        res = lint_src(tmp_path, (
+            "# graftlint: disable-file\n"
+        ) + JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    print(x)\n"
+            "    return x.item()\n"
+        ))
+        assert not active_ids(res)
+
+    def test_rule_name_token(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.item()  # graftlint: disable=host-sync-in-jit\n"
+        ))
+        assert "GL101" not in active_ids(res)
+
+    def test_unknown_rule_token_is_inert(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.item()  # graftlint: disable=GL999\n"
+        ))
+        assert "GL101" in active_ids(res)
+
+    def test_multiline_statement_suppressed_from_first_line(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    v = jax.device_get(  # graftlint: disable=GL101\n"
+            "        x\n"
+            "    )\n"
+            "    return v\n"
+        ))
+        assert "GL101" not in active_ids(res)
+
+
+class TestRuleFilter:
+    def test_rules_option_limits_scope(self, tmp_path):
+        src = JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    print(x)\n"
+            "    return x.item()\n"
+        )
+        res = lint_src(tmp_path, src, rules=["GL103"])
+        assert "GL103" in active_ids(res)
+        assert "GL101" not in active_ids(res)
+
+
+class TestSameBasenameArgs:
+    def test_both_colliding_files_are_linted(self, tmp_path):
+        # `graftlint a/util.py b/util.py` must scan BOTH (the old
+        # last-writer-wins keying made the exit code order-dependent)
+        bad = JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.item()\n"
+        )
+        clean = "def ok():\n    return 1\n"
+        (tmp_path / "a").mkdir()
+        (tmp_path / "b").mkdir()
+        (tmp_path / "a" / "util.py").write_text(bad)
+        (tmp_path / "b" / "util.py").write_text(clean)
+        for order in (
+            [tmp_path / "a" / "util.py", tmp_path / "b" / "util.py"],
+            [tmp_path / "b" / "util.py", tmp_path / "a" / "util.py"],
+        ):
+            res = lint_paths([str(p) for p in order])
+            assert res.files_scanned == 2
+            assert "GL101" in active_ids(res), order
+
+    def test_colliding_files_keep_their_own_suppression_spans(self, tmp_path):
+        # both args display as serving/x.py; the statement-span cache
+        # must stay per-FILE or one file's multi-line suppression is
+        # checked against the other's statement extents
+        plain = JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.item()\n"
+        )
+        suppressed = JIT_HEADER + (
+            "@jax.jit\n"
+            "def g(y):\n"
+            "    v = (\n"
+            "        y.item()\n"
+            "    )  # graftlint: disable=GL101 (fixture)\n"
+            "    return v\n"
+        )
+        (tmp_path / "a" / "serving").mkdir(parents=True)
+        (tmp_path / "b" / "serving").mkdir(parents=True)
+        (tmp_path / "a" / "serving" / "x.py").write_text(plain)
+        (tmp_path / "b" / "serving" / "x.py").write_text(suppressed)
+        res = lint_paths([
+            str(tmp_path / "a" / "serving" / "x.py"),
+            str(tmp_path / "b" / "serving" / "x.py"),
+        ])
+        gl101 = [f for f in res.findings if f.rule == "GL101"]
+        assert [f.suppressed for f in gl101] == [False, True]
+
+
+class TestParseErrors:
+    def test_unparseable_file_is_reported(self, tmp_path):
+        res = lint_src(tmp_path, "def broken(:\n")
+        assert res.parse_errors, "torn file must be surfaced, not skipped"
+
+
+class TestCLI:
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, str(GRAFTLINT), *argv],
+            capture_output=True, text=True, cwd=str(REPO),
+        )
+
+    def test_json_output_is_stable_and_parseable(self, tmp_path):
+        (tmp_path / "m.py").write_text(JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.item()\n"
+        ))
+        r1 = self._run("--json", str(tmp_path))
+        r2 = self._run("--json", str(tmp_path))
+        assert r1.returncode == 1  # active finding -> gate fails
+        assert r1.stdout == r2.stdout, "JSON output must be deterministic"
+        doc = json.loads(r1.stdout)
+        assert doc["graftlint"] == 1
+        assert doc["summary"]["active"] == 1
+        assert doc["rules"] == sorted(RULES_BY_ID)
+        (f,) = [x for x in doc["findings"] if not x["suppressed"]]
+        assert set(f) == {
+            "path", "line", "rule", "name", "message", "hint", "suppressed"
+        }
+        assert f["rule"] == "GL101"
+        assert f["line"] == 5
+
+    def test_clean_tree_exits_zero(self, tmp_path):
+        (tmp_path / "m.py").write_text("def ok():\n    return 1\n")
+        r = self._run("--json", str(tmp_path))
+        assert r.returncode == 0
+        doc = json.loads(r.stdout)
+        assert doc["summary"]["active"] == 0
+
+    def test_findings_sorted(self, tmp_path):
+        (tmp_path / "b.py").write_text(JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    print(x)\n"
+            "    return x.item()\n"
+        ))
+        (tmp_path / "a.py").write_text(JIT_HEADER + (
+            "@jax.jit\n"
+            "def g(x):\n"
+            "    return x.item()\n"
+        ))
+        doc = json.loads(self._run("--json", str(tmp_path)).stdout)
+        keys = [(f["path"], f["line"], f["rule"]) for f in doc["findings"]]
+        assert keys == sorted(keys)
+
+    def test_list_rules(self):
+        r = self._run("--list-rules")
+        assert r.returncode == 0
+        for rule in RULES:
+            assert rule.id in r.stdout
+
+    def test_no_paths_is_usage_error(self):
+        assert self._run().returncode == 2
+
+    def test_unknown_rule_is_usage_error(self, tmp_path):
+        # a typoed --rules must not lint nothing and exit 0 (a
+        # misconfigured CI gate would pass forever)
+        (tmp_path / "m.py").write_text("x = 1\n")
+        r = self._run("--rules", "GL999", str(tmp_path))
+        assert r.returncode == 2
+        assert "unknown rule" in r.stderr
+
+    def test_nonexistent_path_is_usage_error(self, tmp_path):
+        # same contract as unknown rules: a typoed/renamed path must
+        # not scan zero files and exit 0
+        r = self._run(str(tmp_path / "renamed_away"))
+        assert r.returncode == 2
+        assert "does not exist" in r.stderr
+
+    def test_path_with_no_py_files_is_usage_error(self, tmp_path):
+        (tmp_path / "README.txt").write_text("no python here\n")
+        r = self._run(str(tmp_path))
+        assert r.returncode == 2
+        assert "no .py files" in r.stderr
+
+    def test_non_py_file_arg_is_usage_error(self, tmp_path):
+        target = tmp_path / "notes.txt"
+        target.write_text("x = 1\n")
+        r = self._run(str(target))
+        assert r.returncode == 2
+        assert "no .py files" in r.stderr
+
+    def test_parse_error_fails_gate(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def broken(:\n")
+        r = self._run("--json", str(tmp_path))
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert len(doc["parse_errors"]) == 1
+        assert doc["parse_errors"][0].endswith("broken.py")

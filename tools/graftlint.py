@@ -1,0 +1,25 @@
+"""graftlint — JAX hazard linter for this repo (thin CLI wrapper).
+
+The real engine lives in differential_transformer_replication_tpu/
+analysis/ (rules.py = catalog, lint.py = AST engine, cli.py = this
+interface); this wrapper exists so the documented invocation works
+from a fresh checkout with no install step::
+
+    python tools/graftlint.py differential_transformer_replication_tpu/
+    python tools/graftlint.py --json ... | python -m json.tool
+
+Installed form (pyproject ``[project.scripts]``): ``graftlint <paths>``.
+Pure stdlib — never imports jax, so it runs anywhere in milliseconds.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from differential_transformer_replication_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

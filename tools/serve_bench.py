@@ -312,6 +312,14 @@ def main() -> None:
     p.add_argument("--max-retries", type=int, default=3,
                    help="per-request retry budget for retriable "
                         "failures (503 / engine crash)")
+    p.add_argument("--allow-recompiles", type=int, default=0,
+                   help="XLA compile budget for the measured window "
+                        "(in-process modes). Warmup compiles every "
+                        "shape this load can produce, so the default 0 "
+                        "makes a silent recompile FAIL the bench "
+                        "(analysis/sanitizers.py RecompileSentinel) "
+                        "instead of quietly degrading tok/s; -1 "
+                        "disables the pin")
     p.add_argument("--max-queue-len", type=int, default=0,
                    help="engine admission bound; 0 = unbounded")
     p.add_argument("--deadline", type=float, default=0.0,
@@ -568,16 +576,29 @@ def main() -> None:
                         out.trace_id,
                     ))
 
-    t0 = time.perf_counter()
-    threads = [
-        threading.Thread(target=worker, args=(w,))
-        for w in range(args.clients)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
+    # the measured window is pinned recompile-free: warmup above
+    # compiled the whole prefill ladder + decode + samplers, so any
+    # compilation here means latencies silently include XLA compile
+    # time — fail the bench loudly rather than report degraded numbers
+    from differential_transformer_replication_tpu.analysis.sanitizers import (
+        RecompileSentinel,
+    )
+
+    sentinel = RecompileSentinel(
+        budget=None if args.allow_recompiles < 0 else args.allow_recompiles,
+        name="serve-bench-measured-window",
+    )
+    with sentinel:
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker, args=(w,))
+            for w in range(args.clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
     if httpd is not None:
         httpd.shutdown()
         httpd.server_close()
@@ -604,6 +625,7 @@ def main() -> None:
         "wall_s": round(wall, 3),
         "slow_exemplars": _slow_exemplars(completed),
         "trace_dir": args.trace_dir,
+        "compiles_in_window": sentinel.count,
         "model": model_cfg.model,
         "num_slots": serving.num_slots,
         "clients": args.clients,
