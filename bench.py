@@ -18,7 +18,8 @@ this image's torch device (CPU-only torch; see tools/measure_reference.py
 and BASELINE.md for the number's provenance and hardware caveat).
 
 Env overrides: BENCH_STEPS, BENCH_WARMUP, BENCH_MICRO_BATCH, BENCH_MODEL,
-BENCH_ATTN ("xla" | "pallas").
+BENCH_ATTN ("xla" | "pallas"), BENCH_FFN ("xla" | "pallas"),
+BENCH_REMAT/BENCH_REMAT_POLICY, BENCH_LOSS_CHUNK.
 """
 
 from __future__ import annotations
@@ -74,6 +75,14 @@ def main() -> None:
     # cross-entropy backward) and dominates at every longer context;
     # BENCH_ATTN=xla to compare.
     attn = os.environ.get("BENCH_ATTN", "pallas")
+    # the fused FFN/norm path (ops/fused_ffn.py + fused_norm_residual.py:
+    # block-boundary add+LN and the SwiGLU chain as Pallas kernels) is
+    # the round-6 default; BENCH_FFN=xla reproduces the round-5 path.
+    ffn = os.environ.get("BENCH_FFN", "pallas")
+    # remat policy knob (only meaningful with BENCH_REMAT=1; sweep with
+    # tools/ffn_sweep.py --remat-policies)
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "none")
     loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0")) or None
 
     model = ModelConfig(
@@ -86,6 +95,9 @@ def main() -> None:
         dropout=0.0,
         compute_dtype="bfloat16",
         attention_impl=attn,
+        ffn_impl=ffn,
+        remat=remat,
+        remat_policy=remat_policy,
         loss_chunk=loss_chunk,
     )
     cfg = TrainConfig(model=model, micro_batch_size=micro_batch, grad_acc_steps=1)
@@ -193,7 +205,8 @@ def main() -> None:
     )
     # diagnostics on stderr so stdout stays one JSON line
     print(
-        f"[bench] model={model_kind} attn={attn} device={jax.devices()[0].device_kind} "
+        f"[bench] model={model_kind} attn={attn} ffn={ffn} "
+        f"device={jax.devices()[0].device_kind} "
         f"micro_batch={micro_batch} block={T} steps={steps} "
         f"tok/s best..median={tps:.0f}..{tps_median:.0f} "
         f"sec/step={dt / (calls * spc):.4f} steps_per_call={spc} "

@@ -44,6 +44,16 @@ class ModelConfig:
     # Attention backend: "xla" (merged-head einsum under jit) or "pallas"
     # (fused differential flash attention kernel).
     attention_impl: str = "xla"
+    # FFN/norm backend — the non-attention hot path. "xla": the reference
+    # composition (ops/swiglu.py + ops/norms.py as separate XLA ops).
+    # "pallas": the fused kernels — residual-add + LayerNorm in one pass
+    # at every block boundary (ops/fused_norm_residual.py, GroupLayerNorm
+    # included) and the SwiGLU chain (gate/xform matmuls -> SiLU ->
+    # product, optionally with the pre-LN fused in front) as one Pallas
+    # kernel with a fused backward (ops/fused_ffn.py). Selected exactly
+    # like attention_impl, for all three model families and the decode
+    # path; interpret-mode on CPU.
+    ffn_impl: str = "xla"
     # Sequence-parallel strategy when the mesh's sequence axis is > 1:
     # "ring" (K/V rotation with O(Tl) chunk memory, parallel/ring.py) or
     # "ulysses" (all-to-all head/sequence re-sharding so the unmodified
@@ -54,6 +64,22 @@ class ModelConfig:
     # activation memory — the standard TPU lever for bigger micro-batches
     # or longer contexts (no reference analog; it keeps all activations).
     remat: bool = False
+    # What jax.checkpoint may SAVE per block when remat is on — the
+    # per-layer-group recompute policy (models/common.py REMAT_POLICIES):
+    #   "none"       jax.checkpoint's default: save only block inputs,
+    #                recompute everything (max memory savings),
+    #   "dots"       save matmul outputs (checkpoint_policies.dots_
+    #                saveable): skips recomputing the MXU-bound work,
+    #                recomputes only the cheap elementwise/norm chain —
+    #                the sweet spot once the FFN epilogue is fused
+    #                (fused kernels make the recompute side cheaper, so
+    #                the policy trade-off moved; sweep with
+    #                tools/ffn_sweep.py --remat-policies),
+    #   "dots_no_batch"  dots_with_no_batch_dims_saveable (Flax's
+    #                default "save the small stuff" policy),
+    #   "nothing"    nothing_saveable, explicit,
+    #   "everything" everything_saveable (remat becomes a no-op marker).
+    remat_policy: str = "none"
     # Fused chunked linear+cross-entropy (ops/losses.py): when set, the
     # training loss never materializes the (B, T, V) logits — it scans
     # position-chunks of this size through the lm head with a
@@ -70,6 +96,17 @@ class ModelConfig:
             raise ValueError(
                 "attention_impl must be 'xla' or 'pallas', got "
                 f"{self.attention_impl!r}"
+            )
+        if self.ffn_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"ffn_impl must be 'xla' or 'pallas', got {self.ffn_impl!r}"
+            )
+        if self.remat_policy not in (
+            "none", "dots", "dots_no_batch", "nothing", "everything"
+        ):
+            raise ValueError(
+                "remat_policy must be one of none|dots|dots_no_batch|"
+                f"nothing|everything, got {self.remat_policy!r}"
             )
         if self.sequence_impl not in ("ring", "ulysses"):
             raise ValueError(
@@ -515,6 +552,25 @@ class TrainConfig:
     # immediately, the default amortizes it to noise. Skipping itself
     # happens every step on-device regardless of this cadence.
     anomaly_check_interval: int = 10
+
+    # Overlap-scheduled data-parallel gradient sync (parallel/dp_step.py).
+    # On a PURE data-parallel mesh (data > 1, every other axis 1) the
+    # step runs under shard_map with the gradient all-reduce issued PER
+    # LAYER-GROUP BUCKET from inside the backward pass (a custom-vjp
+    # identity on each bucket's params), so the collective for layer k's
+    # gradients overlaps the backward compute of layers < k instead of
+    # running fully exposed after it. Numerically the same mean-gradient
+    # (modulo float reduction order); single jit, donated state, zero
+    # recompiles — pinned in tests/test_fused_ffn.py. Ineligible meshes
+    # (fsdp/tensor/sequence/pipeline > 1) fall back to the GSPMD path
+    # regardless of this flag.
+    dp_overlap: bool = True
+    # Consecutive transformer blocks per gradient-sync bucket. 1 = one
+    # all-reduce per layer (max overlap, most collectives); n_layer =
+    # one bucket (no overlap — the GSPMD schedule, minus fusion).
+    # Embeddings and the ln_f/lm_head tail always form their own
+    # buckets.
+    dp_bucket_layers: int = 2
 
     # Fault injection spec (utils/faults.py), merged with the DTX_FAULTS
     # env var. Testing/chaos only; None = inert.

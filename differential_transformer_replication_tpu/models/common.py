@@ -19,8 +19,18 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from differential_transformer_replication_tpu.ops import layer_norm, swiglu
+from differential_transformer_replication_tpu.ops import (
+    fused_group_norm,
+    group_layer_norm,
+    layer_norm,
+    swiglu,
+)
 from differential_transformer_replication_tpu.ops.dropout import dropout
+from differential_transformer_replication_tpu.ops.fused_ffn import fused_swiglu
+from differential_transformer_replication_tpu.ops.fused_norm_residual import (
+    fused_add_norm,
+    fused_norm,
+)
 from differential_transformer_replication_tpu.ops.losses import (
     fused_linear_cross_entropy,
 )
@@ -81,25 +91,136 @@ def apply_ffn(
     return dropout(out, dropout_rate, rng)
 
 
+# ---------------------------------------------------------------------------
+# ffn_impl dispatch — the fused non-attention hot path (ISSUE 9 / ROADMAP
+# item 5). "xla" is the reference composition above; "pallas" routes the
+# block-boundary residual-add + LayerNorm through the single-pass kernel
+# (ops/fused_norm_residual.py) and the SwiGLU chain through the fused
+# MXU kernel (ops/fused_ffn.py). Selection mirrors attention_impl: one
+# ModelConfig switch, all three families + decode.
 
 
-def apply_tail(x: jnp.ndarray, params: dict) -> jnp.ndarray:
+def use_fused_ffn(cfg, mesh=None) -> bool:
+    """Whether the fused Pallas FFN/norm kernels may be dispatched here.
+
+    GSPMD cannot partition a bare ``pallas_call`` — the reason
+    ``attention_impl='pallas'`` routes through the shard_map wrapper
+    (parallel/shard_flash.py) on >1-device meshes. The fused FFN/norm
+    kernels have no such wrapper, so any multi-device GSPMD placement
+    (fsdp/tensor/sequence/pipeline, multi-process DP, or pure DP with
+    ``dp_overlap`` off) falls back to the XLA composition — numerically
+    identical, just un-fused. The overlap-DP hot path is unaffected:
+    its shard_map body runs with ``mesh=None`` (every shard is a
+    single-device program), so the fused kernels stay on there.
+    """
+    if cfg is None or cfg.ffn_impl != "pallas":
+        return False
+    return mesh is None or mesh.devices.size == 1
+
+
+def apply_pre_norm(x: jnp.ndarray, p: dict, cfg, mesh=None) -> jnp.ndarray:
+    """A standalone LayerNorm with no residual input — the block's first
+    pre-LN and decode's ln_f — dispatched on ``cfg.ffn_impl``."""
+    if use_fused_ffn(cfg, mesh):
+        return fused_norm(x, p["w"], p["b"])
+    return layer_norm(x, p["w"], p["b"])
+
+
+def apply_group_norm(x: jnp.ndarray, p: dict, cfg, mesh=None) -> jnp.ndarray:
+    """The full-width GroupLayerNorm over the head concat (diff/ndiff
+    attention + decode), dispatched like :func:`apply_pre_norm` — the
+    Pallas GLN is the fused_norm alias (ops/fused_norm_residual.py)."""
+    if use_fused_ffn(cfg, mesh):
+        return fused_group_norm(x, p["w"], p["b"])
+    return group_layer_norm(x, p["w"], p["b"])
+
+
+def apply_block_ffn(
+    x: jnp.ndarray,
+    attn_out: jnp.ndarray,
+    blk: dict,
+    cfg,
+    rng: Optional[jax.Array] = None,
+    mesh=None,
+) -> jnp.ndarray:
+    """The block's FFN half: attention residual add + pre-LN + SwiGLU +
+    down-proj + dropout + FFN residual add (control.py:92-111's second
+    half, identical across families).
+
+    On the fused path the first three HBM round-trips collapse into two
+    kernels: ``fused_add_norm`` produces the carried residual AND the
+    normalized FFN input in one pass over the tile, and ``fused_swiglu``
+    runs the gate/xform/SiLU/product chain without materializing the
+    (M, 4E) pre-activations. The down-proj + residual stay XLA: the
+    row-parallel matmul is MXU-bound and XLA fuses the add into its
+    epilogue.
+    """
+    rate = cfg.dropout
+    if use_fused_ffn(cfg, mesh):
+        p = blk["ffn"]
+        x, normed = fused_add_norm(
+            x, attn_out, blk["ln2"]["w"], blk["ln2"]["b"]
+        )
+        h = fused_swiglu(
+            normed,
+            p["gate"]["w"], p["gate"]["b"],
+            p["xform"]["w"], p["xform"]["b"],
+        )
+        return x + dropout(linear(h, p["out"]), rate, rng)
+    x = x + attn_out
+    return x + apply_ffn(
+        apply_layer_norm(x, blk["ln2"]), blk["ffn"], rate, rng
+    )
+
+
+# jax.checkpoint policies selectable per run (ModelConfig.remat_policy):
+# what the block remat may SAVE instead of recomputing. Resolved lazily —
+# jax.checkpoint_policies is stable across the pinned versions.
+REMAT_POLICIES = ("none", "dots", "dots_no_batch", "nothing", "everything")
+
+
+def resolve_remat_policy(name: str):
+    cp = jax.checkpoint_policies
+    return {
+        "none": None,  # jax.checkpoint default: save block inputs only
+        "dots": cp.dots_saveable,
+        "dots_no_batch": cp.dots_with_no_batch_dims_saveable,
+        "nothing": cp.nothing_saveable,
+        "everything": cp.everything_saveable,
+    }[name]
+
+
+def remat_block(block_fn, cfg):
+    """Wrap a family's ``block_forward`` in jax.checkpoint under the
+    configured save policy. static_argnums pins (layer_idx, cfg, mesh) —
+    the uniform per-family block signature (models/registry.py)."""
+    policy = resolve_remat_policy(cfg.remat_policy)
+    kw = {} if policy is None else {"policy": policy}
+    return jax.checkpoint(block_fn, static_argnums=(2, 3, 8), **kw)
+
+
+
+
+def apply_tail(x: jnp.ndarray, params: dict, cfg=None, mesh=None) -> jnp.ndarray:
     """Final LayerNorm + untied lm head — identical across the three
     families (control.py:126-127, diff_transformer.py:164-165,
     Ndiff_transformer.py:220-221). ``params`` is the model params dict
-    (or any dict carrying ``ln_f``/``lm_head``)."""
-    x = apply_layer_norm(x, params["ln_f"])
+    (or any dict carrying ``ln_f``/``lm_head``). The ln_f dispatches on
+    ``cfg.ffn_impl`` like every block-boundary norm (``cfg=None`` =
+    reference path)."""
+    x = apply_pre_norm(x, params["ln_f"], cfg, mesh)
     return linear(x, params["lm_head"])
 
 
 def fused_tail_loss(
-    x: jnp.ndarray, params: dict, targets: jnp.ndarray, chunk: int
+    x: jnp.ndarray, params: dict, targets: jnp.ndarray, chunk: int,
+    cfg=None, mesh=None,
 ) -> jnp.ndarray:
     """Final LayerNorm + chunked fused lm-head/cross-entropy
     (ops/losses.py) — the loss of :func:`apply_tail` +
     :func:`cross_entropy_loss` without ever materializing (B, T, V)
     logits."""
-    x = apply_layer_norm(x, params["ln_f"])
+    x = apply_pre_norm(x, params["ln_f"], cfg, mesh)
     p = params["lm_head"]
     return fused_linear_cross_entropy(x, p["w"], p.get("b"), targets, chunk)
 
@@ -144,7 +265,7 @@ def _ce_bwd(res, g):
 cross_entropy_loss.defvjp(_ce_fwd, _ce_bwd)
 
 
-def tail_and_loss(x, params: dict, cfg, targets):
+def tail_and_loss(x, params: dict, cfg, targets, mesh=None):
     """The shared end-of-forward dispatch for all three families: final
     LayerNorm + lm head + (optional) loss. With ``cfg.loss_chunk`` set and
     targets given, routes through the fused chunked loss (ops/losses.py)
@@ -156,17 +277,19 @@ def tail_and_loss(x, params: dict, cfg, targets):
     the returned logits are an independent dense head application that
     training steps drop (DCE removes it when only the loss is consumed)."""
     if targets is not None and cfg.loss_chunk:
-        return None, fused_tail_loss(x, params, targets, cfg.loss_chunk)
+        return None, fused_tail_loss(
+            x, params, targets, cfg.loss_chunk, cfg, mesh
+        )
     if targets is not None:
         from differential_transformer_replication_tpu.ops.losses import (
             dense_linear_cross_entropy,
         )
 
-        x_ln = apply_layer_norm(x, params["ln_f"])
+        x_ln = apply_pre_norm(x, params["ln_f"], cfg, mesh)
         p = params["lm_head"]
         loss = dense_linear_cross_entropy(x_ln, p["w"], p.get("b"), targets)
         return linear(x_ln, p), loss
-    return apply_tail(x, params), None
+    return apply_tail(x, params, cfg, mesh), None
 
 
 def split_rng(rng: Optional[jax.Array], n: int):

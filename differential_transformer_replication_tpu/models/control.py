@@ -123,15 +123,14 @@ def block_forward(
     model has no per-layer schedule, so it is unused here."""
     del layer_idx
     r_attn, r_ffn = common.split_rng(rng, 2)
-    x = x + _attn(
-        common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
+    a = _attn(
+        common.apply_pre_norm(x, blk["ln1"], cfg, mesh), blk["attn"],
         cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl, mesh,
         cfg.sequence_impl,
     )
-    return x + common.apply_ffn(
-        common.apply_layer_norm(x, blk["ln2"]), blk["ffn"],
-        cfg.dropout, r_ffn,
-    )
+    # residual add + ln2 + SwiGLU + down-proj + residual, ffn_impl-
+    # dispatched (fused kernels when "pallas"; models/common.py)
+    return common.apply_block_ffn(x, a, blk, cfg, r_ffn, mesh)
 
 
 def forward(
@@ -151,6 +150,6 @@ def forward(
     for li, (blk, r) in enumerate(zip(params["blocks"], rngs), 1):
         fn = block_forward
         if cfg.remat:  # recompute this block's activations in the backward
-            fn = jax.checkpoint(fn, static_argnums=(2, 3, 8))
+            fn = common.remat_block(fn, cfg)  # cfg.remat_policy-aware
         x = fn(x, blk, li, cfg, cos, sin, mask, r, mesh)
-    return common.tail_and_loss(x, params, cfg, targets)
+    return common.tail_and_loss(x, params, cfg, targets, mesh)

@@ -54,7 +54,6 @@ from differential_transformer_replication_tpu.models import common
 from differential_transformer_replication_tpu.ops import (
     apply_rope,
     diff_lambda,
-    group_layer_norm,
     lambda_init_schedule,
     ndiff_lambdas,
     ndiff_signs,
@@ -180,7 +179,7 @@ def _attn_chunk(
     out = jnp.einsum("bhlm,bmhe->blhe", combined.astype(v.dtype), v_cache)
     out = out.reshape(B, L, -1)  # concat heads
     if cfg.model in ("diff", "ndiff"):
-        out = group_layer_norm(out, p_attn["gn"]["w"], p_attn["gn"]["b"])
+        out = common.apply_group_norm(out, p_attn["gn"], cfg)
         out = out * OUTPUT_SCALE  # constant 0.2 (diff_transformer.py:91)
     out = common.linear(out, p_attn["out"])
     return out, {"k": k_cache, "v": v_cache}
@@ -262,13 +261,15 @@ def forward_chunk(
     new_cache = []
     for li, blk in enumerate(params["blocks"], 1):  # 1-based (diff_transformer.py:161)
         a, layer_cache = _attn_chunk(
-            common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
+            common.apply_pre_norm(x, blk["ln1"], cfg), blk["attn"],
             cache[li - 1], pos, li, cfg, cos, sin, window=window,
         )
-        x = x + a
-        x = x + common.apply_ffn(common.apply_layer_norm(x, blk["ln2"]), blk["ffn"])
+        # residual add + ln2 + SwiGLU + down-proj + residual — the same
+        # ffn_impl dispatch as the training blocks (dropout-free here:
+        # generation is eval-mode)
+        x = common.apply_block_ffn(x, a, blk, cfg)
         new_cache.append(layer_cache)
-    x = common.apply_layer_norm(x, params["ln_f"])
+    x = common.apply_pre_norm(x, params["ln_f"], cfg)
     logits = common.linear(x, params["lm_head"])
     return logits, new_cache
 

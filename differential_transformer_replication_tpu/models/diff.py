@@ -26,7 +26,6 @@ from differential_transformer_replication_tpu.ops import (
     causal_mask,
     diff_attention,
     diff_lambda,
-    group_layer_norm,
     lambda_init_schedule,
 )
 from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
@@ -86,6 +85,7 @@ def _attn(
     impl: str = "xla",
     mesh=None,
     seq_impl: str = "ring",
+    cfg=None,
 ) -> jnp.ndarray:
     B, T, E = x.shape
     r_att, r_out = common.split_rng(rng, 2)
@@ -116,7 +116,7 @@ def _attn(
         ),
     )
     out = out.reshape(B, T, -1)  # concat heads (diff_transformer.py:89)
-    out = group_layer_norm(out, p["gn"]["w"], p["gn"]["b"])  # :90
+    out = common.apply_group_norm(out, p["gn"], cfg, mesh)  # :90
     out = out * OUTPUT_SCALE  # constant 0.2, :91
     out = common.linear(out, p["out"])
     return common.dropout(out, dropout_rate, r_out)
@@ -153,15 +153,14 @@ def block_forward(
     no RoPE."""
     del cos, sin
     r_attn, r_ffn = common.split_rng(rng, 2)
-    x = x + _attn(
-        common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
+    a = _attn(
+        common.apply_pre_norm(x, blk["ln1"], cfg, mesh), blk["attn"],
         layer_idx, mask, cfg.dropout, r_attn, cfg.attention_impl, mesh,
-        cfg.sequence_impl,
+        cfg.sequence_impl, cfg,
     )
-    return x + common.apply_ffn(
-        common.apply_layer_norm(x, blk["ln2"]), blk["ffn"],
-        cfg.dropout, r_ffn,
-    )
+    # residual add + ln2 + SwiGLU + down-proj + residual, ffn_impl-
+    # dispatched (fused kernels when "pallas"; models/common.py)
+    return common.apply_block_ffn(x, a, blk, cfg, r_ffn, mesh)
 
 
 def forward(
@@ -180,6 +179,6 @@ def forward(
     for li, (blk, r) in enumerate(zip(params["blocks"], rngs), 1):  # 1-based, :161
         fn = block_forward
         if cfg.remat:  # recompute this block's activations in the backward
-            fn = jax.checkpoint(fn, static_argnums=(2, 3, 8))
+            fn = common.remat_block(fn, cfg)  # cfg.remat_policy-aware
         x = fn(x, blk, li, cfg, None, None, mask, r, mesh)
-    return common.tail_and_loss(x, params, cfg, targets)
+    return common.tail_and_loss(x, params, cfg, targets, mesh)

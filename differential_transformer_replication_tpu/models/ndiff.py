@@ -28,7 +28,6 @@ from differential_transformer_replication_tpu.models import common
 from differential_transformer_replication_tpu.ops import (
     apply_rope,
     causal_mask,
-    group_layer_norm,
     lambda_init_schedule,
     ndiff_attention,
     ndiff_lambdas,
@@ -88,6 +87,7 @@ def _attn(
     impl: str = "xla",
     mesh=None,
     seq_impl: str = "ring",
+    cfg=None,
 ) -> jnp.ndarray:
     B, T, E = x.shape
     n = p["wq"].shape[0]
@@ -117,7 +117,7 @@ def _attn(
         ),
     )
     out = out.reshape(B, T, -1)  # concat heads (Ndiff_transformer.py:142)
-    out = group_layer_norm(out, p["gn"]["w"], p["gn"]["b"])  # :143
+    out = common.apply_group_norm(out, p["gn"], cfg, mesh)  # :143
     out = out * OUTPUT_SCALE  # constant 0.2, :144
     out = common.linear(out, p["out"])
     return common.dropout(out, dropout_rate, r_out)
@@ -143,15 +143,14 @@ def block_forward(
     ``layer_idx`` is 1-based (Ndiff_transformer.py:216) and may be static
     or traced (the pipeline-parallel layer scan)."""
     r_attn, r_ffn = common.split_rng(rng, 2)
-    x = x + _attn(
-        common.apply_layer_norm(x, blk["ln1"]), blk["attn"],
+    a = _attn(
+        common.apply_pre_norm(x, blk["ln1"], cfg, mesh), blk["attn"],
         layer_idx, cos, sin, mask, cfg.dropout, r_attn, cfg.attention_impl,
-        mesh, cfg.sequence_impl,
+        mesh, cfg.sequence_impl, cfg,
     )
-    return x + common.apply_ffn(
-        common.apply_layer_norm(x, blk["ln2"]), blk["ffn"],
-        cfg.dropout, r_ffn,
-    )
+    # residual add + ln2 + SwiGLU + down-proj + residual, ffn_impl-
+    # dispatched (fused kernels when "pallas"; models/common.py)
+    return common.apply_block_ffn(x, a, blk, cfg, r_ffn, mesh)
 
 
 def forward(
@@ -171,6 +170,6 @@ def forward(
     for li, (blk, r) in enumerate(zip(params["blocks"], rngs), 1):  # 1-based, :216
         fn = block_forward
         if cfg.remat:  # recompute this block's activations in the backward
-            fn = jax.checkpoint(fn, static_argnums=(2, 3, 8))
+            fn = common.remat_block(fn, cfg)  # cfg.remat_policy-aware
         x = fn(x, blk, li, cfg, cos, sin, mask, r, mesh)
-    return common.tail_and_loss(x, params, cfg, targets)
+    return common.tail_and_loss(x, params, cfg, targets, mesh)

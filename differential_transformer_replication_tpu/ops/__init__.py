@@ -25,6 +25,15 @@ from differential_transformer_replication_tpu.ops.flash import (
 from differential_transformer_replication_tpu.ops.losses import (
     fused_linear_cross_entropy,
 )
+from differential_transformer_replication_tpu.ops.fused_norm_residual import (
+    fused_add_group_norm,
+    fused_add_norm,
+    fused_group_norm,
+    fused_norm,
+)
+from differential_transformer_replication_tpu.ops.fused_ffn import (
+    fused_swiglu,
+)
 
 __all__ = [
     "rope_cos_sin",
@@ -48,4 +57,9 @@ __all__ = [
     "flash_diff_attention",
     "flash_ndiff_attention",
     "fused_linear_cross_entropy",
+    "fused_add_group_norm",
+    "fused_add_norm",
+    "fused_group_norm",
+    "fused_norm",
+    "fused_swiglu",
 ]

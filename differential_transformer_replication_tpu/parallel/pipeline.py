@@ -215,6 +215,14 @@ def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
     microbatch index inside the tick, and block_forward splits per
     layer. Without a key, dropout is inert (eval semantics)."""
     n_stages = _check_pipeline_cfg(model_cfg, mesh)
+    if model_cfg.ffn_impl != "xla":
+        # the stage body is a per-device program (shard_map), so a bare
+        # pallas_call would be legal here — but the fused FFN/norm
+        # kernels are validated on the single-device and overlap-DP
+        # paths only; keep pipeline placements on the reference XLA
+        # composition, matching the documented use_fused_ffn fallback
+        # for every other multi-device placement (models/common.py)
+        model_cfg = model_cfg.replace(ffn_impl="xla")
     layers_per_stage = model_cfg.n_layer // n_stages
     mod = model_module(model_cfg)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]

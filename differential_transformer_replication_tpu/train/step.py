@@ -57,7 +57,8 @@ def loss_fn(
     return loss
 
 
-def make_step_fn(cfg: TrainConfig, mesh=None):
+def make_step_fn(cfg: TrainConfig, mesh=None, param_sync=None,
+                 loss_sync=None, grad_sync=None):
     """The raw (un-jitted) optimizer-step function — reused by the
     single-device jit below and by the sharded jit in parallel/dp_step.py
     (which passes its Mesh so attention can go sequence-parallel).
@@ -66,20 +67,44 @@ def make_step_fn(cfg: TrainConfig, mesh=None):
     grad_acc_steps microbatches (A=1 for the reference default,
     train.py:68). Gradients are averaged over microbatches, matching the
     reference's ``loss / grad_acc_steps`` scaling (train.py:265).
+
+    ``param_sync``/``loss_sync`` are the overlap-scheduled DP hooks
+    (parallel/dp_step.py): ``param_sync`` is an identity-forward pytree
+    transform applied to the params INSIDE the differentiated loss, so
+    its custom-VJP backward (a per-bucket ``lax.pmean``) fires the
+    gradient all-reduce for each layer group as soon as that group's
+    cotangents exist — overlapped with the rest of backward instead of
+    exposed after it. ``loss_sync`` maps the shard-local loss to the
+    global mean for metrics and the anomaly guard. ``grad_sync``
+    directly pmeans a gradient pytree; when grad_acc_steps > 1 the
+    microbatch scan uses the LOCAL loss and applies it ONCE to the
+    accumulated grads — baking param_sync into the scanned loss would
+    fire the full per-bucket all-reduce set every microbatch (A x the
+    collective volume for a numerically identical result, pmean being
+    linear). All three default to None (single-device / GSPMD
+    placement, where the partitioner inserts the collectives).
     """
     model_cfg = cfg.resolved_model()
     tx, schedule = make_optimizer(cfg)
-    grad_fn = jax.value_and_grad(loss_fn)
+    if param_sync is None:
+        _loss = loss_fn
+    else:
+        def _loss(params, x, y, model_cfg, r, mesh):
+            return loss_fn(param_sync(params), x, y, model_cfg, r, mesh)
 
-    def run_grad(params, x, y, r, scale):
-        """value_and_grad, optionally loss-scaled: ``scale`` is the
-        fault-injection poison (utils/faults.py) — NaN there makes the
+    # the accumulation scan differentiates the LOCAL loss when grad_sync
+    # handles the post-scan sync (module docstring)
+    _scan_loss = loss_fn if grad_sync is not None else _loss
+
+    def run_grad(params, x, y, r, scale, lf=_loss):
+        """value_and_grad of ``lf``, optionally loss-scaled: ``scale`` is
+        the fault-injection poison (utils/faults.py) — NaN there makes the
         loss AND every gradient NaN, the exact failure the anomaly guard
         must catch. None (no fault armed) is the production path."""
         if scale is None:
-            return grad_fn(params, x, y, model_cfg, r, mesh)
+            return jax.value_and_grad(lf)(params, x, y, model_cfg, r, mesh)
         return jax.value_and_grad(
-            lambda p: loss_fn(p, x, y, model_cfg, r, mesh) * scale
+            lambda p: lf(p, x, y, model_cfg, r, mesh) * scale
         )(params)
 
     def step(state: dict, batch: dict, rng: Optional[jax.Array] = None):
@@ -108,7 +133,8 @@ def make_step_fn(cfg: TrainConfig, mesh=None):
                 else:
                     x, y, sc = xs
                 r = None if rng is None else jax.random.fold_in(rng, i)
-                loss, grads = run_grad(state["params"], x, y, r, sc)
+                loss, grads = run_grad(state["params"], x, y, r, sc,
+                                       lf=_scan_loss)
                 grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
                 return (grads_acc, loss_acc + loss, i + 1), None
 
@@ -122,7 +148,17 @@ def make_step_fn(cfg: TrainConfig, mesh=None):
             )
             grads = jax.tree_util.tree_map(lambda g: g / n_micro, grads)
             loss = loss_sum / n_micro
+            if grad_sync is not None:
+                # one full-gradient all-reduce per STEP; pmean-of-mean ==
+                # mean-of-per-microbatch-pmeans, at 1/A the traffic
+                grads = grad_sync(grads)
 
+        if loss_sync is not None:
+            # shard-local -> global mean loss, BEFORE the guard reads it:
+            # every shard must judge the same scalar or lax.cond could
+            # take different branches per device (grads are already
+            # globally synced by param_sync's backward)
+            loss = loss_sync(loss)
         grad_norm = optax.global_norm(grads)
         # per-layer-group gradient norms ((L+2,): embed, blocks, head) —
         # the observability layer logs them next to the per-layer lambdas

@@ -6,6 +6,20 @@ protobuf with tensorflow's bundled proto (present in this image). This
 is the workflow that produced the step decompositions in BASELINE.md.
 
     python tools/profile_step.py [--steps 5] [--attn pallas] [--top 25]
+    python tools/profile_step.py --json          # one machine-readable line
+
+``--json`` emits the grouped breakdown as ONE JSON line (grouped op
+families, the custom-kernel buckets, device-busy ms/step, compile count)
+so before/after MFU deltas are diffable in CI instead of eyeballed from
+text. The fused Pallas kernels get their own buckets: ``flash_attention``
+(ops/flash.py) and ``fused_ffn`` (ops/fused_ffn.py +
+ops/fused_norm_residual.py custom-call/fusion names).
+
+The capture window runs inside ``RecompileSentinel(budget=0)`` exactly
+like bench.py's measured window: a profile of a RETRACING step would
+produce a misleading breakdown (compile time and duplicate programs in
+the trace), so it fails loudly instead. ``--allow-recompiles N`` loosens
+the pin (-1 disables), mirroring BENCH_ALLOW_RECOMPILES.
 
 The reference has no profiling at all (SURVEY.md section 5.1 — its only
 instrument is GPU-memory prints); this plus utils/profiling.py
@@ -16,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import glob
+import json
 import re
 import sys
 import tempfile
@@ -24,11 +39,28 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+# Custom-kernel buckets for the grouped breakdown: XLA names Pallas
+# programs after the kernel function (custom-call/fusion metadata), so
+# substring membership is stable across jax versions. The fused bucket
+# is checked FIRST: its kernel names (_ffn_fwd_kernel, _addnorm_*) end
+# with the flash needle "_fwd_kernel", so flash-first would swallow
+# their time into flash_attention and under-report the fused work.
+_KERNEL_BUCKETS = (
+    ("fused_ffn", ("_ffn_fwd", "_ffn_bwd", "_addnorm_",
+                   "fused_ffn", "fused_norm", "fused_add_norm",
+                   "_swiglu2", "_norm2", "_add_norm2")),
+    ("flash_attention", ("_fwd_kernel", "_bwd_dq", "_bwd_dkv", "flash",
+                         "_tm_", "tm_packed")),
+)
 
-def capture(args) -> str:
+
+def capture(args):
     import jax
     import jax.numpy as jnp
 
+    from differential_transformer_replication_tpu.analysis.sanitizers import (
+        RecompileSentinel,
+    )
     from differential_transformer_replication_tpu.config import (
         ModelConfig,
         TrainConfig,
@@ -39,9 +71,10 @@ def capture(args) -> str:
     )
 
     model = ModelConfig(
-        model=args.model, vocab_size=12000, n_embd=768, n_head=4, n_layer=8,
-        block_size=args.block_size, dropout=0.0, compute_dtype="bfloat16",
-        attention_impl=args.attn,
+        model=args.model, vocab_size=args.vocab_size, n_embd=args.n_embd,
+        n_head=args.n_head, n_layer=args.n_layer,
+        block_size=args.block_size, dropout=0.0, compute_dtype=args.dtype,
+        attention_impl=args.attn, ffn_impl=args.ffn,
     )
     cfg = TrainConfig(
         model=model, micro_batch_size=args.micro_batch, grad_acc_steps=1
@@ -58,34 +91,35 @@ def capture(args) -> str:
     _ = float(m["loss"])  # sync (block_until_ready lies on axon; BASELINE.md)
 
     out_dir = args.out or tempfile.mkdtemp(prefix="profile_step_")
-    with jax.profiler.trace(out_dir):
-        for _ in range(args.steps):
-            state, m = step(state, batch)
-        _ = float(m["loss"])
-    return out_dir
+    # a retracing step inside the capture window = a misleading profile;
+    # fail loudly like bench.py's measured window (budget configurable)
+    budget = None if args.allow_recompiles < 0 else args.allow_recompiles
+    sentinel = RecompileSentinel(budget=budget, name="profile-capture-window")
+    with sentinel:
+        with jax.profiler.trace(out_dir):
+            for _ in range(args.steps):
+                state, m = step(state, batch)
+            _ = float(m["loss"])
+    return out_dir, sentinel.count
 
 
-def report(out_dir: str, steps: int, top: int) -> None:
+def _parse_trace(out_dir: str, steps: int):
+    """(groups_ms_per_step, totals, counts, busy_ms_per_step) or an
+    error string when the xplane proto is unavailable."""
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
     except ImportError:
-        print(
-            f"trace written to {out_dir} — tensorflow's xplane proto is not "
-            f"importable here; open the trace in TensorBoard instead"
-        )
-        return
+        return "tensorflow's xplane proto is not importable here"
 
     paths = glob.glob(f"{out_dir}/plugins/profile/*/*.xplane.pb")
     if not paths:
-        print(f"no xplane.pb under {out_dir}")
-        return
+        return f"no xplane.pb under {out_dir}"
     xs = xplane_pb2.XSpace()
     with open(sorted(paths)[-1], "rb") as f:
         xs.ParseFromString(f.read())
     tpu = [p for p in xs.planes if p.name.startswith("/device:TPU")]
     if not tpu:
-        print(f"no TPU plane in the trace (planes: {[p.name for p in xs.planes]})")
-        return
+        return f"no TPU plane in the trace (planes: {[p.name for p in xs.planes]})"
     plane = tpu[0]
     meta = plane.event_metadata
     line = max(
@@ -94,12 +128,12 @@ def report(out_dir: str, steps: int, top: int) -> None:
         default=None,
     )
     if line is None:
-        print("no 'XLA Ops' line in the TPU plane")
-        return
+        return "no 'XLA Ops' line in the TPU plane"
 
     totals: dict = defaultdict(float)
     counts: dict = defaultdict(int)
     groups: dict = defaultdict(float)
+    buckets: dict = defaultdict(float)
     for ev in line.events:
         name = meta[ev.metadata_id].name
         ms = ev.duration_ps / 1e9
@@ -107,15 +141,71 @@ def report(out_dir: str, steps: int, top: int) -> None:
         counts[name] += 1
         m = re.match(r"%([a-zA-Z_\.]+)", name)
         groups[m.group(1) if m else name[:24]] += ms
+        for bucket, needles in _KERNEL_BUCKETS:
+            if any(n in name for n in needles):
+                buckets[bucket] += ms
+                break
+    busy = sum(totals.values())
+    return {
+        "groups": {k: v / steps for k, v in groups.items()},
+        "kernel_buckets": {k: v / steps for k, v in buckets.items()},
+        "totals": totals,
+        "counts": counts,
+        "busy_ms_per_step": busy / steps,
+    }
 
-    total = sum(totals.values())
-    print(f"device busy: {total / steps:.2f} ms/step over {steps} steps\n")
+
+def report(out_dir: str, steps: int, top: int, compiles: int,
+           as_json: bool) -> None:
+    parsed = _parse_trace(out_dir, steps)
+    if as_json:
+        doc = {
+            "metric": "profile_step_breakdown",
+            "steps": steps,
+            "compiles_in_window": compiles,
+            "trace_dir": out_dir,
+        }
+        if isinstance(parsed, str):
+            doc["error"] = parsed
+        else:
+            doc["device_busy_ms_per_step"] = round(
+                parsed["busy_ms_per_step"], 3
+            )
+            doc["groups_ms_per_step"] = {
+                k: round(v, 4) for k, v in sorted(
+                    parsed["groups"].items(), key=lambda kv: -kv[1]
+                )
+            }
+            doc["kernel_buckets_ms_per_step"] = {
+                k: round(v, 4) for k, v in parsed["kernel_buckets"].items()
+            }
+        print(json.dumps(doc))
+        return
+    if isinstance(parsed, str):
+        print(f"trace written to {out_dir} — {parsed}; open it in "
+              "TensorBoard instead")
+        return
+    print(
+        f"device busy: {parsed['busy_ms_per_step']:.2f} ms/step over "
+        f"{steps} steps ({compiles} compiles in window)\n"
+    )
     print("grouped by op family (ms/step):")
-    for k, ms in sorted(groups.items(), key=lambda kv: -kv[1])[:15]:
-        print(f"  {ms / steps:8.3f}  {k}")
+    for k, ms in sorted(parsed["groups"].items(), key=lambda kv: -kv[1])[:15]:
+        print(f"  {ms:8.3f}  {k}")
+    if parsed["kernel_buckets"]:
+        print("\ncustom-kernel buckets (ms/step):")
+        for k, ms in sorted(
+            parsed["kernel_buckets"].items(), key=lambda kv: -kv[1]
+        ):
+            print(f"  {ms:8.3f}  {k}")
     print(f"\ntop {top} ops (ms/step):")
-    for name, ms in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
-        print(f"  {ms / steps:7.3f} x{counts[name] // steps:3d}  {name[:110]}")
+    for name, ms in sorted(
+        parsed["totals"].items(), key=lambda kv: -kv[1]
+    )[:top]:
+        print(
+            f"  {ms / steps:7.3f} x{parsed['counts'][name] // steps:3d}  "
+            f"{name[:110]}"
+        )
 
 
 def main() -> None:
@@ -125,11 +215,23 @@ def main() -> None:
     p.add_argument("--block-size", type=int, default=512)
     p.add_argument("--model", default="diff", choices=["control", "diff", "ndiff"])
     p.add_argument("--attn", default="pallas", choices=["xla", "pallas"])
+    p.add_argument("--ffn", default="pallas", choices=["xla", "pallas"])
+    p.add_argument("--dtype", default="bfloat16")
+    # recipe-shape overrides so CI can profile a tiny model quickly
+    p.add_argument("--n-embd", type=int, default=768)
+    p.add_argument("--n-head", type=int, default=4)
+    p.add_argument("--n-layer", type=int, default=8)
+    p.add_argument("--vocab-size", type=int, default=12000)
     p.add_argument("--top", type=int, default=25)
     p.add_argument("--out", default=None, help="trace dir (default: temp)")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable JSON line instead of text")
+    p.add_argument("--allow-recompiles", type=int, default=0,
+                   help="compile budget for the capture window "
+                        "(default 0 = any retrace fails; -1 disables)")
     args = p.parse_args()
-    out_dir = capture(args)
-    report(out_dir, args.steps, args.top)
+    out_dir, compiles = capture(args)
+    report(out_dir, args.steps, args.top, compiles, args.json)
 
 
 if __name__ == "__main__":

@@ -36,6 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-terms", type=int, default=m.n_terms)
     p.add_argument("--compute-dtype", default=m.compute_dtype)
     p.add_argument("--attention-impl", choices=("xla", "pallas"), default=m.attention_impl)
+    p.add_argument("--ffn-impl", choices=("xla", "pallas"), default=m.ffn_impl,
+                   help="FFN/norm backend: reference XLA ops, or the fused "
+                        "add+LayerNorm and SwiGLU Pallas kernels")
     p.add_argument("--sequence-impl", choices=("ring", "ulysses"),
                    default=m.sequence_impl,
                    help="sequence-parallel strategy when --sequence-parallel "
@@ -45,6 +48,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(never materializes full logits; for long context)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks on backward (less activation memory)")
+    p.add_argument("--remat-policy", default=m.remat_policy,
+                   choices=("none", "dots", "dots_no_batch", "nothing",
+                            "everything"),
+                   help="what jax.checkpoint may save per block under "
+                        "--remat (sweep with tools/ffn_sweep.py)")
+    p.add_argument("--no-dp-overlap", action="store_true",
+                   help="disable the bucketed backward-overlapped DP "
+                        "gradient all-reduce (parallel/dp_step.py)")
+    p.add_argument("--dp-bucket-layers", type=int, default=t.dp_bucket_layers,
+                   help="transformer blocks per overlapped gradient "
+                        "all-reduce bucket (parallel/dp_step.py)")
 
     p.add_argument("--dataset", default=t.dataset,
                    help="tinystories | synthetic | path to a text file")
@@ -173,8 +187,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         n_terms=args.n_terms,
         compute_dtype=args.compute_dtype,
         attention_impl=args.attention_impl,
+        ffn_impl=args.ffn_impl,
         sequence_impl=args.sequence_impl,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         loss_chunk=args.loss_chunk,
     )
     return TrainConfig(
@@ -206,6 +222,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         ckpt_async=args.ckpt_async,
         ckpt_keep_last=args.ckpt_keep_last,
         ckpt_keep_every=args.ckpt_keep_every,
+        dp_overlap=not args.no_dp_overlap,
+        dp_bucket_layers=args.dp_bucket_layers,
         anomaly_guard=args.anomaly_guard,
         anomaly_spike_factor=args.anomaly_spike_factor,
         anomaly_warmup_steps=args.anomaly_warmup_steps,
