@@ -54,6 +54,27 @@ class ModelConfig:
     # like attention_impl, for all three model families and the decode
     # path; interpret-mode on CPU.
     ffn_impl: str = "xla"
+    # Decode-side (serving / generate_cached) attention backend for the
+    # single-query step over the ring KV cache: "xla" keeps the plain
+    # einsum+softmax composition (models/decode.py), "pallas" routes the
+    # batched L=1 step through the fused online-softmax kernel
+    # (ops/decode_attention.py: per-stream softmaxes + lambda combine in
+    # one pass; score maps never reach HBM). Selected exactly like
+    # attention_impl/ffn_impl; interpret-mode on CPU. Prefill chunks
+    # always run the XLA chunk path (compute-bound, not the decode
+    # bottleneck).
+    decode_attention_impl: str = "xla"
+    # KV-cache storage dtype for the ring/slot-pool caches
+    # (models/decode.py init_cache): "auto" stores compute_dtype (the
+    # pre-quantization behavior), "bf16" forces bfloat16 storage, "int8"
+    # stores symmetric per-head-scale int8 K/V (ops/decode_attention.py
+    # quantize_kv) — about half the bf16 HBM bytes per slot, so ~2x
+    # concurrent slot capacity at equal HBM, with dequantization fused
+    # into the Pallas kernel's tile loads (the XLA path dequantizes the
+    # cache row before attending). bf16/auto decode is bit-identical
+    # between impls at the greedy level; int8 is tolerance-gated
+    # (tests/test_decode_attention.py).
+    kv_cache_dtype: str = "auto"
     # Sequence-parallel strategy when the mesh's sequence axis is > 1:
     # "ring" (K/V rotation with O(Tl) chunk memory, parallel/ring.py) or
     # "ulysses" (all-to-all head/sequence re-sharding so the unmodified
@@ -100,6 +121,16 @@ class ModelConfig:
         if self.ffn_impl not in ("xla", "pallas"):
             raise ValueError(
                 f"ffn_impl must be 'xla' or 'pallas', got {self.ffn_impl!r}"
+            )
+        if self.decode_attention_impl not in ("xla", "pallas"):
+            raise ValueError(
+                "decode_attention_impl must be 'xla' or 'pallas', got "
+                f"{self.decode_attention_impl!r}"
+            )
+        if self.kv_cache_dtype not in ("auto", "bf16", "int8"):
+            raise ValueError(
+                "kv_cache_dtype must be one of auto|bf16|int8, got "
+                f"{self.kv_cache_dtype!r}"
             )
         if self.remat_policy not in (
             "none", "dots", "dots_no_batch", "nothing", "everything"
@@ -211,8 +242,25 @@ class ServingConfig:
     # device call is synchronous — but operators/load-balancers can
     # route around it). 0 = watchdog off.
     step_time_budget_s: float = 0.0
+    # Serving-side overrides of the corresponding ModelConfig knobs,
+    # applied by ServingEngine at build: a checkpoint trained with the
+    # defaults can still serve with the fused decode kernel / quantized
+    # KV without editing its saved model config. "" = inherit the
+    # ModelConfig value.
+    decode_attention_impl: str = ""
+    kv_cache_dtype: str = ""
 
     def __post_init__(self):
+        if self.decode_attention_impl not in ("", "xla", "pallas"):
+            raise ValueError(
+                "decode_attention_impl must be ''|'xla'|'pallas', got "
+                f"{self.decode_attention_impl!r}"
+            )
+        if self.kv_cache_dtype not in ("", "auto", "bf16", "int8"):
+            raise ValueError(
+                "kv_cache_dtype must be ''|auto|bf16|int8, got "
+                f"{self.kv_cache_dtype!r}"
+            )
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
         if self.max_queue_len < 0:

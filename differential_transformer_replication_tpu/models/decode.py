@@ -59,6 +59,12 @@ from differential_transformer_replication_tpu.ops import (
     ndiff_signs,
     rope_cos_sin,
 )
+from differential_transformer_replication_tpu.ops.decode_attention import (
+    decode_attention,
+    decode_attention_reference,
+    dequantize_kv,
+    quantize_kv,
+)
 from differential_transformer_replication_tpu.ops.lambdas import OUTPUT_SCALE
 from differential_transformer_replication_tpu.ops.streams import (
     NEG_INF,
@@ -76,19 +82,101 @@ def _uses_rope(cfg: ModelConfig) -> bool:
     return cfg.model in ("control", "ndiff")
 
 
+# Pool-batch axis of each cache leaf: K (and its scales) carry the
+# stream axis first, V does not. The single source of truth for every
+# per-slot slice/scatter/merge over the cache pytree (serving/engine.py).
+KV_CACHE_BATCH_AXIS = {"k": 1, "v": 0, "k_scale": 1, "v_scale": 0}
+
+
+def kv_store_dtype(cfg: ModelConfig) -> str:
+    """Resolved KV-cache storage dtype: ``"int8"`` or a float dtype
+    string (``kv_cache_dtype == "auto"`` stores ``compute_dtype``, the
+    pre-quantization behavior)."""
+    if cfg.kv_cache_dtype == "int8":
+        return "int8"
+    if cfg.kv_cache_dtype == "bf16":
+        return "bfloat16"
+    return cfg.compute_dtype
+
+
 def init_cache(cfg: ModelConfig, batch_size: int) -> list:
-    """Per-layer K/V buffers sized to ``block_size``: K is per-stream
-    (S, B, M, H, d); V is shared across streams (B, M, H, dv)."""
+    """Per-layer K/V buffers sized to ``block_size``, HEAD-MAJOR so the
+    per-(slot, head) ring is contiguous — the fused decode kernel's
+    native layout (ops/decode_attention.py) and an equivalent einsum for
+    the XLA chunk path: K is per-stream (S, B, H, M, d); V is shared
+    across streams (B, H, M, dv).
+
+    ``cfg.kv_cache_dtype == "int8"`` stores symmetric per-head-scale
+    int8 values plus fp32 scales (``k_scale`` (S, B, H, M) / ``v_scale``
+    (B, H, M)) — about half the bf16 bytes per slot; otherwise the
+    resolved float dtype (:func:`kv_store_dtype`)."""
     S = _n_streams(cfg)
     H, d, dv, M = cfg.n_head, cfg.head_size, cfg.value_size, cfg.block_size
-    dt = jnp.dtype(cfg.compute_dtype)
-    return [
-        {
-            "k": jnp.zeros((S, batch_size, M, H, d), dt),
-            "v": jnp.zeros((batch_size, M, H, dv), dt),
-        }
-        for _ in range(cfg.n_layer)
-    ]
+    store = kv_store_dtype(cfg)
+    cache = []
+    for _ in range(cfg.n_layer):
+        if store == "int8":
+            layer = {
+                "k": jnp.zeros((S, batch_size, H, M, d), jnp.int8),
+                "v": jnp.zeros((batch_size, H, M, dv), jnp.int8),
+                "k_scale": jnp.zeros((S, batch_size, H, M), jnp.float32),
+                "v_scale": jnp.zeros((batch_size, H, M), jnp.float32),
+            }
+        else:
+            dt = jnp.dtype(store)
+            layer = {
+                "k": jnp.zeros((S, batch_size, H, M, d), dt),
+                "v": jnp.zeros((batch_size, H, M, dv), dt),
+            }
+        cache.append(layer)
+    return cache
+
+
+def _dequant_layer(layer_cache: dict, dtype):
+    """The layer's (K, V) as float arrays in ``dtype``: a cast-free read
+    on the float path, a fused multiply on the int8 path (the Pallas
+    kernel instead dequantizes inside its tile loads)."""
+    if "k_scale" in layer_cache:
+        return (
+            dequantize_kv(layer_cache["k"], layer_cache["k_scale"], dtype),
+            dequantize_kv(layer_cache["v"], layer_cache["v_scale"], dtype),
+        )
+    return layer_cache["k"], layer_cache["v"]
+
+
+def _write_chunk(layer_cache: dict, ks: jnp.ndarray, v: jnp.ndarray,
+                 slot) -> dict:
+    """Write one chunk's new K/V — ks (S, B, L, H, d), v (B, L, H, dv) —
+    into the ring at ``slot``, quantizing on the int8 path so the chunk's
+    own attention (and every later step) reads exactly what the cache
+    holds."""
+    k_new = ks.transpose(0, 1, 3, 2, 4)  # (S, B, H, L, d)
+    v_new = v.transpose(0, 2, 1, 3)  # (B, H, L, dv)
+    out = dict(layer_cache)
+    if "k_scale" in layer_cache:
+        kq, ksc = quantize_kv(k_new)
+        vq, vsc = quantize_kv(v_new)
+        out["k"] = jax.lax.dynamic_update_slice(
+            layer_cache["k"], kq, (0, 0, 0, slot, 0)
+        )
+        out["k_scale"] = jax.lax.dynamic_update_slice(
+            layer_cache["k_scale"], ksc, (0, 0, 0, slot)
+        )
+        out["v"] = jax.lax.dynamic_update_slice(
+            layer_cache["v"], vq, (0, 0, slot, 0)
+        )
+        out["v_scale"] = jax.lax.dynamic_update_slice(
+            layer_cache["v_scale"], vsc, (0, 0, slot)
+        )
+    else:
+        dt = layer_cache["k"].dtype
+        out["k"] = jax.lax.dynamic_update_slice(
+            layer_cache["k"], k_new.astype(dt), (0, 0, 0, slot, 0)
+        )
+        out["v"] = jax.lax.dynamic_update_slice(
+            layer_cache["v"], v_new.astype(dt), (0, 0, slot, 0)
+        )
+    return out
 
 
 def _stacked_wq(p_attn: dict) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -144,16 +232,16 @@ def _attn_chunk(
     # ABSOLUTE position; RoPE scores depend only on (q_pos - k_pos), so
     # the rolled window needs no re-rotating (sliding-window attention —
     # see the module docstring for how this relates to the reference's
-    # crop semantics).
+    # crop semantics). The write quantizes on the int8 path, so the
+    # chunk's own attention below reads exactly what later decode steps
+    # will read.
     slot = jax.lax.rem(jnp.asarray(pos, jnp.int32), M)
-    k_cache = jax.lax.dynamic_update_slice(
-        layer_cache["k"], ks, (0, 0, slot, 0, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(layer_cache["v"], v, (0, slot, 0, 0))
+    new_cache = _write_chunk(layer_cache, ks, v, slot)
+    k_cache, v_cache = _dequant_layer(new_cache, x.dtype)
 
     scale = 1.0 / (cfg.head_size ** 0.5)
     scores = (
-        jnp.einsum("sblhd,sbmhd->sbhlm", qs, k_cache).astype(jnp.float32) * scale
+        jnp.einsum("sblhd,sbhmd->sbhlm", qs, k_cache).astype(jnp.float32) * scale
     )
     # Ring-aware causal mask over absolute positions. After this chunk's
     # write the latest absolute position is ``last``; slot m then holds
@@ -176,13 +264,13 @@ def _attn_chunk(
 
     coeffs = _layer_coeffs(cfg, p_attn, layer_idx)  # (S, H)
     combined = jnp.einsum("sh,sbhlm->bhlm", coeffs, probs)
-    out = jnp.einsum("bhlm,bmhe->blhe", combined.astype(v.dtype), v_cache)
+    out = jnp.einsum("bhlm,bhme->blhe", combined.astype(v.dtype), v_cache)
     out = out.reshape(B, L, -1)  # concat heads
     if cfg.model in ("diff", "ndiff"):
         out = common.apply_group_norm(out, p_attn["gn"], cfg)
         out = out * OUTPUT_SCALE  # constant 0.2 (diff_transformer.py:91)
     out = common.linear(out, p_attn["out"])
-    return out, {"k": k_cache, "v": v_cache}
+    return out, new_cache
 
 
 def forward_chunk(
@@ -274,6 +362,158 @@ def forward_chunk(
     return logits, new_cache
 
 
+# ---------------------------------------------------------------------------
+# Pool-native batched decode (decode_attention_impl == "pallas"): the
+# whole slot pool advances one token in ONE call — no vmap over rows —
+# with each row at its own absolute position and attention running
+# through the fused Pallas kernel (ops/decode_attention.py). The XLA
+# baseline keeps the per-row vmapped forward_chunk path untouched.
+# ---------------------------------------------------------------------------
+
+
+def _rope_rows(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
+    """Rotate single-token streams at PER-ROW positions: x (S, B, H, d),
+    cos/sin (B, d/2) gathered at each row's own position. Same fp32
+    even/odd-lane formula as ops/rope.py:apply_rope (which slices one
+    shared [0, T) table and so cannot express per-row positions)."""
+    xf = x.astype(jnp.float32)
+    x_even = xf[..., 0::2]
+    x_odd = xf[..., 1::2]
+    c = cos[None, :, None, :]  # broadcast over (S, ..., H, ...)
+    s = sin[None, :, None, :]
+    rot_even = x_even * c - x_odd * s
+    rot_odd = x_even * s + x_odd * c
+    return jnp.stack([rot_even, rot_odd], axis=-1).reshape(x.shape).astype(
+        x.dtype
+    )
+
+
+def _update_cache_rows(layer_cache: dict, ks: jnp.ndarray, v: jnp.ndarray,
+                       pos: jnp.ndarray, M: int) -> dict:
+    """Scatter each row's new K/V — ks (S, B, H, d), v (B, H, dv) — into
+    its own ring slot ``pos[b] % M`` (one XLA scatter per leaf; row/slot
+    pairs are unique so the update order is immaterial)."""
+    slot = jax.lax.rem(jnp.asarray(pos, jnp.int32), M)
+    b_idx = jnp.arange(slot.shape[0])
+    out = dict(layer_cache)
+    if "k_scale" in layer_cache:
+        kq, ksc = quantize_kv(ks)
+        vq, vsc = quantize_kv(v)
+        out["k"] = layer_cache["k"].at[:, b_idx, :, slot].set(
+            kq.transpose(1, 0, 2, 3)
+        )
+        out["k_scale"] = layer_cache["k_scale"].at[:, b_idx, :, slot].set(
+            ksc.transpose(1, 0, 2)
+        )
+        out["v"] = layer_cache["v"].at[b_idx, :, slot].set(vq)
+        out["v_scale"] = layer_cache["v_scale"].at[b_idx, :, slot].set(vsc)
+    else:
+        dt = layer_cache["k"].dtype
+        out["k"] = layer_cache["k"].at[:, b_idx, :, slot].set(
+            ks.astype(dt).transpose(1, 0, 2, 3)
+        )
+        out["v"] = layer_cache["v"].at[b_idx, :, slot].set(v.astype(dt))
+    return out
+
+
+def _pool_attn(
+    x: jnp.ndarray,  # (B, E) normed single-token inputs, one per slot
+    p_attn: dict,
+    layer_cache: dict,
+    pos: jnp.ndarray,  # (B,) int32 absolute positions
+    layer_idx: int,
+    cfg: ModelConfig,
+    cos,  # (B, d/2) per-row RoPE tables (None for the diff family)
+    sin,
+):
+    """The batched L=1 twin of :func:`_attn_chunk`: update-then-attend
+    over every slot row at once, attention dispatched on
+    ``cfg.decode_attention_impl``."""
+    B = x.shape[0]
+    wq, wk = _stacked_wq(p_attn)
+    qs = jnp.einsum("be,sehd->sbhd", x, wq.astype(x.dtype))
+    ks = jnp.einsum("be,sehd->sbhd", x, wk.astype(x.dtype))
+    v = jnp.einsum("be,ehd->bhd", x, p_attn["wv"].astype(x.dtype))
+    if _uses_rope(cfg):
+        qs = _rope_rows(qs, cos, sin)
+        ks = _rope_rows(ks, cos, sin)
+    new_cache = _update_cache_rows(layer_cache, ks, v, pos, cfg.block_size)
+    coeffs = _layer_coeffs(cfg, p_attn, layer_idx)
+    if cfg.decode_attention_impl == "pallas":
+        out = decode_attention(
+            qs, new_cache["k"], new_cache["v"], pos, coeffs,
+            k_scale=new_cache.get("k_scale"),
+            v_scale=new_cache.get("v_scale"),
+        )
+    else:
+        k_eff, v_eff = _dequant_layer(new_cache, x.dtype)
+        out = decode_attention_reference(qs, k_eff, v_eff, pos, coeffs)
+    out = out.reshape(B, -1)  # concat heads
+    if cfg.model in ("diff", "ndiff"):
+        out = common.apply_group_norm(out, p_attn["gn"], cfg)
+        out = out * OUTPUT_SCALE
+    return common.linear(out, p_attn["out"]), new_cache
+
+
+def forward_decode_pool(
+    params: dict,
+    tokens: jnp.ndarray,  # (B,) current token per slot row
+    pos,  # (B,) int32 absolute position per row (runtime array)
+    cache: list,
+    cfg: ModelConfig,
+    rope_len: int = 0,
+) -> Tuple[jnp.ndarray, list]:
+    """Advance the WHOLE slot pool by one token: returns ((B, V) logits,
+    updated cache). The batched counterpart of a length-1
+    :func:`forward_chunk` per row — same ring semantics, same
+    update-then-attend order, every row at its own position — minus the
+    vmap, so the fused decode kernel sees the full pool in one
+    ``(B*H,)``-grid call per layer. Host-side admission guards
+    (serving/engine.py submit, generate_cached's checks) own the
+    concrete-position validity rules; everything here is traced."""
+    B = tokens.shape[0]
+    M = cfg.block_size
+    compute = jnp.dtype(cfg.compute_dtype)
+    pos = jnp.asarray(pos, jnp.int32)
+    x = params["tok_emb"][tokens].astype(compute)  # (B, E)
+    cos = sin = None
+    if cfg.model == "diff":
+        x = x + params["pos_emb"][pos].astype(compute)
+    else:
+        cos_full, sin_full = rope_cos_sin(
+            cfg.head_size, max(int(rope_len), M)
+        )
+        cos = cos_full[pos]  # (B, d/2) at each row's own position
+        sin = sin_full[pos]
+    new_cache = []
+    for li, blk in enumerate(params["blocks"], 1):  # 1-based schedule
+        a, layer_cache = _pool_attn(
+            common.apply_pre_norm(x, blk["ln1"], cfg), blk["attn"],
+            cache[li - 1], pos, li, cfg, cos, sin,
+        )
+        x = common.apply_block_ffn(x, a, blk, cfg)
+        new_cache.append(layer_cache)
+    x = common.apply_pre_norm(x, params["ln_f"], cfg)
+    return common.linear(x, params["lm_head"]), new_cache
+
+
+def merge_cache_update(active: jnp.ndarray, new_cache: list,
+                       old_cache: list) -> list:
+    """Masked cache merge over the pool-batch axis of every leaf: rows
+    where ``active`` keep the update, others keep their old buffers —
+    how the engine's batched decode step discards the garbage writes of
+    inactive/mid-prefill slots (serving/engine.py)."""
+    merged = []
+    for nc, oc in zip(new_cache, old_cache):
+        layer = {}
+        for key in nc:
+            axis = KV_CACHE_BATCH_AXIS[key]
+            shape = (1,) * axis + (-1,) + (1,) * (nc[key].ndim - axis - 1)
+            layer[key] = jnp.where(active.reshape(shape), nc[key], oc[key])
+        merged.append(layer)
+    return merged
+
+
 @partial(
     jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k")
 )
@@ -338,11 +578,22 @@ def generate_cached(
         cache, samples, rng = carry
         rng, key = jax.random.split(rng)
         prev = samples[:, i - 1]
-        logits, cache = forward_chunk(
-            params, prev[:, None], Tc + i - 1, cache, cfg, rope_len=total
-        )
+        if cfg.decode_attention_impl == "pallas":
+            # fused pool step: all B rows share the position here, but
+            # the kernel path is the same one the serving engine runs
+            # with per-row positions
+            last, cache = forward_decode_pool(
+                params, prev, jnp.full((B,), Tc + i - 1, jnp.int32),
+                cache, cfg, rope_len=total,
+            )
+        else:
+            logits, cache = forward_chunk(
+                params, prev[:, None], Tc + i - 1, cache, cfg,
+                rope_len=total,
+            )
+            last = logits[:, -1, :]
         nxt = sample_token(
-            key, logits[:, -1, :].astype(jnp.float32), temperature, top_k
+            key, last.astype(jnp.float32), temperature, top_k
         ).astype(samples.dtype)
         samples = samples.at[:, i].set(nxt)
         return cache, samples, rng

@@ -34,6 +34,13 @@ from differential_transformer_replication_tpu.ops.fused_norm_residual import (
 from differential_transformer_replication_tpu.ops.fused_ffn import (
     fused_swiglu,
 )
+from differential_transformer_replication_tpu.ops.decode_attention import (
+    decode_attention,
+    decode_attention_reference,
+    dequantize_kv,
+    quantize_kv,
+    quantize_params_int8,
+)
 
 __all__ = [
     "rope_cos_sin",
@@ -62,4 +69,9 @@ __all__ = [
     "fused_group_norm",
     "fused_norm",
     "fused_swiglu",
+    "decode_attention",
+    "decode_attention_reference",
+    "dequantize_kv",
+    "quantize_kv",
+    "quantize_params_int8",
 ]

@@ -1,0 +1,332 @@
+"""Fused single-query decode attention over the serving slot pool, with
+optional int8 KV storage — the decode-side counterpart of ops/flash.py.
+
+Serving decode is one token per step per slot: the engine's hot loop
+(serving/engine.py) runs L=1 attention over every slot's ring KV cache.
+As plain XLA ops (models/decode.py:``_attn_chunk``) that materializes the
+per-stream fp32 score/softmax maps ``(S, B, H, M)`` in HBM every layer of
+every step, and on TPU the decode step is bandwidth-bound: the K/V cache
+stream dominates, so the score-map round-trips bound both inter-token
+latency and how many concurrent slots fit at equal HBM.
+
+This module is the fused alternative:
+
+- :func:`decode_attention` — a Pallas kernel, grid ``(B*H, nk)``, that
+  streams each slot row's ring cache tile-by-tile, runs the S per-stream
+  softmaxes ONLINE (flash-style running max/sum carried in VMEM scratch),
+  applies the lambda-weighted combine coefficients
+  (models/decode.py:``_layer_coeffs`` — control S=1, diff S=2, ndiff S=N)
+  in-kernel, and writes only the ``(B, H, dv)`` output. Per-stream
+  attention maps and fp32 scores never reach HBM.
+- int8 KV: :func:`quantize_kv` stores K/V rows as int8 with one fp32
+  scale per (stream,) slot/head/token vector; the kernel dequantizes
+  INSIDE the tile loads, so the HBM stream is genuinely half the bf16
+  bytes (plus a ~4/d scale overhead). :func:`dequantize_kv` is the XLA
+  twin used by the un-fused path and the parity oracles.
+- :func:`decode_attention_reference` — the plain-XLA twin (same masking
+  and fp32 softmax), used when ``decode_attention_impl == "xla"`` and by
+  tests/tools/decode_attn_sweep.py as the parity baseline.
+- :func:`quantize_params_int8` — the weight-side satellite: per-channel
+  symmetric int8 quantize + dequantize of every matmul weight for
+  ``load_params_for_inference(..., quantize="int8")``.
+
+Ring-mask note: a decode row at absolute position ``pos`` over a ring of
+``M = block_size`` slots sees slot ``m`` iff the position it holds is
+non-negative, which reduces to ``m <= pos`` (for ``pos >= M`` every slot
+holds a live key) — the same arithmetic ``_attn_chunk`` derives for its
+general chunk case, collapsed for L=1 (see models/decode.py).
+
+Kernel naming: the kernel body is ``_dattn_fwd_kernel`` so XLA op names
+carry the ``_dattn_`` needle tools/profile_step.py buckets on.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from differential_transformer_replication_tpu.utils.compat import (
+    CompilerParams as _CompilerParams,
+)
+
+from differential_transformer_replication_tpu.ops.flash import (
+    auto_interpret,
+    pick_block,
+)
+from differential_transformer_replication_tpu.ops.streams import NEG_INF
+
+# K tile length streamed per grid step; clipped to a divisor of the cache
+# length (pick_block). 512 keeps the int8 tile above the (32, 128) int8
+# tiling floor and the VMEM footprint at O(S * block * d) per program.
+_DEFAULT_BLOCK_K = 512
+
+
+# ---------------------------------------------------------------------------
+# int8 KV quantization (per-vector symmetric scales)
+# ---------------------------------------------------------------------------
+
+
+def quantize_kv(x: jnp.ndarray):
+    """Symmetric int8 quantization over the LAST axis.
+
+    One fp32 scale per leading-index vector (for a K row that is per
+    (stream, slot, head, token) — the "per-head scale" granularity), so
+    ``|dequant(q) - x| <= scale / 2`` elementwise. Returns
+    ``(int8 values, fp32 scales)`` with ``scales.shape == x.shape[:-1]``.
+    All-zero vectors get a tiny floor scale instead of a 0/0 NaN.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    """XLA-side inverse of :func:`quantize_kv` (the fused kernel performs
+    the same multiply inside its tile loads instead)."""
+    return (q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# The fused kernel
+# ---------------------------------------------------------------------------
+
+
+def _dattn_fwd_kernel(
+    q_ref,  # (1, S, d) this row's per-stream queries (post-RoPE)
+    k_ref,  # (S, 1, block_k, d) stored dtype (float) or int8
+    v_ref,  # (1, block_k, dv)
+    pos_ref,  # (1, BH) int32 SMEM: absolute position per (b, h) program
+    c_ref,  # (S, H) float32 SMEM combine coefficients (_layer_coeffs)
+    *refs,  # [k_scale_ref (S, 1, block_k), v_scale_ref (1, block_k) if
+    #          quantized] then out_ref (1, dv) and scratch:
+    #          m (S, 1), l (S, 1), acc (S, dv) — all fp32
+    n_heads: int,
+    quantized: bool,
+):
+    if quantized:
+        ks_ref, vs_ref, out_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        out_ref, m_scr, l_scr, acc_scr = refs
+    S, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[2]
+    bh = pl.program_id(0)  # read at top level (interpreter cannot lower
+    j = pl.program_id(1)   # program_id inside when-bodies; see ops/flash.py)
+    nk = pl.num_programs(1)
+    pos = pos_ref[0, bh]
+    scale = 1.0 / math.sqrt(d)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # ring visibility collapses to col <= pos for a single decode row
+    # (module docstring); a tile entirely past pos is skipped outright
+    @pl.when(j * block_k <= pos)
+    def _():
+        q = q_ref[0]  # (S, d)
+        k_j = k_ref[:, 0]  # (S, block_k, d)
+        v_j = v_ref[0]  # (block_k, dv)
+        if quantized:
+            # dequant fused into the tile load: HBM carried int8 + one
+            # fp32 scale per row vector; VMEM sees compute-dtype tiles
+            k_j = (
+                k_j.astype(jnp.float32) * ks_ref[:, 0][:, :, None]
+            ).astype(q.dtype)
+            v_j = (
+                v_j.astype(jnp.float32) * vs_ref[0][:, None]
+            ).astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, k_j,
+            dimension_numbers=(((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (S, block_k)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        s = jnp.where(cols <= pos, s, NEG_INF)
+        m_prev = m_scr[:]  # (S, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # (S, block_k)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_j.dtype), v_j,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (S, dv)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+
+    @pl.when(j == nk - 1)
+    def _():
+        # l >= 1 always (slot pos is visible to its own query); the floor
+        # only guards never-stepped degenerate rows
+        l_safe = jnp.maximum(l_scr[:], 1e-30)
+        o_s = acc_scr[:] / l_safe  # (S, dv) per-stream outputs
+        h = jax.lax.rem(bh, jnp.int32(n_heads))
+        combined = o_s[0:1] * c_ref[0, h]
+        for s_i in range(1, S):
+            combined += o_s[s_i:s_i + 1] * c_ref[s_i, h]
+        out_ref[:] = combined.astype(out_ref.dtype)
+
+
+def decode_attention(
+    qs: jnp.ndarray,  # (S, B, H, d) current-token queries (post-RoPE)
+    k_cache: jnp.ndarray,  # (S, B, H, M, d) stored dtype or int8
+    v_cache: jnp.ndarray,  # (B, H, M, dv)
+    pos,  # (B,) int32 absolute position of each row's current token
+    coeffs: jnp.ndarray,  # (S, H) float32 combine coefficients
+    *,
+    k_scale: Optional[jnp.ndarray] = None,  # (S, B, H, M) fp32 (int8 path)
+    v_scale: Optional[jnp.ndarray] = None,  # (B, H, M) fp32
+    block_k: int = 0,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused single-query multi-stream attention over the slot pool.
+
+    The cache rides in the kernel-native pool layout (models/decode.py
+    ``init_cache``): head-major, so the per-(b, h) ``(M, d)`` ring is
+    contiguous and the grid flattens to ``B*H`` programs with zero-copy
+    reshapes. The current token's K/V must already be written into the
+    cache at ``pos % M`` (the same update-then-attend order
+    ``_attn_chunk`` uses). Returns ``(B, H, dv)`` in the query dtype.
+    """
+    S, B, H, M, d = k_cache.shape
+    dv = v_cache.shape[-1]
+    BH = B * H
+    if interpret is None:
+        interpret = auto_interpret()
+    bk = pick_block(block_k or _DEFAULT_BLOCK_K, M)
+    nk = M // bk
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
+
+    q = qs.transpose(1, 2, 0, 3).reshape(BH, S, d)  # tiny: one token/row
+    k = k_cache.reshape(S, BH, M, d)  # zero-copy: head-major layout
+    v = v_cache.reshape(BH, M, dv)
+    pos_bh = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32)[:, None], (B, H)
+    ).reshape(1, BH)
+
+    inputs = [q, k, v, pos_bh, coeffs.astype(jnp.float32)]
+    in_specs = [
+        pl.BlockSpec((1, S, d), lambda bh, j: (bh, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((S, 1, bk, d), lambda bh, j: (0, bh, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, dv), lambda bh, j: (bh, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, BH), lambda bh, j: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((S, H), lambda bh, j: (0, 0),
+                     memory_space=pltpu.SMEM),
+    ]
+    if quantized:
+        inputs += [
+            k_scale.reshape(S, BH, M).astype(jnp.float32),
+            v_scale.reshape(BH, M).astype(jnp.float32),
+        ]
+        in_specs += [
+            pl.BlockSpec((S, 1, bk), lambda bh, j: (0, bh, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), lambda bh, j: (bh, j),
+                         memory_space=pltpu.VMEM),
+        ]
+    out = pl.pallas_call(
+        functools.partial(
+            _dattn_fwd_kernel, n_heads=H, quantized=quantized
+        ),
+        grid=(BH, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, dv), lambda bh, j: (bh, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, dv), qs.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((S, 1), jnp.float32),
+            pltpu.VMEM((S, 1), jnp.float32),
+            pltpu.VMEM((S, dv), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*inputs)
+    return out.reshape(B, H, dv)
+
+
+def decode_attention_reference(
+    qs: jnp.ndarray,  # (S, B, H, d)
+    k_cache: jnp.ndarray,  # (S, B, H, M, d) FLOAT (dequantize first)
+    v_cache: jnp.ndarray,  # (B, H, M, dv)
+    pos,  # (B,) int32
+    coeffs: jnp.ndarray,  # (S, H) float32
+) -> jnp.ndarray:
+    """Plain-XLA twin of :func:`decode_attention`: identical masking and
+    fp32 per-stream softmax, materialized maps — the un-fused baseline
+    (``decode_attention_impl == "xla"``) and the sweep/test oracle."""
+    S, B, H, M, d = k_cache.shape
+    scale = 1.0 / math.sqrt(d)
+    scores = (
+        jnp.einsum("sbhd,sbhmd->sbhm", qs, k_cache).astype(jnp.float32)
+        * scale
+    )
+    visible = jnp.arange(M)[None, :] <= jnp.asarray(pos, jnp.int32)[:, None]
+    scores = jnp.where(visible[None, :, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    combined = jnp.einsum("sh,sbhm->bhm", coeffs.astype(jnp.float32), probs)
+    return jnp.einsum("bhm,bhme->bhe", combined.astype(v_cache.dtype), v_cache)
+
+
+# ---------------------------------------------------------------------------
+# int8 weight quantization (load_params_for_inference satellite)
+# ---------------------------------------------------------------------------
+
+_QKV_KEYS = ("wq", "wk", "wv")
+
+
+def quantize_weight_int8(w: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Symmetric per-output-channel int8 quantize + dequantize of one
+    matmul weight: one fp32 scale per slice along the CONTRACTION
+    ``axis``, so every output channel keeps its own dynamic range.
+    Returns the dequantized weight in the input dtype (the int8 form is
+    transient — "dequant-on-load")."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.round(wf / scale).astype(jnp.int8)
+    return (q.astype(jnp.float32) * scale).astype(w.dtype)
+
+
+def quantize_params_int8(params: dict) -> dict:
+    """Apply :func:`quantize_weight_int8` to every matmul weight in a
+    model params tree: the attention projections (``wq``/``wk``/``wv``,
+    contraction axis = the embedding axis) and every Linear ``w``
+    (attention out-proj, FFN gate/xform/out, lm head; contraction axis
+    0). Embeddings, norms, lambda vectors and biases pass through
+    untouched — quantizing those buys nothing (tiny) and costs accuracy
+    disproportionately."""
+
+    def walk(node, name=None):
+        if isinstance(node, dict):
+            return {k: walk(v, k) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v, name) for v in node)
+        if name in _QKV_KEYS:
+            # (E, H, d) or stacked (S, E, H, d): E is always axis -3
+            return quantize_weight_int8(node, axis=-3)
+        if name == "w" and getattr(node, "ndim", 0) == 2:
+            return quantize_weight_int8(node, axis=0)
+        return node
+
+    return walk(params)

@@ -259,7 +259,11 @@ def make_pipeline_loss(model_cfg: ModelConfig, mesh: Mesh):
                     h, blk, li, model_cfg, cos, sin, mask, r
                 )
                 if model_cfg.remat:
-                    fn = jax.checkpoint(fn)
+                    policy = common.resolve_remat_policy(
+                        model_cfg.remat_policy
+                    )
+                    kw = {} if policy is None else {"policy": policy}
+                    fn = jax.checkpoint(fn, **kw)
                 return fn(h, blk), None
 
             h, _ = jax.lax.scan(

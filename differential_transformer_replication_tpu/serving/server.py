@@ -859,6 +859,22 @@ def main() -> None:
     p.add_argument("--prefill-chunk", type=int, default=128)
     p.add_argument("--prefill-budget", type=int, default=256)
     p.add_argument("--max-seq-len", type=int, default=0)
+    p.add_argument("--decode-attention-impl", default="",
+                   choices=("", "xla", "pallas"),
+                   help="decode attention backend: the fused Pallas "
+                        "single-query kernel (ops/decode_attention.py) "
+                        "or plain XLA; '' keeps the model config")
+    p.add_argument("--kv-cache-dtype", default="",
+                   choices=("", "auto", "bf16", "int8"),
+                   help="KV-cache storage dtype; int8 stores per-head-"
+                        "scale quantized K/V — about half the bf16 HBM "
+                        "bytes per slot, so ~2x slot capacity at equal "
+                        "memory; '' keeps the model config")
+    p.add_argument("--quantize-weights", default=None,
+                   choices=("int8",),
+                   help="per-channel int8 quantize + dequant of every "
+                        "matmul weight at checkpoint load "
+                        "(tolerance-gated accuracy)")
     p.add_argument("--max-queue-len", type=int, default=0,
                    help="reject (HTTP 503) submissions past this many "
                         "waiting requests; 0 = unbounded")
@@ -920,7 +936,8 @@ def main() -> None:
         )
 
         params, model_cfg, meta = load_params_for_inference(
-            args.checkpoint, verify=not args.no_verify_checkpoint
+            args.checkpoint, verify=not args.no_verify_checkpoint,
+            quantize=args.quantize_weights,
         )
     else:
         from differential_transformer_replication_tpu.models import init_model
@@ -929,7 +946,14 @@ def main() -> None:
             model=args.model, vocab_size=512, n_embd=64, n_head=2,
             n_layer=2, block_size=128, compute_dtype="float32",
         )
-        params = init_model(jax.random.PRNGKey(0), model_cfg)
+        from differential_transformer_replication_tpu.train.checkpoint import (
+            apply_weight_quantization,
+        )
+
+        params = apply_weight_quantization(
+            init_model(jax.random.PRNGKey(0), model_cfg),
+            args.quantize_weights,
+        )
         print("[serve] no checkpoint given: random-init demo model")
 
     tokenizer = None
@@ -952,6 +976,8 @@ def main() -> None:
     serving = ServingConfig(
         num_slots=args.num_slots, prefill_chunk=args.prefill_chunk,
         prefill_budget=args.prefill_budget, max_seq_len=args.max_seq_len,
+        decode_attention_impl=args.decode_attention_impl,
+        kv_cache_dtype=args.kv_cache_dtype,
         max_queue_len=args.max_queue_len,
         default_deadline_s=args.default_deadline,
         drain_timeout_s=args.drain_timeout,

@@ -369,7 +369,7 @@ def read_meta(path: str) -> dict:
 
 
 def load_params_for_inference(
-    path: str, verify: bool = True,
+    path: str, verify: bool = True, quantize: Optional[str] = None,
 ) -> Tuple[dict, ModelConfig, dict]:
     """Load a TRAINING checkpoint dir (meta.json + state.msgpack) for
     inference-only use: returns (params, resolved ModelConfig, meta).
@@ -385,11 +385,19 @@ def load_params_for_inference(
     integrity manifest before serving the weights (corrupt weights in
     production are worse than a startup error); ``verify=False`` is
     the escape hatch for pre-manifest checkpoints (or certify them
-    once with ``tools/ckpt_doctor.py --adopt-legacy``)."""
+    once with ``tools/ckpt_doctor.py --adopt-legacy``).
+
+    ``quantize="int8"`` applies per-channel symmetric int8
+    quantize-then-dequantize to every matmul weight on load
+    (ops/decode_attention.py:``quantize_params_int8`` — the
+    ``--quantize-weights`` flag on sample.py / serving.server);
+    embeddings, norms and lambda vectors stay exact. Tolerance-gated
+    in tests/test_decode_attention.py."""
     from differential_transformer_replication_tpu.train.step import (
         create_train_state,
     )
 
+    _validate_quantize(quantize)
     meta = read_meta(path)
     try:
         saved = meta["config"]
@@ -413,7 +421,33 @@ def load_params_for_inference(
         lambda: create_train_state(jax.random.PRNGKey(0), cfg)
     )
     state, _ = load_checkpoint(path, cfg, target, verify=verify)
-    return state["params"], cfg.resolved_model(), meta
+    params = apply_weight_quantization(state["params"], quantize)
+    return params, cfg.resolved_model(), meta
+
+
+def _validate_quantize(quantize: Optional[str]) -> None:
+    if quantize not in ("int8", None, "", "none"):
+        raise ValueError(
+            f"unsupported weight quantization {quantize!r}; expected "
+            "'int8' or None"
+        )
+
+
+def apply_weight_quantization(params: dict, quantize: Optional[str]) -> dict:
+    """The one place the ``--quantize-weights`` option is interpreted:
+    validates ``quantize`` and returns ``params`` with per-channel int8
+    quantize-then-dequantize applied to every matmul weight (or
+    untouched for None/""/"none"). Shared by every inference load path
+    — :func:`load_params_for_inference`, :func:`from_pretrained`, and
+    the serving server's random-init demo model."""
+    _validate_quantize(quantize)
+    if quantize == "int8":
+        from differential_transformer_replication_tpu.ops.decode_attention import (
+            quantize_params_int8,
+        )
+
+        params = quantize_params_int8(params)
+    return params
 
 
 def save_pretrained(path: str, params: dict, model_cfg: ModelConfig) -> None:
@@ -426,11 +460,16 @@ def save_pretrained(path: str, params: dict, model_cfg: ModelConfig) -> None:
         json.dump({"model_args": dataclasses.asdict(model_cfg)}, f, indent=1)
 
 
-def from_pretrained(path: str) -> Tuple[dict, ModelConfig]:
-    """Rebuild config + params (Ndiff_transformer.py:243-249)."""
+def from_pretrained(
+    path: str, quantize: Optional[str] = None,
+) -> Tuple[dict, ModelConfig]:
+    """Rebuild config + params (Ndiff_transformer.py:243-249).
+
+    ``quantize`` has :func:`load_params_for_inference` semantics
+    (:func:`apply_weight_quantization`)."""
     with open(os.path.join(path, "config.json")) as f:
         model_cfg = ModelConfig(**json.load(f)["model_args"])
     target = init_model(jax.random.PRNGKey(0), model_cfg)
     with open(os.path.join(path, "params.msgpack"), "rb") as f:
         params = serialization.from_bytes(target, f.read())
-    return params, model_cfg
+    return apply_weight_quantization(params, quantize), model_cfg

@@ -40,6 +40,22 @@ def main() -> None:
                    help="skip integrity-manifest verification (needed "
                         "for pre-manifest checkpoints; or certify them "
                         "once with tools/ckpt_doctor.py --adopt-legacy)")
+    p.add_argument("--decode-attention-impl", default="",
+                   choices=("", "xla", "pallas"),
+                   help="decode attention backend for the KV-cached "
+                        "path: the fused Pallas single-query kernel "
+                        "(ops/decode_attention.py) or plain XLA; '' "
+                        "keeps the checkpoint's model config")
+    p.add_argument("--kv-cache-dtype", default="",
+                   choices=("", "auto", "bf16", "int8"),
+                   help="KV-cache storage dtype; int8 = per-head-scale "
+                        "quantized K/V (half the bf16 bytes per "
+                        "sequence); '' keeps the checkpoint's config")
+    p.add_argument("--quantize-weights", default=None,
+                   choices=("int8",),
+                   help="per-channel int8 quantize + dequant of every "
+                        "matmul weight on load (tolerance-gated "
+                        "accuracy; embeddings/norms stay exact)")
     args = p.parse_args()
 
     from differential_transformer_replication_tpu.data.tokenizer import (
@@ -56,12 +72,21 @@ def main() -> None:
 
     fp = None  # save_pretrained dirs carry no meta.json / fingerprint
     if os.path.exists(os.path.join(args.checkpoint, "params.msgpack")):
-        params, model_cfg = from_pretrained(args.checkpoint)
+        params, model_cfg = from_pretrained(
+            args.checkpoint, quantize=args.quantize_weights,
+        )
     else:
         params, model_cfg, meta = load_params_for_inference(
-            args.checkpoint, verify=not args.no_verify_checkpoint
+            args.checkpoint, verify=not args.no_verify_checkpoint,
+            quantize=args.quantize_weights,
         )
         fp = meta.get("tokenizer_fingerprint")
+    if args.decode_attention_impl:
+        model_cfg = model_cfg.replace(
+            decode_attention_impl=args.decode_attention_impl
+        )
+    if args.kv_cache_dtype:
+        model_cfg = model_cfg.replace(kv_cache_dtype=args.kv_cache_dtype)
 
     from differential_transformer_replication_tpu.data.tokenizer import (
         check_tokenizer_matches,

@@ -41,6 +41,16 @@ def main() -> None:
     p.add_argument("--n-head", type=int, default=8,
                    help="control at the reference's head-doubled width")
     p.add_argument("--block-size", type=int, default=512)
+    p.add_argument("--decode-attention-impl", default="xla",
+                   choices=("xla", "pallas"),
+                   help="decode attention backend for the cached path: "
+                        "the fused Pallas single-query kernel "
+                        "(ops/decode_attention.py) or the plain XLA "
+                        "composition")
+    p.add_argument("--kv-cache-dtype", default="auto",
+                   choices=("auto", "bf16", "int8"),
+                   help="KV-cache storage dtype; int8 = per-head-scale "
+                        "quantized K/V (half the bf16 bytes)")
     p.add_argument("--out", default=None)
     args = p.parse_args()
 
@@ -61,6 +71,8 @@ def main() -> None:
         n_head=args.n_head, n_layer=args.n_layer,
         block_size=args.block_size, dropout=0.0,
         compute_dtype="bfloat16",
+        decode_attention_impl=args.decode_attention_impl,
+        kv_cache_dtype=args.kv_cache_dtype,
     )
     params = init_model(jax.random.PRNGKey(0), cfg)
     rows = []
@@ -86,6 +98,8 @@ def main() -> None:
             row = {
                 "impl": name, "batch": B, "new_tokens": args.new_tokens,
                 "prompt_len": args.prompt_len, "model": args.model,
+                "decode_attention_impl": args.decode_attention_impl,
+                "kv_cache_dtype": args.kv_cache_dtype,
                 "tokens_per_sec": round(tps, 1), "wall_s": round(dt, 2),
             }
             rows.append(row)

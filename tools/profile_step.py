@@ -12,8 +12,10 @@ is the workflow that produced the step decompositions in BASELINE.md.
 families, the custom-kernel buckets, device-busy ms/step, compile count)
 so before/after MFU deltas are diffable in CI instead of eyeballed from
 text. The fused Pallas kernels get their own buckets: ``flash_attention``
-(ops/flash.py) and ``fused_ffn`` (ops/fused_ffn.py +
-ops/fused_norm_residual.py custom-call/fusion names).
+(ops/flash.py), ``fused_ffn`` (ops/fused_ffn.py +
+ops/fused_norm_residual.py custom-call/fusion names) and
+``decode_attention`` (ops/decode_attention.py ``_dattn_*`` serving
+kernels, when profiling a decode workload).
 
 The capture window runs inside ``RecompileSentinel(budget=0)`` exactly
 like bench.py's measured window: a profile of a RETRACING step would
@@ -41,11 +43,13 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 # Custom-kernel buckets for the grouped breakdown: XLA names Pallas
 # programs after the kernel function (custom-call/fusion metadata), so
-# substring membership is stable across jax versions. The fused bucket
-# is checked FIRST: its kernel names (_ffn_fwd_kernel, _addnorm_*) end
-# with the flash needle "_fwd_kernel", so flash-first would swallow
-# their time into flash_attention and under-report the fused work.
+# substring membership is stable across jax versions. The decode and
+# fused-FFN buckets are checked BEFORE flash: their kernel names
+# (_dattn_fwd_kernel, _ffn_fwd_kernel, _addnorm_*) end with the flash
+# needle "_fwd_kernel", so flash-first would swallow their time into
+# flash_attention and under-report the fused work.
 _KERNEL_BUCKETS = (
+    ("decode_attention", ("_dattn_",)),
     ("fused_ffn", ("_ffn_fwd", "_ffn_bwd", "_addnorm_",
                    "fused_ffn", "fused_norm", "fused_add_norm",
                    "_swiglu2", "_norm2", "_add_norm2")),

@@ -296,6 +296,16 @@ def main() -> None:
     p.add_argument("--num-slots", type=int, default=8)
     p.add_argument("--prefill-chunk", type=int, default=128)
     p.add_argument("--prefill-budget", type=int, default=256)
+    p.add_argument("--decode-attention-impl", default="",
+                   choices=("", "xla", "pallas"),
+                   help="serving-side override of the decode attention "
+                        "backend (ops/decode_attention.py fused kernel "
+                        "vs plain XLA); '' inherits the model config")
+    p.add_argument("--kv-cache-dtype", default="",
+                   choices=("", "auto", "bf16", "int8"),
+                   help="KV-cache storage dtype override: int8 stores "
+                        "per-head-scale quantized K/V (~half the bf16 "
+                        "bytes per slot); '' inherits the model config")
     p.add_argument("--min-prompt", type=int, default=16)
     p.add_argument("--max-prompt", type=int, default=128)
     p.add_argument("--new-tokens", type=int, default=64)
@@ -368,6 +378,9 @@ def main() -> None:
         ModelConfig,
         ServingConfig,
     )
+    from differential_transformer_replication_tpu.models.decode import (
+        kv_store_dtype,
+    )
     from differential_transformer_replication_tpu.serving import (
         DeadlineExceededError,
         EngineCrashError,
@@ -402,6 +415,8 @@ def main() -> None:
         prefill_budget=args.prefill_budget,
         max_queue_len=args.max_queue_len,
         default_deadline_s=args.deadline,
+        decode_attention_impl=args.decode_attention_impl,
+        kv_cache_dtype=args.kv_cache_dtype,
         # let RoPE families roll past block_size so a full-window prompt
         # plus new_tokens always fits (the diff family ignores this and
         # stays hard-capped at block_size)
@@ -627,6 +642,10 @@ def main() -> None:
         "trace_dir": args.trace_dir,
         "compiles_in_window": sentinel.count,
         "model": model_cfg.model,
+        # resolved from the ENGINE's config (serving-side overrides
+        # applied) so the JSON names what actually ran
+        "decode_attention_impl": engine.cfg.decode_attention_impl,
+        "kv_cache_dtype": kv_store_dtype(engine.cfg),
         "num_slots": serving.num_slots,
         "clients": args.clients,
         "prefill_chunk": serving.prefill_chunk,
