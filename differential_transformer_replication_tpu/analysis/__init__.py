@@ -23,6 +23,7 @@ from differential_transformer_replication_tpu.analysis.lint import (
     Finding,
     LintResult,
     lint_paths,
+    to_sarif,
 )
 from differential_transformer_replication_tpu.analysis.rules import (
     RULES,
@@ -45,7 +46,7 @@ _LAZY = {
 }
 
 __all__ = [
-    "Finding", "LintResult", "lint_paths", "Rule", "RULES",
+    "Finding", "LintResult", "lint_paths", "to_sarif", "Rule", "RULES",
     "RULES_BY_ID", *sorted(_LAZY),
 ]
 

@@ -1,15 +1,34 @@
 """The ``graftlint`` command line: lint paths, report, gate CI.
 
-Exit status: 0 when no ACTIVE (unsuppressed) findings, 1 otherwise,
-2 on usage errors. ``--json`` prints one machine-parseable JSON object
-(stable key order, findings sorted by path/line/rule) — what
-tests/test_lint_clean.py and any CI gate consume. Suppressed findings
-are reported either way so a suppression stays an auditable decision.
+Exit status: 0 when no ACTIVE (unsuppressed) error-severity findings,
+1 otherwise, 2 on usage errors. Warn-severity findings (GL503) are
+reported but never flip the exit code.
+
+Output formats (``--format``, default ``text``):
+
+- ``json`` (alias ``--json``): one machine-parseable JSON object
+  (stable key order, findings sorted by path/line/rule) — what
+  tests/test_lint_clean.py and any CI gate consume.
+- ``sarif``: a SARIF 2.1.0 document so CI (GitHub code scanning and
+  friends) can annotate findings inline — schema-pinned and
+  deterministic exactly like the JSON.
+
+``--changed <ref>`` lints only files modified vs a git ref (committed,
+staged, working-tree, or untracked) while the call graph is still
+built from the WHOLE tree, so cross-module jit-region reachability and
+axis environments stay sound — pre-commit latency stays flat as the
+tree grows. Parse errors anywhere still fail the gate (an unparseable
+file is silently rule-exempt no matter which files changed).
+
+Suppressed findings are reported either way so a suppression stays an
+auditable decision.
 
 Examples::
 
     graftlint differential_transformer_replication_tpu/
     graftlint --json pkg/ | python -m json.tool
+    graftlint --format sarif pkg/ > graftlint.sarif
+    graftlint --changed origin/main pkg/
     graftlint --rules GL101,GL202 pkg/train/trainer.py
     graftlint --list-rules
 """
@@ -19,12 +38,15 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Set
 
 from differential_transformer_replication_tpu.analysis.lint import (
+    DEFAULT_VMEM_BUDGET_MIB,
     _iter_py_files,
     lint_paths,
+    to_sarif,
 )
 from differential_transformer_replication_tpu.analysis.rules import (
     RULES,
@@ -33,29 +55,80 @@ from differential_transformer_replication_tpu.analysis.rules import (
 )
 
 
+def _git_changed_files(ref: str, anchor: str) -> Optional[Set[str]]:
+    """Absolute paths of files changed vs ``ref`` in the repo that
+    contains ``anchor``: committed+staged+working diffs plus untracked
+    files (a brand-new hazard file must not dodge a changed-files
+    gate). None when git fails (caller reports the usage error)."""
+    anchor_dir = anchor if os.path.isdir(anchor) else os.path.dirname(anchor)
+    try:
+        top = subprocess.run(
+            ["git", "-C", anchor_dir or ".", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        diff = subprocess.run(
+            ["git", "-C", top, "diff", "--name-only", ref, "--"],
+            capture_output=True, text=True, check=True,
+        ).stdout.splitlines()
+        untracked = subprocess.run(
+            ["git", "-C", top, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, check=True,
+        ).stdout.splitlines()
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    # realpath on BOTH sides of the later comparison: git reports the
+    # PHYSICAL toplevel, while lint paths may reach the repo through a
+    # symlink — abspath-vs-physical mismatch would silently filter
+    # every finding and pass the gate
+    return {
+        os.path.realpath(os.path.join(top, rel))
+        for rel in diff + untracked if rel.strip()
+    }
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="graftlint",
         description="JAX hazard linter: host syncs, impure jit regions, "
-                    "recompile triggers, missing donation, serving lock "
-                    "discipline. Rule catalog: ANALYSIS.md.",
+                    "recompile triggers, missing donation, collective/"
+                    "sharding discipline, Pallas kernel checks, lock-order "
+                    "analysis. Rule catalog: ANALYSIS.md.",
     )
     p.add_argument("paths", nargs="*",
                    help="files or directories to lint")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default=None, dest="fmt",
+                   help="output format (default: text)")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="machine-parseable JSON report on stdout")
+                   help="alias for --format json")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule ids/names to run "
                         "(default: all)")
+    p.add_argument("--changed", default=None, metavar="REF",
+                   help="report findings only for files changed vs this "
+                        "git ref (call graph still spans the whole tree)")
+    p.add_argument("--vmem-budget", type=float,
+                   default=DEFAULT_VMEM_BUDGET_MIB, metavar="MIB",
+                   help="GL503 VMEM footprint budget in MiB "
+                        f"(default {DEFAULT_VMEM_BUDGET_MIB:g})")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print suppressed findings in text mode")
     args = p.parse_args(argv)
 
+    if args.fmt and args.as_json and args.fmt != "json":
+        print("graftlint: error: --json conflicts with "
+              f"--format {args.fmt}", file=sys.stderr)
+        return 2
+    fmt = args.fmt or ("json" if args.as_json else "text")
+
     if args.list_rules:
         for r in RULES:
-            print(f"{r.id} {r.name}\n    {r.summary}\n    hint: {r.hint}")
+            sev = "" if r.severity == "error" else f" [{r.severity}]"
+            print(f"{r.id} {r.name}{sev}\n    {r.summary}\n"
+                  f"    hint: {r.hint}")
         return 0
     if not args.paths:
         p.print_usage(sys.stderr)
@@ -94,10 +167,37 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   file=sys.stderr)
             return 2
         enumerated.extend(found)
-    result = lint_paths(args.paths, rules=rules, files=enumerated)
 
-    if args.as_json:
-        print(json.dumps(result.as_dict(), sort_keys=False))
+    changed_abs: Optional[Set[str]] = None
+    if args.changed is not None:
+        changed_abs = _git_changed_files(args.changed, args.paths[0])
+        if changed_abs is None:
+            print(f"graftlint: error: git diff against {args.changed!r} "
+                  "failed (not a git checkout, or unknown ref)",
+                  file=sys.stderr)
+            return 2
+
+    result = lint_paths(args.paths, rules=rules, files=enumerated,
+                        vmem_budget_mib=args.vmem_budget)
+
+    if changed_abs is not None:
+        # filter by the lint enumeration's ABSOLUTE paths (display
+        # relpaths keep only one parent component and may collide)
+        keep_rel = {
+            rel for full, rel, _mod in enumerated
+            if os.path.realpath(full) in changed_abs
+        }
+        result.findings = [
+            f for f in result.findings if f.path in keep_rel
+        ]
+
+    if fmt == "json":
+        doc = result.as_dict()
+        if args.changed is not None:
+            doc["changed_vs"] = args.changed
+        print(json.dumps(doc, sort_keys=False))
+    elif fmt == "sarif":
+        print(json.dumps(to_sarif(result), sort_keys=False))
     else:
         shown = (
             result.findings if args.show_suppressed else result.active
@@ -108,16 +208,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"{rel}: parse error — file skipped (every rule "
                   "silently exempt)", file=sys.stderr)
         n_sup = len(result.findings) - len(result.active)
+        n_warn = len(result.active) - len(result.gating)
         print(
             f"graftlint: {result.files_scanned} files, "
             f"{result.jit_regions} jit-region functions, "
-            f"{len(result.active)} finding(s)"
+            f"{len(result.gating)} finding(s)"
+            + (f" (+{n_warn} warning)" if n_warn else "")
             + (f" (+{n_sup} suppressed)" if n_sup else "")
             + (f", {len(result.parse_errors)} parse error(s)"
                if result.parse_errors else ""),
             file=sys.stderr,
         )
-    return 1 if result.active or result.parse_errors else 0
+    return 1 if result.gating or result.parse_errors else 0
 
 
 if __name__ == "__main__":

@@ -35,6 +35,33 @@ How a file is analyzed:
    (``if cfg.dropout > 0``) stay clean. Parameters named by a constant
    ``static_argnums``/``static_argnames`` on the jit decorator or call
    site are not seeded at all.
+6. **Interprocedural edges** (the machinery under the GL4xx/5xx/6xx
+   families). Beyond direct calls, the graph follows: *maker
+   variables* (``step = make_step_fn(cfg)`` then ``step(...)`` calls
+   the maker's returned local defs); *function-valued parameters*
+   (``make_step_fn(cfg, loss_sync=lambda l: ...)`` — a call to
+   ``loss_sync`` anywhere inside the maker's scope chain resolves to
+   the lambda bound at each call site); ``<fn>.defvjp(fwd, bwd)``
+   (the VJP pair executes wherever the primal does); lambdas passed
+   as call arguments; and functions whose parameter is handed to a
+   tracing transform inside their body (``utils/compat.shard_map``'s
+   ``f`` — so every wrapped body is discovered through the wrapper).
+7. **Axis environments.** ``shard_map``/``pmap`` bodies *bind* mesh
+   axis names (``pmap`` binds its literal ``axis_name``; ``shard_map``
+   binds the wildcard ``*`` — the mesh's axes are runtime values).
+   The environment propagates along the edge graph; a named-axis
+   collective in a function no binder reaches is GL401. Branch arms
+   of ``lax.cond``/``switch``/``while_loop`` propagate the same way
+   for GL402, and ``pallas_call`` kernels / BlockSpec index_maps form
+   *kernel regions* for the GL5xx checks (impure calls in a kernel
+   report GL504, not GL103).
+8. **Lock-order graph** (GL6xx). Per class owning a ``threading``
+   lock, acquisitions are ``with self.<lock>`` / ``.acquire()``;
+   while a lock is held, a directed edge is drawn to every lock
+   acquired inside the block — directly, through same-class method
+   calls, or through methods of attributes whose class the engine can
+   resolve (``self.x = SomeClass(...)`` in ``__init__``). A cycle is
+   GL601; blocking calls under a held lock are GL602.
 
 The engine deliberately under-approximates (no interprocedural taint,
 no aliasing): a finding means "this exact expression does the hazardous
@@ -97,6 +124,42 @@ _STEP_CALL_RE = re.compile(r"(^|_)step$")
 
 _LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition", "Semaphore",
                              "BoundedSemaphore"})
+_EVENT_FACTORIES = frozenset({"Event"})
+_COND_FACTORIES = frozenset({"Condition"})
+_QUEUE_FACTORIES = frozenset({"Queue", "SimpleQueue", "LifoQueue",
+                              "PriorityQueue"})
+
+# GL4xx: named-axis collectives (communicate across shards) and
+# axis-environment queries (need a binding, but never deadlock)
+_COLLECTIVE_OPS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+    "pshuffle", "all_to_all", "psum_scatter",
+})
+_AXIS_QUERY_OPS = frozenset({"axis_index", "axis_size"})
+
+# transforms that BIND mesh axis names for their body
+_BINDING_TRANSFORMS = frozenset({"shard_map", "pmap", "xmap"})
+# transforms whose function args run under a traced predicate (GL402)
+_BRANCH_TRANSFORMS = frozenset({"cond", "switch", "while_loop"})
+
+# GL503: dtype byte widths the estimator understands
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1, "bool_": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+_SUB_FP32_FLOATS = frozenset({"bfloat16", "float16"})
+
+# GL602: dotted-call prefixes that block the calling thread
+_BLOCKING_PREFIXES = (
+    "time.sleep", "urllib.request.", "http.client.", "socket.",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen", "requests.",
+)
+
+DEFAULT_VMEM_BUDGET_MIB = 16.0
 
 
 @dataclass
@@ -107,6 +170,7 @@ class Finding:
     message: str
     hint: str
     suppressed: bool = False
+    severity: str = "error"
 
     @property
     def name(self) -> str:
@@ -116,12 +180,15 @@ class Finding:
     def as_dict(self) -> dict:
         return {
             "path": self.path, "line": self.line, "rule": self.rule,
-            "name": self.name, "message": self.message, "hint": self.hint,
+            "name": self.name, "severity": self.severity,
+            "message": self.message, "hint": self.hint,
             "suppressed": self.suppressed,
         }
 
     def render(self) -> str:
-        tag = " (suppressed)" if self.suppressed else ""
+        tag = " (suppressed)" if self.suppressed else (
+            " (warning)" if self.severity == "warning" else ""
+        )
         return (f"{self.path}:{self.line}: {self.rule} [{self.name}]"
                 f"{tag}: {self.message}\n    hint: {self.hint}")
 
@@ -184,14 +251,23 @@ class _Func:
     parent: Optional["_Func"]
     cls: Optional[str] = None  # enclosing class name, for self.* calls
     local_defs: Dict[str, "_Func"] = field(default_factory=dict)
-    calls: List[Tuple[str, int]] = field(default_factory=list)
     is_root: bool = False
     returns_jitted_probe: bool = False
     static_params: Set[str] = field(default_factory=set)
+    # interprocedural machinery (PR 11): local names holding functions
+    # ("func" -> the named defs; "maker" -> a maker whose RETURNED local
+    # defs the name calls through)
+    var_targets: Dict[str, List[Tuple[str, "_Func"]]] = field(
+        default_factory=dict
+    )
 
     @property
     def key(self) -> Tuple[str, str]:
         return (self.module.modname, self.qualname)
+
+    def all_params(self) -> List[str]:
+        a = self.node.args  # FunctionDef and Lambda expose .args alike
+        return [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
 
 
 @dataclass
@@ -284,13 +360,7 @@ class _FuncCollector(ast.NodeVisitor):
 
     def visit_Lambda(self, node: ast.Lambda) -> None:
         self._visit_func(node, f"<lambda:{node.lineno}>")
-
-    def visit_Call(self, node: ast.Call) -> None:
-        if self.stack:
-            name = _dotted(node.func)
-            if name:
-                self.stack[-1].calls.append((name, node.lineno))
-        self.generic_visit(node)
+    # call edges are collected by the graph builder's EdgeVisitor
 
 
 def _load_module(path: str, relpath: str, modname: str) -> Optional[_Mod]:
@@ -376,7 +446,9 @@ def _mark_roots(mods: Dict[str, _Mod]) -> None:
             for dec in node.decorator_list:
                 d = dec.func if isinstance(dec, ast.Call) else dec
                 name = _dotted(d)
-                if _is_tracing_transform(name):
+                if _is_tracing_transform(name) or _alias_transform_last(
+                    mod, name
+                ):
                     fn.is_root = True
                     if isinstance(dec, ast.Call):
                         _collect_static_params(fn, dec.keywords)
@@ -413,7 +485,9 @@ def _mark_roots(mods: Dict[str, _Mod]) -> None:
 
             def visit_Call(self, node: ast.Call):
                 name = _dotted(node.func)
-                if _is_tracing_transform(name):
+                if _is_tracing_transform(name) or _alias_transform_last(
+                    mod, name
+                ):
                     scope = self.stack[-1]
                     for arg in list(node.args) + [
                         kw.value for kw in node.keywords
@@ -517,24 +591,444 @@ def _resolve_call(
     return _resolve_dotted_func(full, mods)
 
 
-def _reachable_jit_regions(mods: Dict[str, _Mod]) -> Set[Tuple[str, str]]:
-    # `from mod import f` aliases: imports map may point directly at a
-    # function (pkg.mod.f) — _resolve_call handles both layouts
-    work: List[_Func] = [
-        f for m in mods.values() for f in m.funcs if f.is_root
-    ]
-    seen: Set[Tuple[str, str]] = {f.key for f in work}
-    by_key = {
-        f.key: f for m in mods.values() for f in m.funcs
-    }
+def _alias_transform_last(mod: _Mod, name: Optional[str]) -> Optional[str]:
+    """The tracing transform's SHORT name when ``name`` — as written, or
+    resolved through the module's import aliases — names one; None
+    otherwise. The alias path accepts jax-rooted resolutions and this
+    repo's compat re-exports (``from utils.compat import shard_map as
+    _shard_map`` must still read as shard_map)."""
+    if not name:
+        return None
+    resolved = _call_dotted_resolved(mod, name)
+    for cand in (name, resolved):
+        last = cand.split(".")[-1]
+        if last not in _TRACING_TRANSFORMS:
+            continue
+        parts = cand.split(".")
+        if parts[0] in _TRACING_TRANSFORMS or parts[0] in (
+            "jax", "lax", "jnp", "pjit", "functools"
+        ):
+            return last
+        if cand is not name and ("jax" in parts or "compat" in parts):
+            return last
+    return None
+
+
+def _resolve_call_any(
+    scope: Optional[_Func], mod: _Mod, name: str, mods: Dict[str, _Mod]
+) -> Optional[_Func]:
+    """:func:`_resolve_call` that also works at module level (no
+    enclosing function)."""
+    if scope is not None:
+        return _resolve_call(scope, name, mods)
+    if "." not in name:
+        fn = mod.top_defs.get(name)
+        if fn is not None:
+            return fn
+        alias = mod.imports.get(name)
+        return _resolve_dotted_func(alias, mods) if alias else None
+    head, _, rest = name.partition(".")
+    dotted_head = mod.imports.get(head)
+    if dotted_head is None:
+        return None
+    return _resolve_dotted_func(
+        f"{dotted_head}.{rest}" if rest else dotted_head, mods
+    )
+
+
+def _returned_defs(mk: _Func, depth: int = 0) -> List[_Func]:
+    """The local functions a maker returns — what a call THROUGH the
+    maker's result actually runs (``step = make_step_fn(cfg)``)."""
+    if depth > 4 or isinstance(mk.node, ast.Lambda):
+        return []
+    out: List[_Func] = []
+    for node in ast.walk(mk.node):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+            t = mk.local_defs.get(node.value.id)
+            if t is not None:
+                out.append(t)
+                continue
+            for kind, f in _iter_var_targets(mk, node.value.id):
+                if kind == "func":
+                    out.append(f)
+                else:
+                    out.extend(_returned_defs(f, depth + 1))
+    return out
+
+
+def _iter_var_targets(fn: _Func, name: str):
+    """Pre-resolved ("func"|"maker", _Func) pairs a local variable may
+    hold (populated by the graph builder's var pass)."""
+    return list(fn.var_targets.get(name, []))
+
+
+@dataclass
+class _PallasSite:
+    mod: _Mod
+    fn: Optional[_Func]
+    node: ast.Call
+    kernels: List[_Func]
+
+
+@dataclass
+class _Pending:
+    # (owner_key, param) -> functions that CALL that parameter
+    param_calls: Dict[Tuple[Tuple[str, str], str], List[_Func]] = field(
+        default_factory=dict
+    )
+    # wrapper idiom: ((owner_key, param), transform_last, axes)
+    transform_params: List[
+        Tuple[Tuple[Tuple[str, str], str], str, Set[str]]
+    ] = field(default_factory=list)
+    # every resolved direct call: (caller_or_None, mod, callee, node)
+    call_sites: List[
+        Tuple[Optional[_Func], _Mod, _Func, ast.Call]
+    ] = field(default_factory=list)
+
+
+@dataclass
+class _Graph:
+    by_key: Dict[Tuple[str, str], _Func]
+    edges: Dict[Tuple[str, str], Set[Tuple[str, str]]]
+    binder_axes: Dict[Tuple[str, str], Set[str]]
+    arm_seeds: Set[Tuple[str, str]]
+    kernel_seeds: List[Tuple[_Func, Optional[_Func]]]
+    pallas_sites: List[_PallasSite]
+
+    def add_edge(self, src: Optional[_Func], dst: Optional[_Func]) -> None:
+        if src is None or dst is None or src is dst:
+            return
+        self.edges.setdefault(src.key, set()).add(dst.key)
+
+
+def _build_graph(mods: Dict[str, _Mod]) -> _Graph:
+    """The interprocedural edge graph: direct calls plus maker
+    variables, function-valued parameter bindings, ``defvjp`` pairs,
+    lambda call-arguments, transform wrapper parameters, pallas
+    kernels, and BlockSpec index_maps (module docstring, step 6)."""
+    g = _Graph(
+        by_key={f.key: f for m in mods.values() for f in m.funcs},
+        edges={}, binder_axes={}, arm_seeds=set(), kernel_seeds=[],
+        pallas_sites=[],
+    )
+    pending = _Pending()
+    lambda_funcs: Dict[int, _Func] = {}
+    for m in mods.values():
+        for f in m.funcs:
+            if isinstance(f.node, ast.Lambda):
+                lambda_funcs[id(f.node)] = f
+
+    # -- pass 1: variable -> function candidates per scope ------------
+    class VarCollector(ast.NodeVisitor):
+        def __init__(self, mod: _Mod) -> None:
+            self.mod = mod
+            self.stack: List[Optional[_Func]] = [None]
+
+        def _push(self, node):
+            owner = next((f for f in self.mod.funcs if f.node is node), None)
+            self.stack.append(owner)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _push
+
+        def visit_Assign(self, node: ast.Assign):
+            self.generic_visit(node)
+            if len(node.targets) != 1 or not isinstance(
+                node.targets[0], ast.Name
+            ):
+                return
+            owner = self.stack[-1]
+            if owner is None:
+                return  # module-level function vars: top_defs covers defs
+            owner.var_targets.setdefault(
+                node.targets[0].id, []
+            ).extend(self._classify(node.value, owner, 0))
+
+        def _classify(self, value, owner, depth):
+            if depth > 4:
+                return []
+            if isinstance(value, ast.Lambda):
+                f = lambda_funcs.get(id(value))
+                return [("func", f)] if f is not None else []
+            if isinstance(value, ast.IfExp):
+                return (self._classify(value.body, owner, depth + 1)
+                        + self._classify(value.orelse, owner, depth + 1))
+            if isinstance(value, (ast.Name, ast.Attribute)):
+                name = _dotted(value)
+                if not name:
+                    return []
+                t = _resolve_call_any(owner, self.mod, name, mods)
+                return [("func", t)] if t is not None else []
+            if isinstance(value, ast.Call):
+                name = _dotted(value.func)
+                if name and _alias_transform_last(self.mod, name):
+                    # jitted = jax.jit(f) / sharded = shard_map(raw, ...):
+                    # calling the variable runs the wrapped function
+                    out = []
+                    for a in list(value.args) + [
+                        k.value for k in value.keywords
+                    ]:
+                        out.extend(self._classify(a, owner, depth + 1))
+                    return out
+                mk = (
+                    _resolve_call_any(owner, self.mod, name, mods)
+                    if name else None
+                )
+                return [("maker", mk)] if mk is not None else []
+            return []
+
+    for m in mods.values():
+        VarCollector(m).visit(m.tree)
+
+    # -- shared expression -> functions resolver ----------------------
+    def funcs_from_expr(expr, scope, mod, depth=0) -> List[_Func]:
+        if expr is None or depth > 6:
+            return []
+        if isinstance(expr, ast.Lambda):
+            f = lambda_funcs.get(id(expr))
+            return [f] if f is not None else []
+        if isinstance(expr, ast.IfExp):
+            return (funcs_from_expr(expr.body, scope, mod, depth + 1)
+                    + funcs_from_expr(expr.orelse, scope, mod, depth + 1))
+        if isinstance(expr, ast.Call):
+            name = _dotted(expr.func)
+            if name and name.split(".")[-1] == "partial" and expr.args:
+                return funcs_from_expr(expr.args[0], scope, mod, depth + 1)
+            out: List[_Func] = []
+            for mk in funcs_from_expr(expr.func, scope, mod, depth + 1):
+                out.extend(_returned_defs(mk))
+            return out
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            name = _dotted(expr)
+            if not name:
+                return []
+            direct = _resolve_call_any(scope, mod, name, mods)
+            if direct is not None:
+                return [direct]
+            if "." not in name:
+                cur = scope
+                while cur is not None:
+                    cands = _iter_var_targets(cur, name)
+                    if cands:
+                        out = []
+                        for kind, f in cands:
+                            if kind == "func":
+                                out.append(f)
+                            else:
+                                out.extend(_returned_defs(f))
+                        return out
+                    cur = cur.parent
+        return []
+
+    def param_of(owner: _Func, name: str):
+        cur = owner
+        while cur is not None:
+            if name in cur.all_params():
+                return (cur.key, name)
+            cur = cur.parent
+        return None
+
+    # -- pass 2: edges, seeds, sites ----------------------------------
+    class EdgeVisitor(ast.NodeVisitor):
+        def __init__(self, mod: _Mod) -> None:
+            self.mod = mod
+            self.stack: List[Optional[_Func]] = [None]
+
+        def _push(self, node):
+            owner = next((f for f in self.mod.funcs if f.node is node), None)
+            self.stack.append(owner)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _push
+
+        def _axes(self, tl: str, node: ast.Call) -> Set[str]:
+            if tl == "pmap":
+                for kw in node.keywords:
+                    if kw.arg == "axis_name" and isinstance(
+                        kw.value, ast.Constant
+                    ) and isinstance(kw.value.value, str):
+                        return {kw.value.value}
+            return {"*"}
+
+        def _mark(self, t: _Func, tl: str, axes: Set[str], owner) -> None:
+            # overlaps _mark_roots' RootVisitor on bare Name/Lambda args
+            # (that pass also owns static_argnums collection and the
+            # jit(make_step(cfg)) probe); this one adds IfExp/partial/
+            # var-held/list-literal resolution — the root sets UNION, so
+            # a resolver fix usually belongs here, a jit-semantics fix
+            # there
+            g.add_edge(owner, t)
+            t.is_root = True
+            if tl in _BINDING_TRANSFORMS:
+                g.binder_axes.setdefault(t.key, set()).update(axes)
+            if tl in _BRANCH_TRANSFORMS:
+                g.arm_seeds.add(t.key)
+
+        def visit_Call(self, node: ast.Call):
+            owner = self.stack[-1]
+            name = _dotted(node.func)
+            tl = _alias_transform_last(self.mod, name) if name else None
+            if tl and tl != "partial":
+                axes = self._axes(tl, node)
+                argexprs = list(node.args) + [
+                    k.value for k in node.keywords
+                ]
+                flat = []
+                for a in argexprs:
+                    flat.extend(
+                        a.elts if isinstance(a, (ast.List, ast.Tuple))
+                        else [a]
+                    )
+                for a in flat:
+                    targets = funcs_from_expr(a, owner, self.mod)
+                    if (not targets and isinstance(a, ast.Name)
+                            and owner is not None):
+                        pw = param_of(owner, a.id)
+                        if pw is not None:
+                            pending.transform_params.append((pw, tl, axes))
+                        continue
+                    for t in targets:
+                        self._mark(t, tl, axes, owner)
+            elif name and name.split(".")[-1] == "pallas_call":
+                kernels = (
+                    funcs_from_expr(node.args[0], owner, self.mod)
+                    if node.args else []
+                )
+                for k in kernels:
+                    g.kernel_seeds.append((k, owner))
+                    g.add_edge(owner, k)
+                g.pallas_sites.append(
+                    _PallasSite(self.mod, owner, node, kernels)
+                )
+            elif name and name.split(".")[-1] == "BlockSpec":
+                for a in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(a, ast.Lambda):
+                        f = lambda_funcs.get(id(a))
+                        if f is not None:
+                            g.kernel_seeds.append((f, owner))
+                            g.add_edge(owner, f)
+            elif (name and "." in name
+                  and name.split(".")[-1] in ("defvjp", "defjvp")):
+                for b in funcs_from_expr(node.func.value, owner, self.mod):
+                    for arg in node.args:
+                        for t in funcs_from_expr(arg, owner, self.mod):
+                            g.add_edge(b, t)
+            elif name:
+                callee = _resolve_call_any(owner, self.mod, name, mods)
+                if callee is not None:
+                    g.add_edge(owner, callee)
+                    pending.call_sites.append(
+                        (owner, self.mod, callee, node)
+                    )
+                elif "." not in name and owner is not None:
+                    targets = funcs_from_expr(
+                        node.func, owner, self.mod
+                    )
+                    if targets:
+                        for t in targets:
+                            g.add_edge(owner, t)
+                    else:
+                        pw = param_of(owner, name)
+                        if pw is not None:
+                            pending.param_calls.setdefault(
+                                pw, []
+                            ).append(owner)
+            # a lambda passed as ANY call argument runs inside the
+            # callee's dynamic extent; approximate with a caller edge
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(a, ast.Lambda):
+                    f = lambda_funcs.get(id(a))
+                    if f is not None:
+                        g.add_edge(owner, f)
+            self.generic_visit(node)
+
+    for m in mods.values():
+        EdgeVisitor(m).visit(m.tree)
+
+    # -- pass 3: resolve parameter bindings ---------------------------
+    def bindings_for(owner_fn: _Func, pname: str):
+        params = _positional_params(owner_fn.node)
+        for (scope, mod, callee, node) in pending.call_sites:
+            if callee is not owner_fn:
+                continue
+            offset = (
+                1 if params and params[0] == "self"
+                and isinstance(node.func, ast.Attribute) else 0
+            )
+            if pname in params:
+                argpos = params.index(pname) - offset
+                if 0 <= argpos < len(node.args):
+                    yield (scope, mod, node.args[argpos])
+            for kw in node.keywords:
+                if kw.arg == pname:
+                    yield (scope, mod, kw.value)
+
+    for (owner_key, pname), callers in sorted(
+        pending.param_calls.items(), key=lambda kv: (kv[0][0], kv[0][1])
+    ):
+        owner_fn = g.by_key.get(owner_key)
+        if owner_fn is None:
+            continue
+        for (scope, mod, expr) in bindings_for(owner_fn, pname):
+            for t in funcs_from_expr(expr, scope, mod):
+                for caller in callers:
+                    g.add_edge(caller, t)
+
+    for (owner_key, pname), tl, axes in pending.transform_params:
+        owner_fn = g.by_key.get(owner_key)
+        if owner_fn is None:
+            continue
+        for (scope, mod, expr) in bindings_for(owner_fn, pname):
+            for t in funcs_from_expr(expr, scope, mod):
+                t.is_root = True
+                g.add_edge(owner_fn, t)
+                if tl in _BINDING_TRANSFORMS:
+                    g.binder_axes.setdefault(t.key, set()).update(axes)
+                if tl in _BRANCH_TRANSFORMS:
+                    g.arm_seeds.add(t.key)
+
+    return g
+
+
+def _closure(
+    seeds, edges: Dict[Tuple[str, str], Set[Tuple[str, str]]],
+    stop: Set[Tuple[str, str]] = frozenset(),
+) -> Set[Tuple[str, str]]:
+    """Reachability from ``seeds``. Nodes in ``stop`` are reached but
+    not expanded — how the regular-jit closure avoids flowing THROUGH a
+    pallas kernel and claiming its private helpers for GL103."""
+    seen = set(seeds)
+    work = [k for k in seen if k not in stop]
     while work:
-        fn = work.pop()
-        for name, _line in fn.calls:
-            callee = _resolve_call(fn, name, mods)
-            if callee is not None and callee.key not in seen:
-                seen.add(callee.key)
-                work.append(callee)
-    return seen & set(by_key)
+        k = work.pop()
+        for n in edges.get(k, ()):
+            if n not in seen:
+                seen.add(n)
+                if n not in stop:
+                    work.append(n)
+    return seen
+
+
+def _env_closure(
+    binder_axes: Dict[Tuple[str, str], Set[str]],
+    edges: Dict[Tuple[str, str], Set[Tuple[str, str]]],
+) -> Dict[Tuple[str, str], Set[str]]:
+    """Axis environments: seeded at binder bodies, unioned along edges
+    to a fixpoint. A key's ABSENCE means "no binder reaches this
+    function" — the GL401 trigger."""
+    env = {k: set(v) for k, v in binder_axes.items()}
+    work = list(env)
+    while work:
+        k = work.pop()
+        for n in edges.get(k, ()):
+            cur = env.setdefault(n, set())
+            add = env[k] - cur
+            if add:
+                cur.update(add)
+                work.append(n)
+    return env
 
 
 # -- taint + jit-region rules -------------------------------------------
@@ -665,15 +1159,22 @@ def _weak_param_seeds(fn: _Func) -> Set[str]:
 
 class _JitRegionChecker(ast.NodeVisitor):
     """GL101-GL107 over one jit-region function body (nested function
-    bodies are their own jit regions and are skipped here)."""
+    bodies are their own jit regions and are skipped here). With
+    ``kernel=True`` the body is a Pallas kernel / index_map: the same
+    hazards apply, but impure calls report GL504 (impure-kernel) —
+    inside Mosaic lowering they are a different failure mode than a
+    trace-time freeze — and parameters are refs, never weak-seeded."""
 
     def __init__(self, fn: _Func, enabled: Set[str],
-                 emit) -> None:
+                 emit, kernel: bool = False) -> None:
         self.fn = fn
         self.mod = fn.module
         self.enabled = enabled
         self.emit = emit
-        self.taint = _Taint(fn.module, weak=_weak_param_seeds(fn))
+        self.kernel = kernel
+        self.impure_rule = "GL504" if kernel else "GL103"
+        weak = set() if kernel else _weak_param_seeds(fn)
+        self.taint = _Taint(fn.module, weak=weak)
         self.raise_depth = 0
         self._body_owner = fn.node
 
@@ -854,19 +1355,22 @@ class _JitRegionChecker(ast.NodeVisitor):
                 )
                 return
 
-        if "GL103" in self.enabled:
+        if self.impure_rule in self.enabled:
+            where = (
+                "Pallas kernel" if self.kernel else "jit region"
+            )
             if name in _IMPURE_BARE and name not in self.mod.top_defs:
                 self.emit(
-                    "GL103", node.lineno,
-                    f"impure call {name}() in jit region "
+                    self.impure_rule, node.lineno,
+                    f"impure call {name}() in {where} "
                     f"`{self.fn.qualname}`",
                 )
                 return
             for cand in {name, resolved}:
                 if any(cand.startswith(p) for p in _IMPURE_PREFIXES):
                     self.emit(
-                        "GL103", node.lineno,
-                        f"impure call {name}() in jit region "
+                        self.impure_rule, node.lineno,
+                        f"impure call {name}() in {where} "
                         f"`{self.fn.qualname}`",
                     )
                     return
@@ -876,8 +1380,8 @@ class _JitRegionChecker(ast.NodeVisitor):
                     "jax.random"
                 ):
                     self.emit(
-                        "GL103", node.lineno,
-                        f"host RNG call {name}() in jit region "
+                        self.impure_rule, node.lineno,
+                        f"host RNG call {name}() in {where} "
                         f"`{self.fn.qualname}`",
                     )
                     return
@@ -1035,6 +1539,799 @@ class _StepLoopChecker(ast.NodeVisitor):
                 f"jax.device_get() inside the step loop of "
                 f"`{self.fn.qualname}`",
             )
+
+
+# -- GL401/GL402/GL403: sharding + collective discipline ----------------
+
+
+def _axis_arg_literals(node: ast.Call, last: str) -> List[str]:
+    """Literal axis names named by a collective call, [] when the axis
+    expression is not statically a string (a threaded-in variable —
+    bound by construction at the binding site, so unknown = no check)."""
+    cand = None
+    if last in _AXIS_QUERY_OPS:
+        cand = node.args[0] if node.args else None
+    elif len(node.args) >= 2:
+        cand = node.args[1]
+    for kw in node.keywords:
+        # axis_name is THE name kwarg across lax collectives; `axis=`
+        # on all_gather/all_to_all is the ARRAY dimension (an int) and
+        # must not clobber the positional name candidate
+        if kw.arg == "axis_name":
+            cand = kw.value
+    if cand is None:
+        return []
+    if isinstance(cand, ast.Constant) and isinstance(cand.value, str):
+        return [cand.value]
+    if isinstance(cand, (ast.Tuple, ast.List)):
+        out = []
+        for e in cand.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return []  # partially dynamic: treat as unknown
+        return out
+    return []
+
+
+class _CollectiveChecker(ast.NodeVisitor):
+    """Runs on EVERY function — host or jit region — with the axis
+    environment (None = no shard_map/pmap binder reaches it) and the
+    branch-arm flag computed by the interprocedural closures."""
+
+    def __init__(self, fn: _Func, enabled: Set[str], emit,
+                 env: Optional[Set[str]], in_arm: bool) -> None:
+        self.fn = fn
+        self.mod = fn.module
+        self.enabled = enabled
+        self.emit = emit
+        self.env = env
+        self.in_arm = in_arm
+        self._body_owner = fn.node
+
+    def visit_FunctionDef(self, node):
+        if node is self._body_owner:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if node is self._body_owner:
+            self.visit(node.body)
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        name = _dotted(node.func)
+        if not name:
+            return
+        resolved = _call_dotted_resolved(self.mod, name)
+        jaxish = any(
+            c.split(".")[0] in ("jax", "lax") or c.startswith("jax.")
+            for c in (name, resolved)
+        )
+        if not jaxish:
+            return
+        last = name.split(".")[-1]
+        if last == "device_put":
+            if "GL403" in self.enabled and self.env is not None:
+                self.emit(
+                    "GL403", node.lineno,
+                    f"jax.device_put() inside the shard_map/pmap-bound "
+                    f"region `{self.fn.qualname}`",
+                )
+            return
+        if last not in _COLLECTIVE_OPS and last not in _AXIS_QUERY_OPS:
+            return
+        if "GL401" in self.enabled:
+            if self.env is None:
+                self.emit(
+                    "GL401", node.lineno,
+                    f"collective {name}() in `{self.fn.qualname}`, which "
+                    "no shard_map/pmap axis-binding context reaches",
+                )
+                return
+            if "*" not in self.env:
+                missing = [
+                    a for a in _axis_arg_literals(node, last)
+                    if a not in self.env
+                ]
+                if missing:
+                    self.emit(
+                        "GL401", node.lineno,
+                        f"collective {name}() names axis "
+                        f"{', '.join(repr(a) for a in missing)} not bound "
+                        f"by any reachable context (bound: "
+                        f"{', '.join(sorted(self.env)) or 'none'})",
+                    )
+                    return
+        if ("GL402" in self.enabled and self.in_arm
+                and last in _COLLECTIVE_OPS):
+            self.emit(
+                "GL402", node.lineno,
+                f"collective {name}() reachable from a lax.cond/switch/"
+                f"while_loop branch (`{self.fn.qualname}`) — shards "
+                "taking different branches deadlock",
+            )
+
+
+# -- GL5xx: pallas_call sites and kernel bodies -------------------------
+
+
+def _own_scope_nodes(fnnode):
+    """AST nodes within ONE function's own scope — nested defs/lambdas/
+    classes are separate scopes (their locals are not this scope's
+    constants, and their lock acquisitions happen when the closure runs
+    later, not here)."""
+    stack = [fnnode]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            yield child
+            stack.append(child)
+
+
+def _own_scope_assigns(fnnode) -> List[ast.stmt]:
+    """Assign/AugAssign statements in ONE function's own scope — a
+    sibling nested helper's `BM = 100` is not the call site's BM."""
+    return [
+        n for n in _own_scope_nodes(fnnode)
+        if isinstance(n, (ast.Assign, ast.AugAssign))
+    ]
+
+
+class _ConstEnv:
+    """Best-effort constant folding for pallas-site checks: module-level
+    single assignments plus the enclosing function chain's single
+    assignments (own scopes only). Reassigned names are poisoned
+    (unknown)."""
+
+    def __init__(self, mod: _Mod, fn: Optional[_Func]) -> None:
+        self.vals: Dict[str, ast.AST] = {}
+        self._poison: Set[str] = set()
+        self._feed(mod.tree.body)
+        chain: List[_Func] = []
+        cur = fn
+        while cur is not None:
+            chain.append(cur)
+            cur = cur.parent
+        for f in reversed(chain):
+            if not isinstance(f.node, ast.Lambda):
+                self._feed(_own_scope_assigns(f.node))
+
+    def _feed(self, stmts) -> None:
+        for st in stmts:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                n = st.targets[0].id
+                if n in self.vals or n in self._poison:
+                    self._poison.add(n)
+                    self.vals.pop(n, None)
+                else:
+                    self.vals[n] = st.value
+            elif isinstance(st, ast.AugAssign) and isinstance(
+                st.target, ast.Name
+            ):
+                self._poison.add(st.target.id)
+                self.vals.pop(st.target.id, None)
+
+    def int_of(self, node, depth: int = 0) -> Optional[int]:
+        if node is None or depth > 8:
+            return None
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = self.int_of(node.operand, depth + 1)
+            return -v if v is not None else None
+        if isinstance(node, ast.Name):
+            return self.int_of(self.vals.get(node.id), depth + 1)
+        if isinstance(node, ast.BinOp):
+            lv = self.int_of(node.left, depth + 1)
+            rv = self.int_of(node.right, depth + 1)
+            if lv is None or rv is None:
+                return None
+            if isinstance(node.op, ast.Add):
+                return lv + rv
+            if isinstance(node.op, ast.Sub):
+                return lv - rv
+            if isinstance(node.op, ast.Mult):
+                return lv * rv
+            if isinstance(node.op, ast.FloorDiv):
+                return lv // rv if rv else None
+            if isinstance(node.op, ast.Mod):
+                return lv % rv if rv else None
+            return None
+        if isinstance(node, ast.Subscript):
+            idx = self.int_of(node.slice, depth + 1)
+            seq = node.value
+            if isinstance(seq, ast.Name):
+                seq = self.vals.get(seq.id)
+            if isinstance(seq, (ast.Tuple, ast.List)) and idx is not None \
+                    and 0 <= idx < len(seq.elts):
+                return self.int_of(seq.elts[idx], depth + 1)
+        return None
+
+    def dims_of(self, node) -> Optional[List[Optional[int]]]:
+        if isinstance(node, ast.Name):
+            node = self.vals.get(node.id)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [self.int_of(e) for e in node.elts]
+        return None
+
+    def list_of(self, node) -> Optional[List[ast.AST]]:
+        if isinstance(node, (ast.List, ast.Tuple)):
+            return list(node.elts)
+        if isinstance(node, ast.Name):
+            v = self.vals.get(node.id)
+            if isinstance(v, (ast.List, ast.Tuple)):
+                return list(v.elts)
+        return None
+
+
+def _dtype_last(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    name = _dotted(node)
+    return name.split(".")[-1] if name else None
+
+
+def _kernel_param_layouts(kfn: _Func) -> List[List[str]]:
+    """Candidate positional-parameter name lists for a kernel: the
+    literal signature, or — for ``*refs`` kernels — each tuple-unpack
+    of the vararg found in the body (conditional unpacks yield several
+    candidates; all are checked)."""
+    a = kfn.node.args
+    pos = [p.arg for p in (a.posonlyargs + a.args)]
+    if a.vararg is None:
+        return [pos]
+    layouts: List[List[str]] = []
+    for n in ast.walk(kfn.node):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.value, ast.Name) \
+                and n.value.id == a.vararg.arg \
+                and isinstance(n.targets[0], (ast.Tuple, ast.List)) \
+                and all(isinstance(e, ast.Name)
+                        for e in n.targets[0].elts):
+            layouts.append(pos + [e.id for e in n.targets[0].elts])
+    return layouts or [pos]
+
+
+def _mac_store_line(kfn: _Func, name: str) -> Optional[int]:
+    """Line of an accumulating store into ref ``name``:
+    ``name[...] += ...`` or ``name[...] = <expr reading name[...]>``."""
+    for n in ast.walk(kfn.node):
+        if isinstance(n, ast.AugAssign) \
+                and isinstance(n.op, (ast.Add, ast.Sub)) \
+                and isinstance(n.target, ast.Subscript) \
+                and isinstance(n.target.value, ast.Name) \
+                and n.target.value.id == name:
+            return n.lineno
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == name:
+                    for sub in ast.walk(n.value):
+                        if isinstance(sub, ast.Subscript) \
+                                and isinstance(sub.value, ast.Name) \
+                                and sub.value.id == name:
+                            return n.lineno
+    return None
+
+
+def _check_pallas_site(site: _PallasSite, enabled: Set[str], emit,
+                       vmem_budget_mib: float) -> None:
+    """GL501/GL502/GL503 at one ``pallas_call`` site, from what is
+    statically provable there — unknown dims/dtypes silently skip a
+    check (this is a prover, not a guesser)."""
+    node = site.node
+    env = _ConstEnv(site.mod, site.fn)
+    kws = {k.arg: k.value for k in node.keywords if k.arg}
+
+    def as_list(x):
+        if x is None:
+            return []
+        lst = env.list_of(x)
+        return lst if lst is not None else [x]
+
+    def sds(entry):
+        if isinstance(entry, ast.Call):
+            n = (_dotted(entry.func) or "").split(".")[-1]
+            if n == "ShapeDtypeStruct" and entry.args:
+                return env.dims_of(entry.args[0]), (
+                    _dtype_last(entry.args[1])
+                    if len(entry.args) > 1 else None
+                )
+        return None, None
+
+    def block_dims(entry):
+        if isinstance(entry, ast.Call):
+            n = (_dotted(entry.func) or "").split(".")[-1]
+            if n == "BlockSpec" and entry.args:
+                return env.dims_of(entry.args[0])
+        return None
+
+    def scratch_info(entry):
+        if isinstance(entry, ast.Call):
+            n = (_dotted(entry.func) or "").split(".")[-1]
+            if n in ("VMEM", "SMEM", "ANY") and entry.args:
+                return env.dims_of(entry.args[0]), (
+                    _dtype_last(entry.args[1])
+                    if len(entry.args) > 1 else None
+                )
+            if n == "ShapeDtypeStruct":
+                return sds(entry)
+        return None, None
+
+    shapes = as_list(kws.get("out_shape"))
+    specs = as_list(kws.get("out_specs"))
+    in_specs = env.list_of(kws.get("in_specs")) or []
+    scratch = env.list_of(kws.get("scratch_shapes")) or []
+
+    if "GL501" in enabled and shapes and len(shapes) == len(specs):
+        for shp_e, spec_e in zip(shapes, specs):
+            dims, _dt = sds(shp_e)
+            block = block_dims(spec_e)
+            if not dims or not block or len(dims) != len(block):
+                continue
+            for d, (n_, b_) in enumerate(zip(dims, block)):
+                if isinstance(n_, int) and isinstance(b_, int) \
+                        and b_ > 0 and n_ % b_:
+                    emit(
+                        "GL501", spec_e.lineno,
+                        f"out_shape dim {d} = {n_} not divisible by "
+                        f"BlockSpec block dim {b_} at this pallas_call "
+                        "— the ragged tail tile reads/writes garbage",
+                    )
+
+    if "GL502" in enabled and scratch and site.kernels:
+        sub32 = [
+            (i, scratch_info(e)[1]) for i, e in enumerate(scratch)
+            if scratch_info(e)[1] in _SUB_FP32_FLOATS
+        ]
+        for kfn in site.kernels:
+            reported: Set[Tuple[int, str]] = set()
+            for names in _kernel_param_layouts(kfn):
+                if len(names) < len(scratch):
+                    continue
+                base = len(names) - len(scratch)
+                for i, dt in sub32:
+                    pname = names[base + i]
+                    line = _mac_store_line(kfn, pname)
+                    if line and (line, pname) not in reported:
+                        reported.add((line, pname))
+                        emit(
+                            "GL502", line,
+                            f"kernel `{kfn.qualname}` accumulates into "
+                            f"sub-fp32 scratch `{pname}` ({dt}) — the "
+                            "fp32-accumulation invariant every ops/ "
+                            "kernel documents",
+                        )
+
+    if "GL503" in enabled:
+        total = 0
+        for e in in_specs:
+            b = block_dims(e)
+            if b and all(isinstance(x, int) for x in b):
+                n = 1
+                for x in b:
+                    n *= x
+                total += n * 4  # input dtypes unseen at the site
+        for shp_e, spec_e in zip(shapes, specs):
+            b = block_dims(spec_e)
+            _dims, dt = sds(shp_e)
+            if b and all(isinstance(x, int) for x in b):
+                n = 1
+                for x in b:
+                    n *= x
+                total += n * _DTYPE_BYTES.get(dt or "", 4)
+        for e in scratch:
+            dims, dt = scratch_info(e)
+            if dims and all(isinstance(x, int) for x in dims):
+                n = 1
+                for x in dims:
+                    n *= x
+                total += n * _DTYPE_BYTES.get(dt or "", 4)
+        budget = vmem_budget_mib * 1024 * 1024
+        if total > budget:
+            emit(
+                "GL503", node.lineno,
+                f"estimated VMEM footprint {total / (1024 * 1024):.1f} "
+                f"MiB (statically-known blocks + scratch) exceeds the "
+                f"{vmem_budget_mib:g} MiB budget",
+            )
+
+
+def _strong_taint_names(fn: _Func) -> Set[str]:
+    """Names bound to array-op results in ``fn``'s own body (nested
+    defs excluded) — what a kernel/index_map must not close over."""
+    t = _Taint(fn.module)
+    owner = fn.node
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            if node is owner:
+                self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            if node is owner:
+                self.visit(node.body)
+
+        def visit_Assign(self, node):
+            self.generic_visit(node)
+            v = t.expr(node.value)
+            for tgt in node.targets:
+                t.assign(tgt, v)
+
+        def visit_AugAssign(self, node):
+            self.generic_visit(node)
+            if t.expr(node.value):
+                t.assign(node.target, True)
+
+        def visit_AnnAssign(self, node):
+            self.generic_visit(node)
+            if node.value is not None:
+                t.assign(node.target, t.expr(node.value))
+
+    V().visit(fn.node)
+    return set(t.names)
+
+
+def _free_loads(fn: _Func) -> Dict[str, int]:
+    """Free variables of a function: names LOADED in its body that are
+    neither parameters nor bound anywhere inside it."""
+    bound: Set[str] = set()
+    for n in ast.walk(fn.node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            aa = n.args
+            for p in aa.posonlyargs + aa.args + aa.kwonlyargs:
+                bound.add(p.arg)
+            for extra in (aa.vararg, aa.kwarg):
+                if extra is not None:
+                    bound.add(extra.arg)
+            if not isinstance(n, ast.Lambda):
+                bound.add(n.name)
+        elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            bound.add(n.id)
+    loads: Dict[str, int] = {}
+    for n in ast.walk(fn.node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id not in bound:
+            loads.setdefault(n.id, n.lineno)
+    return loads
+
+
+def _check_kernel_closures(kfn: _Func, enclosing: Optional[_Func],
+                           enabled: Set[str], emit) -> None:
+    """GL504's closure half: a kernel body or index_map referencing a
+    traced value from the enclosing scope."""
+    if "GL504" not in enabled or enclosing is None:
+        return
+    tainted = _strong_taint_names(enclosing)
+    if not tainted:
+        return
+    kind = "index_map" if isinstance(kfn.node, ast.Lambda) else "kernel"
+    for name, line in sorted(_free_loads(kfn).items()):
+        if name in tainted:
+            emit(
+                "GL504", line,
+                f"{kind} `{kfn.qualname}` closes over traced value "
+                f"`{name}` from `{enclosing.qualname}` — pass it in as "
+                "a ref or a partial-bound static",
+            )
+
+
+# -- GL601/GL602: lock-order graph + blocking-under-lock ----------------
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@dataclass
+class _ClassInfo:
+    mod: _Mod
+    node: ast.ClassDef
+    key: Tuple[str, str]  # (modname, ClassName)
+    locks: Set[str] = field(default_factory=set)
+    conds: Set[str] = field(default_factory=set)
+    events: Set[str] = field(default_factory=set)
+    queues: Set[str] = field(default_factory=set)
+    attr_types: Dict[str, object] = field(default_factory=dict)
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+
+
+class _ConcurrencyChecker:
+    """GL601/GL602 over every lock-owning class in the scanned tree.
+    serving/ and tools/fleet.py are the motivating surfaces, but an
+    inversion in train/ or obs/ deadlocks just the same, so the
+    analysis is not directory-scoped (unlike GL301, whose shared-state
+    heuristic is tuned to the serving threading model).
+
+    The lock-order graph: one node per (class, lock attribute); while
+    lock A is lexically held (``with self.A`` / ``self.A.acquire()``),
+    an edge A→B is drawn for every lock B acquired inside — directly,
+    through same-class method calls (transitive), or through methods
+    of attributes whose class ``__init__`` makes resolvable
+    (``self.x = SomeClass(...)``). A cycle means two threads can
+    interleave the two paths and deadlock (GL601)."""
+
+    def __init__(self, mods: Dict[str, _Mod], enabled: Set[str],
+                 emit_for) -> None:
+        self.mods = mods
+        self.enabled = enabled
+        self.emit_for = emit_for
+        self.classes: Dict[Tuple[str, str], _ClassInfo] = {}
+        self.edge_sites: Dict[Tuple, Tuple[_Mod, int]] = {}
+        self.adj: Dict[Tuple, Set[Tuple]] = {}
+
+    @staticmethod
+    def _fmt(nodekey) -> str:
+        (_mod, cls), attr = nodekey
+        return f"{cls}.{attr}"
+
+    def run(self) -> None:
+        if not ({"GL601", "GL602"} & self.enabled):
+            return
+        for mod in self.mods.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    self._collect(mod, node)
+        for ci in self.classes.values():
+            self._resolve_attr_types(ci)
+        for ci in sorted(
+            self.classes.values(),
+            key=lambda c: (c.mod.relpath, c.node.lineno),
+        ):
+            if ci.locks:
+                for meth in ci.methods.values():
+                    self._walk_method(ci, meth)
+        if "GL601" in self.enabled:
+            for (u, v), (mod, line) in sorted(
+                self.edge_sites.items(),
+                key=lambda kv: (kv[1][0].relpath, kv[1][1], str(kv[0])),
+            ):
+                if self._reaches(v, u):
+                    self.emit_for(mod)(
+                        "GL601", line,
+                        f"lock-order inversion: {self._fmt(v)} acquired "
+                        f"while holding {self._fmt(u)}, but another path "
+                        f"acquires {self._fmt(u)} while holding "
+                        f"{self._fmt(v)}",
+                    )
+
+    def _collect(self, mod: _Mod, cls: ast.ClassDef) -> None:
+        key = (mod.modname, cls.name)
+        ci = _ClassInfo(mod=mod, node=cls, key=key)
+        for n in cls.body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[n.name] = n
+        for n in ast.walk(cls):
+            if not isinstance(n, ast.Assign) or not isinstance(
+                n.value, ast.Call
+            ):
+                continue
+            vname = _dotted(n.value.func) or ""
+            last = vname.split(".")[-1]
+            for t in n.targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                if last in _LOCK_FACTORIES:
+                    ci.locks.add(attr)
+                    if last in _COND_FACTORIES:
+                        ci.conds.add(attr)
+                elif last in _EVENT_FACTORIES:
+                    ci.events.add(attr)
+                elif last in _QUEUE_FACTORIES:
+                    ci.queues.add(attr)
+                elif vname and last[:1].isupper():
+                    ci.attr_types.setdefault(attr, vname)
+        self.classes[key] = ci
+
+    def _resolve_attr_types(self, ci: _ClassInfo) -> None:
+        resolved: Dict[str, Tuple[str, str]] = {}
+        for attr, vname in ci.attr_types.items():
+            if "." not in vname and (ci.mod.modname, vname) in self.classes:
+                resolved[attr] = (ci.mod.modname, vname)
+                continue
+            full = _call_dotted_resolved(ci.mod, vname)
+            clsname = full.split(".")[-1]
+            modpart = full.rsplit(".", 1)[0] if "." in full else ""
+            m = _find_module(self.mods, modpart) if modpart else None
+            if m is not None and (m.modname, clsname) in self.classes:
+                resolved[attr] = (m.modname, clsname)
+        ci.attr_types = resolved
+
+    def _acquires(self, key, mname: str,
+                  _seen: Optional[Set[Tuple]] = None) -> Set[Tuple]:
+        """Locks a method acquires, transitively through resolvable
+        calls. No memoization: a cache keyed on (class, method) gets
+        permanently poisoned by cycle-guard placeholders, making GL601
+        order-dependent on unrelated methods — the per-query `_seen`
+        set bounds recursion instead, and the class method graphs here
+        are small enough that recomputation is free."""
+        if _seen is None:
+            _seen = set()
+        memo = (key, mname)
+        if memo in _seen:
+            return set()
+        _seen.add(memo)
+        ci = self.classes.get(key)
+        out: Set[Tuple] = set()
+        if ci is None or mname not in ci.methods:
+            return out
+        # own scope only: a callback DEFINED here acquires its locks
+        # when it runs later, outside this method's lock context —
+        # counting it would invent inversions (_walk_method skips
+        # nested defs for the same reason)
+        for n in _own_scope_nodes(ci.methods[mname]):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    a = _self_attr(item.context_expr)
+                    if a in ci.locks:
+                        out.add((key, a))
+            elif isinstance(n, ast.Call):
+                nm = _dotted(n.func) or ""
+                parts = nm.split(".")
+                if len(parts) == 3 and parts[0] == "self" \
+                        and parts[2] == "acquire" and parts[1] in ci.locks:
+                    out.add((key, parts[1]))
+                elif len(parts) == 2 and parts[0] == "self":
+                    out |= self._acquires(key, parts[1], _seen)
+                elif len(parts) == 3 and parts[0] == "self" \
+                        and parts[1] in ci.attr_types:
+                    out |= self._acquires(
+                        ci.attr_types[parts[1]], parts[2], _seen
+                    )
+        return out
+
+    def _edge(self, u, v, mod: _Mod, line: int) -> None:
+        if (u, v) not in self.edge_sites:
+            self.edge_sites[(u, v)] = (mod, line)
+        self.adj.setdefault(u, set()).add(v)
+
+    def _reaches(self, src, dst) -> bool:
+        seen = {src}
+        work = [src]
+        while work:
+            k = work.pop()
+            if k == dst:
+                return True
+            for n in self.adj.get(k, ()):
+                if n not in seen:
+                    seen.add(n)
+                    work.append(n)
+        return False
+
+    def _walk_method(self, ci: _ClassInfo, meth) -> None:
+        checker = self
+        emit = self.emit_for(ci.mod)
+        held: List[Tuple] = []
+
+        class V(ast.NodeVisitor):
+            def visit_With(self, node):
+                acquired = []
+                for item in node.items:
+                    self.visit(item.context_expr)
+                    a = _self_attr(item.context_expr)
+                    if a is not None and a in ci.locks:
+                        tgt = (ci.key, a)
+                        for h in held:
+                            if h != tgt:
+                                checker._edge(h, tgt, ci.mod, node.lineno)
+                        held.append(tgt)
+                        acquired.append(tgt)
+                for b in node.body:
+                    self.visit(b)
+                for _ in acquired:
+                    held.pop()
+
+            visit_AsyncWith = visit_With
+
+            def visit_FunctionDef(self, node):
+                if node is meth:
+                    self.generic_visit(node)
+                # nested defs run later, outside this lock scope
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                pass
+
+            def visit_Call(self, node):
+                self.generic_visit(node)
+                if held:
+                    checker._call_under_lock(ci, node, held, emit)
+
+        V().visit(meth)
+
+    def _call_under_lock(self, ci: _ClassInfo, node: ast.Call,
+                         held: List[Tuple], emit) -> None:
+        nm = _dotted(node.func) or ""
+        resolved = _call_dotted_resolved(ci.mod, nm) if nm else ""
+        parts = nm.split(".") if nm else []
+        line = node.lineno
+        # lock-order edges through calls
+        acq: Set[Tuple] = set()
+        if len(parts) == 3 and parts[0] == "self" \
+                and parts[2] == "acquire" and parts[1] in ci.locks:
+            acq = {(ci.key, parts[1])}
+        elif len(parts) == 2 and parts[0] == "self":
+            acq = self._acquires(ci.key, parts[1])
+        elif len(parts) == 3 and parts[0] == "self" \
+                and parts[1] in ci.attr_types:
+            acq = self._acquires(ci.attr_types[parts[1]], parts[2])
+        for tgt in acq:
+            for h in held:
+                if h != tgt:
+                    self._edge(h, tgt, ci.mod, line)
+        if "GL602" not in self.enabled:
+            return
+        held_names = ", ".join(self._fmt(h) for h in held)
+        for cand in {nm, resolved}:
+            if cand and any(
+                cand.startswith(p) for p in _BLOCKING_PREFIXES
+            ):
+                emit(
+                    "GL602", line,
+                    f"blocking call {nm}() while holding {held_names}",
+                )
+                return
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join" and not node.args:
+            emit(
+                "GL602", line,
+                f".join() while holding {held_names}",
+            )
+            return
+        if len(parts) == 3 and parts[0] == "self":
+            attr, m = parts[1], parts[2]
+            kwnames = {k.arg for k in node.keywords}
+            # queue.get is non-blocking with block=False / get(False)
+            nonblocking = any(
+                kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+                for kw in node.keywords
+            ) or (
+                node.args and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value is False
+            )
+            if attr in ci.queues and m == "get" \
+                    and "timeout" not in kwnames and len(node.args) < 2 \
+                    and not nonblocking:
+                emit(
+                    "GL602", line,
+                    f"self.{attr}.get() without timeout while holding "
+                    f"{held_names}",
+                )
+            elif attr in ci.events and m == "wait" \
+                    and not node.args and "timeout" not in kwnames:
+                emit(
+                    "GL602", line,
+                    f"self.{attr}.wait() while holding {held_names}",
+                )
+            elif attr in ci.conds and m in ("wait", "wait_for"):
+                others = [h for h in held if h != (ci.key, attr)]
+                if others:
+                    emit(
+                        "GL602", line,
+                        f"self.{attr}.{m}() releases only self.{attr} — "
+                        "still holding "
+                        + ", ".join(self._fmt(h) for h in others),
+                    )
 
 
 # -- GL301: serving lock discipline -------------------------------------
@@ -1205,6 +2502,12 @@ class LintResult:
     def active(self) -> List[Finding]:
         return [f for f in self.findings if not f.suppressed]
 
+    @property
+    def gating(self) -> List[Finding]:
+        """Active findings that flip the exit code (warn-severity rules
+        — GL503's VMEM estimate — are reported but never gate)."""
+        return [f for f in self.active if f.severity == "error"]
+
     def as_dict(self) -> dict:
         return {
             "graftlint": 1,
@@ -1216,9 +2519,98 @@ class LintResult:
                 "total": len(self.findings),
                 "active": len(self.active),
                 "suppressed": len(self.findings) - len(self.active),
+                "warnings": len(
+                    [f for f in self.active if f.severity == "warning"]
+                ),
             },
             "findings": [f.as_dict() for f in self.findings],
         }
+
+
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(result: LintResult) -> dict:
+    """SARIF 2.1.0 document for CI annotation. Deterministic like the
+    JSON report: rules sorted by id, results in finding order (already
+    path/line/rule-sorted), suppressed findings carried with an
+    ``inSource`` suppression instead of being dropped."""
+    rules = [
+        {
+            "id": r.id,
+            "name": r.name,
+            "shortDescription": {"text": r.summary},
+            "help": {"text": r.hint},
+            "defaultConfiguration": {
+                "level": "warning" if r.severity == "warning" else "error"
+            },
+        }
+        for _id, r in sorted(RULES_BY_ID.items())
+    ]
+    results = []
+    for f in result.findings:
+        res = {
+            "ruleId": f.rule,
+            "level": "warning" if f.severity == "warning" else "error",
+            "message": {"text": f"{f.message} (hint: {f.hint})"},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace(os.sep, "/")
+                        },
+                        "region": {"startLine": f.line},
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            res["suppressions"] = [{"kind": "inSource"}]
+        results.append(res)
+    for rel in result.parse_errors:
+        results.append({
+            "ruleId": "GL000",
+            "level": "error",
+            "message": {
+                "text": "parse error — file silently exempt from every "
+                        "rule"
+            },
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": rel.replace(os.sep, "/")
+                        },
+                        "region": {"startLine": 1},
+                    }
+                }
+            ],
+        })
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        # informationUri must be an ABSOLUTE URI per the
+                        # SARIF schema; the repo doc lives in the help
+                        # text instead
+                        "fullDescription": {
+                            "text": "JAX hazard linter — rule catalog "
+                                    "and suppression syntax: ANALYSIS.md"
+                        },
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
 
 
 def lint_paths(
@@ -1226,6 +2618,7 @@ def lint_paths(
     rules: Optional[Sequence[str]] = None,
     *,
     files: Optional[Sequence[Tuple[str, str, str]]] = None,
+    vmem_budget_mib: float = DEFAULT_VMEM_BUDGET_MIB,
 ) -> LintResult:
     """Lint every .py file under ``paths``; returns all findings
     (suppressed ones flagged, not dropped — the JSON output shows
@@ -1233,7 +2626,8 @@ def lint_paths(
 
     ``files`` (pre-enumerated ``_iter_py_files`` tuples) skips the
     directory walk — the CLI already walked each path for its
-    empty-path guard and must not do the I/O twice."""
+    empty-path guard and must not do the I/O twice.
+    ``vmem_budget_mib`` parameterizes GL503's footprint estimate."""
     enabled: Set[str] = (
         {resolve_rule_token(r) for r in rules}
         if rules else set(RULES_BY_ID)
@@ -1260,7 +2654,44 @@ def lint_paths(
             parse_errors.append(rel)
 
     _mark_roots(mods)
-    regions = _reachable_jit_regions(mods)
+    graph = _build_graph(mods)
+
+    # Pallas kernel regions: each kernel/index_map plus every function
+    # nested inside it (pl.when bodies execute within the kernel) plus
+    # everything they call. A function reached ONLY through kernels
+    # reports impure calls as GL504; one also reachable from ordinary
+    # tracing roots keeps GL103.
+    kernel_keys: Set[Tuple[str, str]] = set()
+    kernel_enclosing: List[Tuple[_Func, Optional[_Func]]] = []
+    seen_kernels: Set[Tuple[str, str]] = set()
+    for (kf, enc) in graph.kernel_seeds:
+        if kf.key not in seen_kernels:
+            seen_kernels.add(kf.key)
+            kernel_enclosing.append((kf, enc))
+        kernel_keys.add(kf.key)
+    for m in mods.values():
+        for f in m.funcs:  # pre-order: parents precede children
+            cur = f.parent
+            while cur is not None:
+                if cur.key in kernel_keys:
+                    kernel_keys.add(f.key)
+                    break
+                cur = cur.parent
+
+    kernelish = _closure(kernel_keys, graph.edges)
+    root_keys = {
+        f.key for m in mods.values() for f in m.funcs
+        if f.is_root and f.key not in kernel_keys
+    }
+    # regular jit reachability STOPS at kernels: a jitted caller of a
+    # pallas_call reaches the kernel, but the kernel (and helpers only
+    # it calls) stay kernel regions — impure calls there are GL504,
+    # not GL103, no matter where the call site sits
+    regular = _closure(root_keys, graph.edges, stop=kernel_keys)
+    regions = regular | kernelish
+    kernel_only = kernelish - (regular - kernel_keys)
+    envs = _env_closure(graph.binder_axes, graph.edges)
+    arms = _closure(graph.arm_seeds, graph.edges)
 
     findings: List[Finding] = []
 
@@ -1274,6 +2705,7 @@ def lint_paths(
                 path=mod.relpath, line=line, rule=rule,
                 message=message, hint=r.hint,
                 suppressed=mod.suppressions.covers(rule, lines),
+                severity=r.severity,
             ))
         return emit
 
@@ -1303,13 +2735,27 @@ def lint_paths(
             return [line]
         return list(range(best[0], best[1] + 1))
 
+    emit_by: Dict[int, object] = {}
+
+    def emit_for(mod: _Mod):
+        e = emit_by.get(id(mod))
+        if e is None:
+            e = make_emit(mod)
+            emit_by[id(mod)] = e
+        return e
+
     for mod in mods.values():
-        emit = make_emit(mod)
+        emit = emit_for(mod)
         for fn in mod.funcs:
             if fn.key in regions:
-                _JitRegionChecker(fn, enabled, emit).visit(fn.node)
+                _JitRegionChecker(
+                    fn, enabled, emit, kernel=fn.key in kernel_only
+                ).visit(fn.node)
             else:
                 _StepLoopChecker(fn, enabled, emit).visit(fn.node)
+            _CollectiveChecker(
+                fn, enabled, emit, envs.get(fn.key), fn.key in arms
+            ).visit(fn.node)
         _DonateChecker(mod, enabled, emit).visit(mod.tree)
         # membership keyed on the lint-root-RELATIVE path (file args
         # keep one parent component, so spot-linting serving/server.py
@@ -1318,6 +2764,14 @@ def lint_paths(
         # serving-only rules
         if "serving" in mod.relpath.split(os.sep):
             _LockDisciplineChecker(mod, enabled, emit).run()
+
+    for site in graph.pallas_sites:
+        _check_pallas_site(
+            site, enabled, emit_for(site.mod), vmem_budget_mib
+        )
+    for (kf, enc) in kernel_enclosing:
+        _check_kernel_closures(kf, enc, enabled, emit_for(kf.module))
+    _ConcurrencyChecker(mods, enabled, emit_for).run()
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return LintResult(

@@ -17,6 +17,21 @@ Rule id families:
   from host code (donation, step-loop sync discipline).
 - ``GL3xx`` — thread-discipline checks for the serving layer (host
   threads sharing one engine).
+- ``GL4xx`` — sharding/collective discipline: named-axis collectives
+  must be reachable from an axis-binding context (``shard_map`` /
+  ``pmap``), must not hide under per-shard-divergent control flow, and
+  shard bodies must stay free of host transfers.
+- ``GL5xx`` — Pallas kernel checks at ``pallas_call`` sites and inside
+  kernel bodies: grid/BlockSpec divisibility, fp32-accumulation,
+  VMEM-footprint estimation (warn-level), kernel purity/closures.
+- ``GL6xx`` — concurrency checks over lock-owning classes (serving/,
+  tools/fleet.py, and anywhere else a class owns a lock): a lock-order
+  graph catches A→B / B→A inversions, and blocking calls while holding
+  a lock are flagged.
+
+Severity: every rule is ``error`` (gates CI) except where noted
+``warning`` (reported, never flips the exit code) — currently GL503,
+whose VMEM estimate is a model, not a measurement.
 
 Suppressions (analysis/lint.py parses them from comments):
 
@@ -47,6 +62,7 @@ class Rule:
     name: str
     summary: str
     hint: str
+    severity: str = "error"  # "error" gates CI; "warning" is advisory
 
 
 RULES: Tuple[Rule, ...] = (
@@ -203,6 +219,169 @@ RULES: Tuple[Rule, ...] = (
             "Mutate under the class's lock/condition, or — for "
             "deliberately lock-free monotonic publishes — annotate the "
             "line with `# graftlint: threadsafe` and say why."
+        ),
+    ),
+    Rule(
+        id="GL401",
+        name="unbound-collective-axis",
+        summary=(
+            "A named-axis collective (psum/pmean/pmax/pmin/all_gather/"
+            "ppermute/all_to_all/axis_index/axis_size) in a function "
+            "not reachable from any shard_map/pmap axis-binding "
+            "context — or naming an axis the reachable contexts "
+            "provably do not bind. At trace time that is an unbound "
+            "axis-name error; worse, code that LOOKS collective but "
+            "never runs under a mesh silently computes shard-local "
+            "garbage when later jitted directly."
+        ),
+        hint=(
+            "Call the function from (or wrap it in) shard_map/pmap "
+            "binding that axis, or thread the axis name in from the "
+            "binding site. If the engine cannot see your binding path "
+            "(e.g. a registry of callbacks), suppress with the path "
+            "spelled out in the reason."
+        ),
+    ),
+    Rule(
+        id="GL402",
+        name="collective-under-traced-branch",
+        summary=(
+            "A collective reachable from a `lax.cond`/`lax.switch` "
+            "branch or `lax.while_loop` body. Branch predicates and "
+            "loop trip counts are traced values that can DIVERGE "
+            "per shard — one shard enters the collective while its "
+            "peers skip it, and the program deadlocks (multihost: "
+            "until the barrier timeout kills the pod)."
+        ),
+        hint=(
+            "Hoist the collective out of the branch, or reduce the "
+            "predicate to a provably-uniform scalar FIRST (pmean/psum "
+            "it, the pattern train/step.py uses for the anomaly "
+            "guard) and suppress with the uniformity argument as the "
+            "reason."
+        ),
+    ),
+    Rule(
+        id="GL403",
+        name="host-transfer-in-shard-body",
+        summary=(
+            "jax.device_put (an explicit host->device placement) "
+            "inside a shard_map/pmap body. Per-shard code runs under "
+            "SPMD lowering; a device_put there either fails to trace "
+            "or bakes one device's placement into every shard's "
+            "program — and any host round-trip serializes all shards."
+        ),
+        hint=(
+            "Place operands BEFORE the shard_map call site (in_specs "
+            "already describe the placement); inside the body use "
+            "jnp ops only."
+        ),
+    ),
+    Rule(
+        id="GL501",
+        name="pallas-grid-mismatch",
+        summary=(
+            "pallas_call whose out_shape dimension is provably not "
+            "divisible by the corresponding out_specs BlockSpec block "
+            "dimension (both statically known at the call site). "
+            "Mosaic pads the ragged tail tile; reads of the pad are "
+            "garbage and writes are silently dropped — the classic "
+            "off-by-a-tile numerical corruption."
+        ),
+        hint=(
+            "Clip the block to a divisor of the dimension "
+            "(ops/flash.py:pick_block is the house pattern) or pad "
+            "the operand explicitly and mask in-kernel."
+        ),
+    ),
+    Rule(
+        id="GL502",
+        name="sub-fp32-accumulator",
+        summary=(
+            "A pallas_call scratch accumulator allocated in a "
+            "sub-fp32 float dtype (bf16/fp16) and fed by a "
+            "multiply-accumulate in the kernel body. Every kernel in "
+            "ops/ documents the fp32-accumulation invariant: bf16 "
+            "accumulation loses ~8 mantissa bits per reduction "
+            "step — at M=16k rows that is the whole gradient signal."
+        ),
+        hint=(
+            "Allocate accumulator scratch as jnp.float32 and cast "
+            "once on the final store (pltpu.VMEM(shape, jnp.float32) "
+            "— see ops/fused_ffn.py's dW accumulators)."
+        ),
+    ),
+    Rule(
+        id="GL503",
+        name="pallas-vmem-budget",
+        summary=(
+            "Estimated VMEM footprint of a pallas_call's statically-"
+            "known block shapes x dtypes (in/out blocks + scratch) "
+            "exceeds the budget (default 16 MiB, --vmem-budget). The "
+            "estimate is a lower bound on live VMEM per program "
+            "instance; Mosaic double-buffers inputs on top of it. "
+            "Warn-level: an estimate gates nothing, but a kernel over "
+            "budget will fail to compile on hardware long after CPU "
+            "interpret-mode tests pass."
+        ),
+        hint=(
+            "Shrink block_m/block_k (stream through a grid axis "
+            "instead of holding the operand resident), or raise "
+            "--vmem-budget if the target chip really has more."
+        ),
+        severity="warning",
+    ),
+    Rule(
+        id="GL504",
+        name="impure-kernel",
+        summary=(
+            "An impure call (time/random/np.random/print/logging/IO) "
+            "inside a Pallas kernel body or BlockSpec index_map, or a "
+            "kernel/index_map closing over a traced value from the "
+            "enclosing scope. Kernel bodies lower to Mosaic — host "
+            "effects are trace-time-only at best; a closed-over "
+            "tracer is invisible to the grid machinery and either "
+            "fails to lower or constant-folds one trace's value into "
+            "every grid step."
+        ),
+        hint=(
+            "Pass values into the kernel as refs (inputs) or "
+            "functools.partial static args; index_maps must be pure "
+            "functions of the grid indices."
+        ),
+    ),
+    Rule(
+        id="GL601",
+        name="lock-order-inversion",
+        summary=(
+            "Two locks are acquired in opposite orders on different "
+            "code paths (A held while taking B, and B held while "
+            "taking A — directly or through method calls the engine "
+            "can resolve). Two threads interleaving those paths "
+            "deadlock; under load this is a when, not an if."
+        ),
+        hint=(
+            "Pick one global order (document it on the class) and "
+            "acquire in that order everywhere; or collapse to one "
+            "lock; or drop the inner acquisition by snapshotting "
+            "under the outer lock and working lock-free."
+        ),
+    ),
+    Rule(
+        id="GL602",
+        name="blocking-call-under-lock",
+        summary=(
+            "A blocking call (thread .join(), time.sleep, socket/"
+            "HTTP/subprocess I/O, queue .get() without timeout, "
+            "Event.wait(), Condition.wait on a DIFFERENT lock) while "
+            "holding a lock. Every other thread needing that lock "
+            "stalls for the full blocking duration — the /health "
+            "probe, the scheduler, the engine loop."
+        ),
+        hint=(
+            "Snapshot state under the lock, release, then block; or "
+            "use a timeout and re-check; Condition.wait on the held "
+            "condition itself is the correct idiom and is exempt."
         ),
     ),
 )

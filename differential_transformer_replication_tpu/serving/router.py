@@ -476,7 +476,7 @@ class Router:
             t.join(self.cfg.probe_timeout_s * 2 + 1.0)
         # start()/close() are owner-lifecycle calls (single-threaded by
         # contract); _probe_thread is never touched from request paths
-        self._probe_thread = threading.Thread(  # graftlint: threadsafe
+        self._probe_thread = threading.Thread(  # graftlint: threadsafe (lifecycle)
             target=self._probe_loop, name="router-prober", daemon=True
         )
         self._probe_thread.start()

@@ -1,0 +1,56 @@
+"""graftlint GL4xx fixture — planted sharding/collective hazards.
+
+NEVER imported or executed: tests/test_lint_clean.py lints this file to
+prove the GL4xx passes fire (anti-vacuity). Each planted hazard is
+labeled; the clean twin below it pins the negative."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+
+
+def unbound_collective(x):
+    # PLANTED GL401: no shard_map/pmap context reaches this function
+    return jax.lax.psum(x, "data")
+
+
+def wrong_axis_body(x):
+    # PLANTED GL401: pmap below binds "data", not "model"
+    return jax.lax.pmean(x, "model")
+
+
+wrong_axis = jax.pmap(wrong_axis_body, axis_name="data")
+
+
+def branchy_body(x, pred):
+    def diverging_arm(v):
+        # PLANTED GL402: collective under a lax.cond arm
+        return jax.lax.psum(v, "data")
+
+    def safe_arm(v):
+        return v * 2.0
+
+    return jax.lax.cond(pred, diverging_arm, safe_arm, x)
+
+
+def transfer_body(x):
+    # PLANTED GL403: device_put inside a shard_map body
+    y = jax.device_put(x)
+    return jnp.sum(y)
+
+
+branchy = shard_map(branchy_body, mesh=None, in_specs=None, out_specs=None)
+transfer = shard_map(transfer_body, mesh=None, in_specs=None, out_specs=None)
+
+
+def clean_body(x):
+    # negative twin: bound by the shard_map below — must NOT fire
+    return jax.lax.psum(x, "data") + jax.lax.axis_index("data")
+
+
+clean = shard_map(clean_body, mesh=None, in_specs=None, out_specs=None)
+
+
+def suppressed_collective(x):
+    # suppression plumbing for the family stays auditable
+    return jax.lax.pmax(x, "data")  # graftlint: disable=GL401 (fixture)

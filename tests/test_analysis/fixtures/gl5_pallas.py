@@ -1,0 +1,86 @@
+"""graftlint GL5xx fixture — planted Pallas-kernel hazards.
+
+NEVER imported or executed: tests/test_lint_clean.py lints this file to
+prove the GL5xx passes fire (anti-vacuity)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def ragged_blocks(x):
+    # PLANTED GL501: 100 % 48 != 0 on the out spec's first dim
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(3,),
+        in_specs=[pl.BlockSpec((48, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((48, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((100, 128), jnp.float32),
+    )(x)
+
+
+def _bf16_acc_kernel(x_ref, o_ref, acc_ref):
+    # PLANTED GL502: multiply-accumulate into the bf16 scratch below
+    acc_ref[...] += x_ref[...] * 2.0
+    o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def bf16_accumulator(x, rows):
+    return pl.pallas_call(
+        _bf16_acc_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((128, 128), jnp.bfloat16)],
+    )(x)
+
+
+def vmem_hog(x, rows):
+    # PLANTED GL503 (warning): 2048*4096 fp32 scratch = 32 MiB > 16 MiB
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((2048, 4096), jnp.float32)],
+    )(x)
+
+
+def impure_and_closing(x):
+    y = jnp.sum(x)
+
+    def _impure_kernel(x_ref, o_ref):
+        # PLANTED GL504 (impure call in kernel body)
+        t = time.time()
+        # PLANTED GL504 (closure over traced `y` from enclosing scope)
+        o_ref[...] = x_ref[...] + y + t
+
+    return pl.pallas_call(
+        _impure_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )(x)
+
+
+def clean_call(x, rows):
+    # negative twin: divisible blocks, fp32 scratch, pure kernel
+    def _acc_kernel(x_ref, o_ref, acc_ref):
+        acc_ref[...] += x_ref[...] * 2.0
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        _acc_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32)],
+    )(x)

@@ -1,0 +1,68 @@
+"""graftlint GL6xx fixture — planted lock-order and blocking hazards.
+
+NEVER imported or executed: tests/test_lint_clean.py lints this file to
+prove the GL6xx passes fire (anti-vacuity)."""
+
+import queue
+import threading
+import time
+
+
+class Inverted:
+    """PLANTED GL601: _a -> _b in one(), _b -> _a in two()."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def one(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def two(self):
+        with self._b:
+            with self._a:
+                self.n -= 1
+
+
+class BlockedUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._done = threading.Event()
+
+    def sleepy(self):
+        with self._lock:
+            # PLANTED GL602: sleeping while every other thread waits
+            time.sleep(0.5)
+
+    def queue_get(self):
+        with self._lock:
+            # PLANTED GL602: unbounded queue get under the lock
+            return self._q.get()
+
+    def bounded_ok(self):
+        with self._lock:
+            # negative twin: bounded get releases within the timeout
+            return self._q.get(timeout=0.1)
+
+
+class Ordered:
+    """Negative twin: consistent _a -> _b order everywhere."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def one(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def two(self):
+        with self._a:
+            with self._b:
+                self.n -= 1

@@ -792,6 +792,890 @@ class TestSameBasenameArgs:
         assert [f.suppressed for f in gl101] == [False, True]
 
 
+SHARD_HEADER = (
+    "import jax\nimport jax.numpy as jnp\n"
+    "from jax.experimental.shard_map import shard_map\n"
+)
+
+PALLAS_HEADER = (
+    "import jax\nimport jax.numpy as jnp\n"
+    "from jax.experimental import pallas as pl\n"
+    "from jax.experimental.pallas import tpu as pltpu\n"
+)
+
+
+class TestGL401UnboundCollective:
+    def test_positive_no_binding_context(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def helper(x):\n"
+            "    return jax.lax.psum(x, 'data')\n"
+        ))
+        assert "GL401" in active_ids(res)
+
+    def test_positive_plain_jit_region(self, tmp_path):
+        # jitted but NOT shard_mapped: the axis name is unbound at trace
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return jax.lax.pmean(x, 'data')\n"
+        ))
+        assert "GL401" in active_ids(res)
+
+    def test_negative_direct_shard_map_body(self, tmp_path):
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def body(x):\n"
+            "    return jax.lax.psum(x, 'data')\n"
+            "f = shard_map(body, mesh=None, in_specs=None, out_specs=None)\n"
+        ))
+        assert "GL401" not in active_ids(res)
+
+    def test_negative_axis_index_in_body(self, tmp_path):
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def body(x):\n"
+            "    return x + jax.lax.axis_index('data')\n"
+            "f = shard_map(body, mesh=None, in_specs=None, out_specs=None)\n"
+        ))
+        assert "GL401" not in active_ids(res)
+
+    def test_positive_pmap_wrong_axis(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def body(x):\n"
+            "    return jax.lax.psum(x, 'model')\n"
+            "f = jax.pmap(body, axis_name='data')\n"
+        ))
+        assert "GL401" in active_ids(res)
+
+    def test_negative_pmap_right_axis(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def body(x):\n"
+            "    return jax.lax.psum(x, 'data')\n"
+            "f = jax.pmap(body, axis_name='data')\n"
+        ))
+        assert "GL401" not in active_ids(res)
+
+    def test_negative_variable_axis_under_binder(self, tmp_path):
+        # axis threaded in as a variable: bound by construction
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def make(axis):\n"
+            "    def body(x):\n"
+            "        return jax.lax.pmean(x, axis)\n"
+            "    return body\n"
+            "def build(mesh):\n"
+            "    return shard_map(make('data'), mesh=mesh, in_specs=None,\n"
+            "                     out_specs=None)\n"
+        ))
+        assert "GL401" not in active_ids(res)
+
+    def test_negative_wrapper_idiom(self, tmp_path):
+        # body reaches shard_map only through a wrapper's parameter —
+        # the compat.shard_map shape
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def wrapper(fn, mesh):\n"
+            "    return shard_map(fn, mesh=mesh, in_specs=None,\n"
+            "                     out_specs=None)\n"
+            "def body(x):\n"
+            "    return jax.lax.pmean(x, 'data')\n"
+            "def caller(mesh, x):\n"
+            "    return wrapper(body, mesh)(x)\n"
+        ))
+        assert "GL401" not in active_ids(res)
+
+    def test_negative_param_bound_lambda(self, tmp_path):
+        # the dp_step shape: a pmean lambda handed into a maker whose
+        # returned step runs under shard_map
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def make_step(cfg, loss_sync=None):\n"
+            "    def step(state, batch):\n"
+            "        loss = jnp.sum(batch)\n"
+            "        if loss_sync is not None:\n"
+            "            loss = loss_sync(loss)\n"
+            "        return state, loss\n"
+            "    return step\n"
+            "def build(cfg, mesh):\n"
+            "    axis = 'data'\n"
+            "    inner = make_step(cfg,\n"
+            "                      loss_sync=lambda l: jax.lax.pmean(l, axis))\n"
+            "    def raw(state, batch):\n"
+            "        return inner(state, batch)\n"
+            "    return shard_map(raw, mesh=mesh, in_specs=None,\n"
+            "                     out_specs=None)\n"
+        ))
+        assert "GL401" not in active_ids(res)
+
+    def test_negative_defvjp_backward(self, tmp_path):
+        # a custom-vjp backward pmean is bound through the primal's
+        # reachability (the _bucket_sync shape)
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def make_sync(axis):\n"
+            "    @jax.custom_vjp\n"
+            "    def sync(t):\n"
+            "        return t\n"
+            "    def fwd(t):\n"
+            "        return t, None\n"
+            "    def bwd(_, ct):\n"
+            "        return (jax.lax.pmean(ct, axis),)\n"
+            "    sync.defvjp(fwd, bwd)\n"
+            "    return sync\n"
+            "def build(mesh):\n"
+            "    sync = make_sync('data')\n"
+            "    def body(x):\n"
+            "        return sync(x)\n"
+            "    return shard_map(body, mesh=mesh, in_specs=None,\n"
+            "                     out_specs=None)\n"
+        ))
+        assert "GL401" not in active_ids(res)
+
+    def test_positive_axis_kwarg_does_not_mask_name(self, tmp_path):
+        # all_gather's `axis=` kwarg is the ARRAY dimension, not the
+        # axis name — it must not clobber the positional name candidate
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def body(x):\n"
+            "    return jax.lax.all_gather(x, 'mp', axis=0)\n"
+            "f = jax.pmap(body, axis_name='dp')\n"
+        ))
+        assert "GL401" in active_ids(res)
+
+    def test_suppressed(self, tmp_path):
+        res = lint_src(tmp_path, JIT_HEADER + (
+            "def helper(x):\n"
+            "    return jax.lax.psum(x, 'data')  "
+            "# graftlint: disable=GL401 (fixture)\n"
+        ))
+        assert "GL401" not in active_ids(res)
+        assert "GL401" in all_ids(res)
+
+
+class TestGL402CollectiveUnderBranch:
+    def test_positive_cond_arm(self, tmp_path):
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def body(x, pred):\n"
+            "    def yes(v):\n"
+            "        return jax.lax.psum(v, 'data')\n"
+            "    def no(v):\n"
+            "        return v\n"
+            "    return jax.lax.cond(pred, yes, no, x)\n"
+            "f = shard_map(body, mesh=None, in_specs=None, out_specs=None)\n"
+        ))
+        assert "GL402" in active_ids(res)
+
+    def test_positive_while_body(self, tmp_path):
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def body(x):\n"
+            "    def cond_fn(c):\n"
+            "        return c[1] > 0\n"
+            "    def body_fn(c):\n"
+            "        return (jax.lax.pmean(c[0], 'data'), c[1] - 1)\n"
+            "    return jax.lax.while_loop(cond_fn, body_fn, (x, 3))\n"
+            "f = shard_map(body, mesh=None, in_specs=None, out_specs=None)\n"
+        ))
+        assert "GL402" in active_ids(res)
+
+    def test_positive_transitively_reached(self, tmp_path):
+        # the collective hides one call deep inside the arm
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def deep(v):\n"
+            "    return jax.lax.psum(v, 'data')\n"
+            "def body(x, pred):\n"
+            "    def yes(v):\n"
+            "        return deep(v)\n"
+            "    return jax.lax.cond(pred, yes, lambda v: v, x)\n"
+            "f = shard_map(body, mesh=None, in_specs=None, out_specs=None)\n"
+        ))
+        assert "GL402" in active_ids(res)
+
+    def test_negative_collective_outside_arm(self, tmp_path):
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def body(x, pred):\n"
+            "    s = jax.lax.psum(x, 'data')\n"
+            "    return jax.lax.cond(pred, lambda v: v, lambda v: -v, s)\n"
+            "f = shard_map(body, mesh=None, in_specs=None, out_specs=None)\n"
+        ))
+        assert "GL402" not in active_ids(res)
+
+    def test_negative_scan_body_is_uniform(self, tmp_path):
+        # scan/fori_loop trip counts are static — every shard runs the
+        # same number of collectives (the ring-attention shape)
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def body(ks):\n"
+            "    def step(c, x):\n"
+            "        return jax.lax.ppermute(c, 'sequence',\n"
+            "                                [(0, 1), (1, 0)]), None\n"
+            "    out, _ = jax.lax.scan(step, ks, None, length=4)\n"
+            "    return out\n"
+            "f = shard_map(body, mesh=None, in_specs=None, out_specs=None)\n"
+        ))
+        assert "GL402" not in active_ids(res)
+
+    def test_suppressed(self, tmp_path):
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def body(x, pred):\n"
+            "    def yes(v):\n"
+            "        return jax.lax.psum(v, 'data')  "
+            "# graftlint: disable=GL402 (pred is pmean-uniform)\n"
+            "    return jax.lax.cond(pred, yes, lambda v: v, x)\n"
+            "f = shard_map(body, mesh=None, in_specs=None, out_specs=None)\n"
+        ))
+        assert "GL402" not in active_ids(res)
+        assert "GL402" in all_ids(res)
+
+
+class TestGL403HostTransferInShardBody:
+    def test_positive(self, tmp_path):
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def body(x):\n"
+            "    return jax.device_put(x)\n"
+            "f = shard_map(body, mesh=None, in_specs=None, out_specs=None)\n"
+        ))
+        assert "GL403" in active_ids(res)
+
+    def test_negative_host_device_put(self, tmp_path):
+        # placement BEFORE the shard_map call is the correct idiom
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def body(x):\n"
+            "    return x * 2\n"
+            "def launch(mesh, x, sharding):\n"
+            "    x = jax.device_put(x, sharding)\n"
+            "    f = shard_map(body, mesh=mesh, in_specs=None,\n"
+            "                  out_specs=None)\n"
+            "    return f(x)\n"
+        ))
+        assert "GL403" not in active_ids(res)
+
+    def test_suppressed(self, tmp_path):
+        res = lint_src(tmp_path, SHARD_HEADER + (
+            "def body(x):\n"
+            "    return jax.device_put(x)  "
+            "# graftlint: disable=GL403 (fixture)\n"
+            "f = shard_map(body, mesh=None, in_specs=None, out_specs=None)\n"
+        ))
+        assert "GL403" not in active_ids(res)
+        assert "GL403" in all_ids(res)
+
+
+class TestGL501GridMismatch:
+    POS = PALLAS_HEADER + (
+        "def kern(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...]\n"
+        "def call(x):\n"
+        "    return pl.pallas_call(\n"
+        "        kern,\n"
+        "        grid=(3,),\n"
+        "        in_specs=[pl.BlockSpec((48, 128), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((48, 128), lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((100, 128), jnp.float32),\n"
+        "    )(x)\n"
+    )
+
+    def test_positive(self, tmp_path):
+        res = lint_src(tmp_path, self.POS)
+        assert "GL501" in active_ids(res)
+
+    def test_positive_through_module_constants(self, tmp_path):
+        res = lint_src(tmp_path, PALLAS_HEADER + (
+            "_ROWS = 100\n"
+            "_BLOCK = 48\n"
+            "def kern(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def call(x):\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        out_specs=pl.BlockSpec((_BLOCK, 128),\n"
+            "                               lambda i: (i, 0)),\n"
+            "        out_shape=jax.ShapeDtypeStruct((_ROWS, 128),\n"
+            "                                       jnp.float32),\n"
+            "    )(x)\n"
+        ))
+        assert "GL501" in active_ids(res)
+
+    def test_negative_divisible(self, tmp_path):
+        res = lint_src(tmp_path, self.POS.replace("(100, 128)", "(96, 128)"))
+        assert "GL501" not in active_ids(res)
+
+    def test_negative_dynamic_shapes(self, tmp_path):
+        # non-static dims: a prover stays silent, never guesses
+        res = lint_src(tmp_path, self.POS.replace(
+            "def call(x):", "def call(x, M):"
+        ).replace("(100, 128)", "(M, 128)"))
+        assert "GL501" not in active_ids(res)
+
+    def test_negative_nested_scope_constant_does_not_leak(self, tmp_path):
+        # a sibling nested helper's local `BM = 100` is NOT the call
+        # site's BM (module-level BM = 64 divides 256 evenly)
+        res = lint_src(tmp_path, PALLAS_HEADER + (
+            "BM = 64\n"
+            "def kern(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def call(x):\n"
+            "    def helper():\n"
+            "        BM = 100\n"
+            "        return BM\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        out_specs=pl.BlockSpec((BM, 128), lambda i: (i, 0)),\n"
+            "        out_shape=jax.ShapeDtypeStruct((256, 128),\n"
+            "                                       jnp.float32),\n"
+            "    )(x)\n"
+        ))
+        assert "GL501" not in active_ids(res)
+
+    def test_suppressed(self, tmp_path):
+        res = lint_src(tmp_path, self.POS.replace(
+            "        out_specs=pl.BlockSpec((48, 128), lambda i: (i, 0)),\n",
+            "        out_specs=pl.BlockSpec((48, 128), lambda i: (i, 0)),  "
+            "# graftlint: disable=GL501 (fixture)\n",
+        ))
+        assert "GL501" not in active_ids(res)
+        assert "GL501" in all_ids(res)
+
+
+class TestGL502SubFp32Accumulator:
+    POS = PALLAS_HEADER + (
+        "def kern(x_ref, o_ref, acc_ref):\n"
+        "    acc_ref[...] += x_ref[...] * 2.0\n"
+        "    o_ref[...] = acc_ref[...].astype(o_ref.dtype)\n"
+        "def call(x, M):\n"
+        "    return pl.pallas_call(\n"
+        "        kern,\n"
+        "        grid=(4,),\n"
+        "        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((M, 128), jnp.float32),\n"
+        "        scratch_shapes=[pltpu.VMEM((128, 128), jnp.bfloat16)],\n"
+        "    )(x)\n"
+    )
+
+    def test_positive(self, tmp_path):
+        res = lint_src(tmp_path, self.POS)
+        assert "GL502" in active_ids(res)
+
+    def test_positive_star_refs_unpack(self, tmp_path):
+        # the house kernel style: *refs + tuple unpack in the body
+        res = lint_src(tmp_path, PALLAS_HEADER + (
+            "def kern(*refs):\n"
+            "    x_ref, o_ref, acc_ref = refs\n"
+            "    acc_ref[...] = acc_ref[...] + x_ref[...] * 2.0\n"
+            "    o_ref[...] = acc_ref[...].astype(o_ref.dtype)\n"
+            "def call(x, M):\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],\n"
+            "        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),\n"
+            "        out_shape=jax.ShapeDtypeStruct((M, 128), jnp.float32),\n"
+            "        scratch_shapes=[pltpu.VMEM((128, 128), jnp.bfloat16)],\n"
+            "    )(x)\n"
+        ))
+        assert "GL502" in active_ids(res)
+
+    def test_negative_fp32_scratch(self, tmp_path):
+        res = lint_src(tmp_path, self.POS.replace("jnp.bfloat16", "jnp.float32"))
+        assert "GL502" not in active_ids(res)
+
+    def test_negative_bf16_scratch_without_accumulation(self, tmp_path):
+        # sub-fp32 scratch used as a plain store target is legitimate
+        res = lint_src(tmp_path, self.POS.replace(
+            "    acc_ref[...] += x_ref[...] * 2.0\n",
+            "    acc_ref[...] = x_ref[...] * 2.0\n",
+        ))
+        assert "GL502" not in active_ids(res)
+
+    def test_suppressed(self, tmp_path):
+        res = lint_src(tmp_path, self.POS.replace(
+            "    acc_ref[...] += x_ref[...] * 2.0\n",
+            "    acc_ref[...] += x_ref[...] * 2.0  "
+            "# graftlint: disable=GL502 (fixture)\n",
+        ))
+        assert "GL502" not in active_ids(res)
+        assert "GL502" in all_ids(res)
+
+
+class TestGL503VmemBudget:
+    POS = PALLAS_HEADER + (
+        "def kern(x_ref, o_ref, acc_ref):\n"
+        "    o_ref[...] = x_ref[...]\n"
+        "def call(x, M):\n"
+        "    return pl.pallas_call(\n"
+        "        kern,\n"
+        "        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((M, 128), jnp.float32),\n"
+        "        scratch_shapes=[pltpu.VMEM((2048, 4096), jnp.float32)],\n"
+        "    )(x)\n"
+    )
+
+    def test_positive_is_warning(self, tmp_path):
+        res = lint_src(tmp_path, self.POS)
+        hits = [f for f in res.active if f.rule == "GL503"]
+        assert hits and all(f.severity == "warning" for f in hits)
+        # warn-severity findings never gate
+        assert not res.gating
+
+    def test_budget_configurable(self, tmp_path):
+        from differential_transformer_replication_tpu.analysis.lint import (
+            lint_paths as lp,
+        )
+        path = tmp_path / "mod.py"
+        path.write_text(self.POS)
+        res = lp([str(tmp_path)], vmem_budget_mib=64.0)
+        assert "GL503" not in active_ids(res)
+
+    def test_negative_small_blocks(self, tmp_path):
+        res = lint_src(tmp_path, self.POS.replace("(2048, 4096)", "(128, 128)"))
+        assert "GL503" not in active_ids(res)
+
+    def test_suppressed(self, tmp_path):
+        res = lint_src(tmp_path, self.POS.replace(
+            "    return pl.pallas_call(\n",
+            "    return pl.pallas_call(  "
+            "# graftlint: disable=GL503 (fixture)\n",
+        ))
+        assert "GL503" not in active_ids(res)
+        assert "GL503" in all_ids(res)
+
+
+class TestGL504ImpureKernel:
+    def test_positive_impure_call(self, tmp_path):
+        res = lint_src(tmp_path, PALLAS_HEADER + (
+            "import time\n"
+            "def kern(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...] * time.time()\n"
+            "def call(x):\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),\n"
+            "    )(x)\n"
+        ))
+        ids = active_ids(res)
+        assert "GL504" in ids
+        assert "GL103" not in ids  # kernel impurity is GL504, not GL103
+
+    def test_positive_impure_call_site_inside_jit_region(self, tmp_path):
+        # the common real shape: the pallas_call SITE is itself jitted.
+        # Kernel-ness must win — regular jit reachability stops at the
+        # kernel, so the impure call reports GL504, not GL103
+        res = lint_src(tmp_path, PALLAS_HEADER + (
+            "import time\n"
+            "def kern(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...] * time.time()\n"
+            "@jax.jit\n"
+            "def call(x):\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),\n"
+            "    )(x)\n"
+        ))
+        ids = active_ids(res)
+        assert "GL504" in ids
+        assert "GL103" not in ids
+
+    def test_positive_closure_over_traced(self, tmp_path):
+        res = lint_src(tmp_path, PALLAS_HEADER + (
+            "def call(x):\n"
+            "    y = jnp.sum(x)\n"
+            "    def kern(x_ref, o_ref):\n"
+            "        o_ref[...] = x_ref[...] + y\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),\n"
+            "    )(x)\n"
+        ))
+        assert "GL504" in active_ids(res)
+
+    def test_positive_index_map_closure(self, tmp_path):
+        res = lint_src(tmp_path, PALLAS_HEADER + (
+            "def kern(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def call(x):\n"
+            "    off = jnp.argmax(x)\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        in_specs=[pl.BlockSpec((8, 128),\n"
+            "                               lambda i: (i + off, 0))],\n"
+            "        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),\n"
+            "        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),\n"
+            "    )(x)\n"
+        ))
+        assert "GL504" in active_ids(res)
+
+    def test_negative_static_closure(self, tmp_path):
+        # closing over shapes/ints from the enclosing scope is the
+        # normal kernel idiom (block sizes, head counts)
+        res = lint_src(tmp_path, PALLAS_HEADER + (
+            "def call(x, block):\n"
+            "    S, d = x.shape\n"
+            "    def kern(x_ref, o_ref):\n"
+            "        o_ref[...] = x_ref[...] * S\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        in_specs=[pl.BlockSpec((block, d),\n"
+            "                               lambda i: (i, 0))],\n"
+            "        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),\n"
+            "        out_shape=jax.ShapeDtypeStruct((S, d), x.dtype),\n"
+            "    )(x)\n"
+        ))
+        assert "GL504" not in active_ids(res)
+
+    def test_negative_partial_bound_static(self, tmp_path):
+        res = lint_src(tmp_path, PALLAS_HEADER + (
+            "import functools\n"
+            "def kern(x_ref, o_ref, *, scale):\n"
+            "    o_ref[...] = x_ref[...] * scale\n"
+            "def call(x):\n"
+            "    return pl.pallas_call(\n"
+            "        functools.partial(kern, scale=2.0),\n"
+            "        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),\n"
+            "    )(x)\n"
+        ))
+        assert "GL504" not in active_ids(res)
+
+    def test_suppressed(self, tmp_path):
+        res = lint_src(tmp_path, PALLAS_HEADER + (
+            "import time\n"
+            "def kern(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...] * time.time()  "
+            "# graftlint: disable=GL504 (fixture)\n"
+            "def call(x):\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),\n"
+            "    )(x)\n"
+        ))
+        assert "GL504" not in active_ids(res)
+        assert "GL504" in all_ids(res)
+
+
+LOCKS_HEADER = "import threading\nimport queue\nimport time\n"
+
+
+class TestGL601LockOrderInversion:
+    POS = LOCKS_HEADER + (
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+
+    def test_positive_direct(self, tmp_path):
+        res = lint_src(tmp_path, self.POS)
+        assert "GL601" in active_ids(res)
+
+    def test_positive_across_two_methods_via_call(self, tmp_path):
+        # A->B through a method call, B->A lexical: the planted
+        # inversion the acceptance list names
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def outer(self):\n"
+            "        with self._a:\n"
+            "            self.helper()\n"
+            "    def helper(self):\n"
+            "        with self._b:\n"
+            "            pass\n"
+            "    def other(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        ))
+        assert "GL601" in active_ids(res)
+
+    def test_positive_across_classes(self, tmp_path):
+        # Outer holds _ol and calls into Inner (takes _il); Inner holds
+        # _il and calls back through its owner ref (takes _ol) — the
+        # cross-class cycle resolved via `self.x = Class(...)` typing
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class Outer:\n"
+            "    def __init__(self):\n"
+            "        self._ol = threading.Lock()\n"
+            "        self.inner = Inner(self)\n"
+            "    def fwd(self):\n"
+            "        with self._ol:\n"
+            "            self.inner.work()\n"
+            "    def notify(self):\n"
+            "        with self._ol:\n"
+            "            pass\n"
+            "class Inner:\n"
+            "    def __init__(self, owner):\n"
+            "        self._il = threading.Lock()\n"
+            "        self.owner = Outer()\n"
+            "    def work(self):\n"
+            "        with self._il:\n"
+            "            pass\n"
+            "    def back(self):\n"
+            "        with self._il:\n"
+            "            self.owner.notify()\n"
+        ), filename="locks.py")
+        assert "GL601" in active_ids(res)
+
+    def test_negative_one_directional_cross_class(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class Inner:\n"
+            "    def __init__(self):\n"
+            "        self._il = threading.Lock()\n"
+            "    def work(self):\n"
+            "        with self._il:\n"
+            "            pass\n"
+            "class Outer:\n"
+            "    def __init__(self):\n"
+            "        self._ol = threading.Lock()\n"
+            "        self.inner = Inner()\n"
+            "    def fwd(self):\n"
+            "        with self._ol:\n"
+            "            self.inner.work()\n"
+        ), filename="locks.py")
+        assert "GL601" not in active_ids(res)
+
+    def test_negative_consistent_order(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def two(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+        ))
+        assert "GL601" not in active_ids(res)
+
+    def test_positive_unrelated_deep_chain_does_not_mask(self, tmp_path):
+        # regression: a deep unrelated call chain must not poison the
+        # acquisition analysis for a direct, shallow inversion
+        deep = "".join(
+            f"    def h{i}(self):\n        self.h{i + 1}()\n"
+            for i in range(1, 7)
+        )
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def deep_first(self):\n"
+            "        self.h1()\n"
+        ) + deep + (
+            "    def h7(self):\n"
+            "        with self._b:\n"
+            "            pass\n"
+            "    def shallow(self):\n"
+            "        with self._a:\n"
+            "            self.h7()\n"
+            "    def inverted(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        ))
+        assert "GL601" in active_ids(res)
+
+    def test_negative_callback_defined_not_called(self, tmp_path):
+        # a nested def ACQUIRING b runs later, outside the caller's
+        # lock scope — defining it under `with self.a` is not a->b
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def m1(self):\n"
+            "        with self._a:\n"
+            "            return self.m2()\n"
+            "    def m2(self):\n"
+            "        def cb():\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "        return cb\n"
+            "    def m3(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        ))
+        assert "GL601" not in active_ids(res)
+
+    def test_negative_nested_same_lock_rlock_style(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.RLock()\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            self.two()\n"
+            "    def two(self):\n"
+            "        with self._a:\n"
+            "            pass\n"
+        ))
+        assert "GL601" not in active_ids(res)
+
+    def test_suppressed(self, tmp_path):
+        # edges are reported at the INNER acquisition's `with` line
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:  "
+            "# graftlint: disable=GL601 (fixture)\n"
+            "                pass\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:  "
+            "# graftlint: disable=GL601 (fixture)\n"
+            "                pass\n"
+        ))
+        assert "GL601" not in active_ids(res)
+        assert "GL601" in all_ids(res)
+
+
+class TestGL602BlockingUnderLock:
+    def test_positive_sleep(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1.0)\n"
+        ))
+        assert "GL602" in active_ids(res)
+
+    def test_positive_queue_get_no_timeout(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = queue.Queue()\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            return self._q.get()\n"
+        ))
+        assert "GL602" in active_ids(res)
+
+    def test_positive_thread_join(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._t = threading.Thread(target=print)\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self._t.join()\n"
+        ))
+        assert "GL602" in active_ids(res)
+
+    def test_positive_event_wait(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._evt = threading.Event()\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self._evt.wait()\n"
+        ))
+        assert "GL602" in active_ids(res)
+
+    def test_negative_queue_get_with_timeout(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = queue.Queue()\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            return self._q.get(timeout=0.5)\n"
+        ))
+        assert "GL602" not in active_ids(res)
+
+    def test_negative_queue_get_nonblocking(self, tmp_path):
+        # get(False) / get(block=False) return immediately — the
+        # standard non-blocking idiom must not fail the gate
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = queue.Queue()\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            return self._q.get(False)\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            return self._q.get(block=False)\n"
+        ))
+        assert "GL602" not in active_ids(res)
+
+    def test_negative_cond_wait_on_held_condition(self, tmp_path):
+        # Condition.wait RELEASES the held condition — correct idiom
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._cond = threading.Condition()\n"
+            "    def a(self):\n"
+            "        with self._cond:\n"
+            "            self._cond.wait()\n"
+        ))
+        assert "GL602" not in active_ids(res)
+
+    def test_positive_cond_wait_still_holding_other(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cond = threading.Condition()\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            with self._cond:\n"
+            "                self._cond.wait()\n"
+        ))
+        assert "GL602" in active_ids(res)
+
+    def test_negative_sleep_outside_lock(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            x = 1\n"
+            "        time.sleep(1.0)\n"
+        ))
+        assert "GL602" not in active_ids(res)
+
+    def test_negative_str_join_is_not_blocking(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.names = ['a']\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            return ', '.join(self.names)\n"
+        ))
+        assert "GL602" not in active_ids(res)
+
+    def test_suppressed(self, tmp_path):
+        res = lint_src(tmp_path, LOCKS_HEADER + (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1.0)  "
+            "# graftlint: disable=GL602 (fixture)\n"
+        ))
+        assert "GL602" not in active_ids(res)
+        assert "GL602" in all_ids(res)
+
+
 class TestParseErrors:
     def test_unparseable_file_is_reported(self, tmp_path):
         res = lint_src(tmp_path, "def broken(:\n")
@@ -821,9 +1705,11 @@ class TestCLI:
         assert doc["rules"] == sorted(RULES_BY_ID)
         (f,) = [x for x in doc["findings"] if not x["suppressed"]]
         assert set(f) == {
-            "path", "line", "rule", "name", "message", "hint", "suppressed"
+            "path", "line", "rule", "name", "severity", "message",
+            "hint", "suppressed",
         }
         assert f["rule"] == "GL101"
+        assert f["severity"] == "error"
         assert f["line"] == 5
 
     def test_clean_tree_exits_zero(self, tmp_path):
@@ -893,3 +1779,275 @@ class TestCLI:
         doc = json.loads(r.stdout)
         assert len(doc["parse_errors"]) == 1
         assert doc["parse_errors"][0].endswith("broken.py")
+
+    def test_list_rules_shows_all_families(self):
+        r = self._run("--list-rules")
+        assert r.returncode == 0
+        for fam in ("GL101", "GL201", "GL301", "GL401", "GL402", "GL403",
+                    "GL501", "GL502", "GL503", "GL504", "GL601", "GL602"):
+            assert fam in r.stdout, f"{fam} missing from --list-rules"
+        assert "[warning]" in r.stdout  # GL503's severity is surfaced
+
+    def test_warning_severity_does_not_gate(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            "import jax\nimport jax.numpy as jnp\n"
+            "from jax.experimental import pallas as pl\n"
+            "from jax.experimental.pallas import tpu as pltpu\n"
+            "def kern(x_ref, o_ref, acc_ref):\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def call(x, M):\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        out_shape=jax.ShapeDtypeStruct((M, 128), jnp.float32),\n"
+            "        scratch_shapes=[pltpu.VMEM((2048, 4096),\n"
+            "                                   jnp.float32)],\n"
+            "    )(x)\n"
+        )
+        r = self._run("--json", str(tmp_path))
+        doc = json.loads(r.stdout)
+        assert r.returncode == 0, "a lone GL503 warning must not gate"
+        assert doc["summary"]["active"] == 1
+        assert doc["summary"]["warnings"] == 1
+        (f,) = doc["findings"]
+        assert f["rule"] == "GL503" and f["severity"] == "warning"
+
+    def test_vmem_budget_flag(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            "import jax\nimport jax.numpy as jnp\n"
+            "from jax.experimental import pallas as pl\n"
+            "from jax.experimental.pallas import tpu as pltpu\n"
+            "def kern(x_ref, o_ref, acc_ref):\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def call(x, M):\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        out_shape=jax.ShapeDtypeStruct((M, 128), jnp.float32),\n"
+            "        scratch_shapes=[pltpu.VMEM((2048, 4096),\n"
+            "                                   jnp.float32)],\n"
+            "    )(x)\n"
+        )
+        doc = json.loads(
+            self._run("--json", "--vmem-budget", "64", str(tmp_path)).stdout
+        )
+        assert doc["summary"]["active"] == 0
+        doc = json.loads(
+            self._run("--json", "--vmem-budget", "8", str(tmp_path)).stdout
+        )
+        assert doc["summary"]["active"] == 1
+
+
+class TestSarifOutput:
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, str(GRAFTLINT), *argv],
+            capture_output=True, text=True, cwd=str(REPO),
+        )
+
+    def _fixture(self, tmp_path):
+        (tmp_path / "m.py").write_text(JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    print(x)\n"
+            "    return x.item()\n"
+            "@jax.jit\n"
+            "def g(x):\n"
+            "    return x.tolist()  # graftlint: disable=GL101 (fixture)\n"
+        ))
+
+    def test_schema_and_determinism(self, tmp_path):
+        self._fixture(tmp_path)
+        r1 = self._run("--format", "sarif", str(tmp_path))
+        r2 = self._run("--format", "sarif", str(tmp_path))
+        assert r1.returncode == 1  # active findings still gate
+        assert r1.stdout == r2.stdout, "SARIF must be deterministic"
+        doc = json.loads(r1.stdout)
+        assert doc["version"] == "2.1.0"
+        assert "sarif" in doc["$schema"]
+        (run,) = doc["runs"]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "graftlint"
+        rule_ids = [r["id"] for r in driver["rules"]]
+        assert rule_ids == sorted(rule_ids)
+        from differential_transformer_replication_tpu.analysis import (
+            RULES_BY_ID as _R,
+        )
+        assert set(rule_ids) == set(_R)
+        for res in run["results"]:
+            assert set(res) >= {"ruleId", "level", "message", "locations"}
+            (loc,) = res["locations"]
+            phys = loc["physicalLocation"]
+            assert phys["artifactLocation"]["uri"].endswith("m.py")
+            assert phys["region"]["startLine"] >= 1
+
+    def test_suppressed_findings_carried_as_suppressions(self, tmp_path):
+        self._fixture(tmp_path)
+        doc = json.loads(
+            self._run("--format", "sarif", str(tmp_path)).stdout
+        )
+        sup = [
+            r for r in doc["runs"][0]["results"] if r.get("suppressions")
+        ]
+        assert len(sup) == 1
+        assert sup[0]["suppressions"] == [{"kind": "inSource"}]
+
+    def test_warning_level_mapped(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            "import jax\nimport jax.numpy as jnp\n"
+            "from jax.experimental import pallas as pl\n"
+            "from jax.experimental.pallas import tpu as pltpu\n"
+            "def kern(x_ref, o_ref, acc_ref):\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def call(x, M):\n"
+            "    return pl.pallas_call(\n"
+            "        kern,\n"
+            "        out_shape=jax.ShapeDtypeStruct((M, 128), jnp.float32),\n"
+            "        scratch_shapes=[pltpu.VMEM((2048, 4096),\n"
+            "                                   jnp.float32)],\n"
+            "    )(x)\n"
+        )
+        r = self._run("--format", "sarif", str(tmp_path))
+        assert r.returncode == 0
+        doc = json.loads(r.stdout)
+        (res,) = doc["runs"][0]["results"]
+        assert res["ruleId"] == "GL503" and res["level"] == "warning"
+
+    def test_json_conflict_is_usage_error(self, tmp_path):
+        (tmp_path / "m.py").write_text("x = 1\n")
+        r = self._run("--json", "--format", "sarif", str(tmp_path))
+        assert r.returncode == 2
+
+
+class TestChangedMode:
+    def _run(self, *argv, cwd):
+        return subprocess.run(
+            [sys.executable, str(GRAFTLINT), *argv],
+            capture_output=True, text=True, cwd=cwd,
+        )
+
+    def _git(self, cwd, *argv):
+        return subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *argv],
+            cwd=str(cwd), capture_output=True, text=True, check=True,
+        )
+
+    def _repo(self, tmp_path):
+        self._git(tmp_path, "init", "-q")
+        (tmp_path / "old_bad.py").write_text(JIT_HEADER + (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.item()\n"
+        ))
+        self._git(tmp_path, "add", "old_bad.py")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        return tmp_path
+
+    def test_only_changed_files_reported(self, tmp_path):
+        repo = self._repo(tmp_path)
+        # a NEW untracked hazard file and an UNCHANGED committed one
+        (repo / "new_bad.py").write_text(JIT_HEADER + (
+            "@jax.jit\n"
+            "def g(x):\n"
+            "    print(x)\n"
+            "    return x\n"
+        ))
+        r = self._run("--changed", "HEAD", "--json", ".", cwd=str(repo))
+        doc = json.loads(r.stdout)
+        assert r.returncode == 1
+        assert doc["changed_vs"] == "HEAD"
+        paths = {f["path"] for f in doc["findings"]}
+        assert all(p.endswith("new_bad.py") for p in paths), paths
+        # ...while the full run still sees both
+        r_full = self._run("--json", ".", cwd=str(repo))
+        full_paths = {
+            f["path"] for f in json.loads(r_full.stdout)["findings"]
+        }
+        assert any(p.endswith("old_bad.py") for p in full_paths)
+
+    def test_unchanged_tree_exits_zero(self, tmp_path):
+        repo = self._repo(tmp_path)
+        r = self._run("--changed", "HEAD", ".", cwd=str(repo))
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_call_graph_spans_whole_tree(self, tmp_path):
+        # the hazard lives in an UNTOUCHED helper module; the CHANGED
+        # file jits a function that calls it. Cross-module reachability
+        # must survive the file filter: the finding lands in the helper
+        # (unchanged -> filtered out, exit 0), but the jit-region count
+        # proves the whole tree was analyzed, and editing the helper
+        # itself surfaces it.
+        repo = self._repo(tmp_path)
+        (repo / "helper.py").write_text(
+            "def deep(x):\n"
+            "    return x.item()\n"
+        )
+        self._git(repo, "add", "helper.py")
+        self._git(repo, "commit", "-qm", "helper")
+        (repo / "entry.py").write_text(JIT_HEADER + (
+            "from helper import deep\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return deep(x)\n"
+        ))
+        r = self._run("--changed", "HEAD", "--json", ".", cwd=str(repo))
+        doc = json.loads(r.stdout)
+        # finding is attributed to helper.py (unchanged) -> filtered;
+        # nothing in entry.py itself
+        assert all(
+            not f["path"].endswith("entry.py") for f in doc["findings"]
+        )
+        # whole-tree analysis really happened (files_scanned is global)
+        assert doc["files_scanned"] == 3
+        # now touch the helper too: the finding surfaces in changed mode
+        (repo / "helper.py").write_text(
+            "def deep(x):\n"
+            "    return x.item()\n"
+            "\n"
+            "def deep2(x):\n"
+            "    return x\n"
+        )
+        r2 = self._run("--changed", "HEAD", "--json", ".", cwd=str(repo))
+        doc2 = json.loads(r2.stdout)
+        assert any(
+            f["path"].endswith("helper.py") and f["rule"] == "GL101"
+            for f in doc2["findings"]
+        )
+        assert r2.returncode == 1
+
+    def test_findings_survive_symlinked_path(self, tmp_path):
+        # git reports the PHYSICAL toplevel; reaching the repo through
+        # a symlink must not silently filter every finding (gate would
+        # pass on real hazards)
+        (tmp_path / "real").mkdir()
+        repo = self._repo(tmp_path / "real")
+        (repo / "new_bad.py").write_text(JIT_HEADER + (
+            "@jax.jit\n"
+            "def g(x):\n"
+            "    return x.item()\n"
+        ))
+        link = tmp_path / "link"
+        link.symlink_to(repo)
+        r = self._run("--changed", "HEAD", "--json", ".", cwd=str(link))
+        doc = json.loads(r.stdout)
+        assert r.returncode == 1
+        assert any(
+            f["path"].endswith("new_bad.py") for f in doc["findings"]
+        )
+
+    def test_bad_ref_is_usage_error(self, tmp_path):
+        repo = self._repo(tmp_path)
+        r = self._run("--changed", "no-such-ref", ".", cwd=str(repo))
+        assert r.returncode == 2
+        assert "git diff" in r.stderr
+
+    def test_outside_git_is_usage_error(self, tmp_path):
+        (tmp_path / "m.py").write_text("x = 1\n")
+        import os as _os
+        env_dir = tmp_path / "isolated"
+        env_dir.mkdir()
+        (env_dir / "m.py").write_text("x = 1\n")
+        r = subprocess.run(
+            [sys.executable, str(GRAFTLINT), "--changed", "HEAD", "m.py"],
+            capture_output=True, text=True, cwd=str(env_dir),
+            env={**_os.environ, "GIT_CEILING_DIRECTORIES": str(tmp_path)},
+        )
+        assert r.returncode == 2
