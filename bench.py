@@ -20,6 +20,10 @@ and BASELINE.md for the number's provenance and hardware caveat).
 Env overrides: BENCH_STEPS, BENCH_WARMUP, BENCH_MICRO_BATCH, BENCH_MODEL,
 BENCH_ATTN ("xla" | "pallas"), BENCH_FFN ("xla" | "pallas"),
 BENCH_REMAT/BENCH_REMAT_POLICY, BENCH_LOSS_CHUNK.
+
+BENCH_OUT=path appends the JSON line to a history file (one line per
+run) — the trajectory ``tools/perf_gate.py`` gates and
+``tools/bench_trend.py`` renders.
 """
 
 from __future__ import annotations
@@ -162,11 +166,17 @@ def main() -> None:
     # convention: per token per layer, each of the S softmax streams does
     # a QK and a PV contraction over ~(T+1)/2 visible keys.
     from differential_transformer_replication_tpu.models import param_count
+    from differential_transformer_replication_tpu.obs.xprof import (
+        embedding_param_count,
+    )
 
     rm = cfg.resolved_model()
     n_params = param_count(state["params"])
-    n_embed = model.vocab_size * model.n_embd + (
-        model.block_size * model.n_embd if model_kind == "diff" else 0
+    # one shared definition of "non-embedding params" (obs/xprof.py) so
+    # this mfu_6nd and the continuous device_mfu gauge subtract the
+    # same N
+    n_embed = embedding_param_count(
+        model_kind, model.vocab_size, model.n_embd, model.block_size
     )
     flops_per_tok = 6 * (n_params - n_embed)
     n_streams = {"control": 1, "diff": 2, "ndiff": rm.n_terms}[model_kind]
@@ -178,31 +188,35 @@ def main() -> None:
     flops_per_tok_attn = flops_per_tok + 3 * attn_fwd
     peak = 197e12  # TPU v5e bf16 peak FLOP/s
 
-    print(
-        json.dumps(
-            {
-                "metric": "train_tokens_per_sec_per_chip",
-                "value": round(tps, 1),
-                "unit": "tokens/sec",
-                # vs the deliberately GENEROUS estimate of the reference on
-                # a modern GPU (see header) — the conservative ratio
-                "vs_baseline": round(tps / REFERENCE_TOKENS_PER_SEC, 2),
-                # vs the only MEASURED reference number (torch on this
-                # host's CPU; tools/measure_reference.py)
-                "vs_reference_measured_cpu": round(
-                    tps / REFERENCE_TOKENS_PER_SEC_MEASURED_CPU, 1
-                ),
-                "mfu_6nd": round(tps * flops_per_tok / peak, 3),
-                "mfu_attn_incl": round(tps * flops_per_tok_attn / peak, 3),
-                # dispersion across the timing windows, machine-readable:
-                # `value` is min-of-N (least-contended estimate on the
-                # shared chip); median + raw windows let readers compare
-                # like-for-like estimators across rounds (ADVICE r2)
-                "tokens_per_sec_median": round(tps_median, 1),
-                "window_secs": [round(w, 4) for w in window_secs],
-            }
-        )
+    line = json.dumps(
+        {
+            "metric": "train_tokens_per_sec_per_chip",
+            "value": round(tps, 1),
+            "unit": "tokens/sec",
+            # vs the deliberately GENEROUS estimate of the reference on
+            # a modern GPU (see header) — the conservative ratio
+            "vs_baseline": round(tps / REFERENCE_TOKENS_PER_SEC, 2),
+            # vs the only MEASURED reference number (torch on this
+            # host's CPU; tools/measure_reference.py)
+            "vs_reference_measured_cpu": round(
+                tps / REFERENCE_TOKENS_PER_SEC_MEASURED_CPU, 1
+            ),
+            "mfu_6nd": round(tps * flops_per_tok / peak, 3),
+            "mfu_attn_incl": round(tps * flops_per_tok_attn / peak, 3),
+            # dispersion across the timing windows, machine-readable:
+            # `value` is min-of-N (least-contended estimate on the
+            # shared chip); median + raw windows let readers compare
+            # like-for-like estimators across rounds (ADVICE r2)
+            "tokens_per_sec_median": round(tps_median, 1),
+            "window_secs": [round(w, 4) for w in window_secs],
+        }
     )
+    print(line)
+    # append to the trajectory file perf_gate/bench_trend consume
+    out_path = os.environ.get("BENCH_OUT")
+    if out_path:
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
     # diagnostics on stderr so stdout stays one JSON line
     print(
         f"[bench] model={model_kind} attn={attn} ffn={ffn} "

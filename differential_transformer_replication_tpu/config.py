@@ -242,6 +242,16 @@ class ServingConfig:
     # device call is synchronous — but operators/load-balancers can
     # route around it). 0 = watchdog off.
     step_time_budget_s: float = 0.0
+    # Continuous on-device profiling (obs/device_profile.py): every
+    # this-many engine iterations, wrap ONE iteration in a
+    # jax.profiler capture, parse it off-loop, and publish the
+    # per-kernel step decomposition as device_* gauges on /metrics,
+    # {"record":"device_profile"} JSONL rows, and a stitchable
+    # device-lane Chrome trace — all under <profile_dir>. Uncaptured
+    # iterations pay one integer compare; the decode compile count
+    # stays 1 (capture wraps an already-compiled step). 0 = off.
+    profile_every: int = 0
+    profile_dir: str = "device_profiles"
     # Serving-side overrides of the corresponding ModelConfig knobs,
     # applied by ServingEngine at build: a checkpoint trained with the
     # defaults can still serve with the fused decode kernel / quantized
@@ -277,6 +287,10 @@ class ServingConfig:
         if self.max_restarts < 0:
             raise ValueError(
                 f"max_restarts must be >= 0, got {self.max_restarts}"
+            )
+        if self.profile_every < 0:
+            raise ValueError(
+                f"profile_every must be >= 0, got {self.profile_every}"
             )
         if self.prefill_chunk < 1 or (
             self.prefill_chunk & (self.prefill_chunk - 1)
@@ -499,6 +513,20 @@ class TrainConfig:
     # Profiling: capture a jax.profiler trace of a few steady-state steps
     # into this directory (TensorBoard/Perfetto viewable); None = off.
     profile_dir: Optional[str] = None
+    # Continuous on-device profiling (obs/device_profile.py): every
+    # this-many iterations, wrap ONE train step in a jax.profiler
+    # capture, parse it off-loop, and publish the per-kernel step
+    # decomposition + derived MFU as device_* gauges (the --metrics-port
+    # sidecar), {"record":"device_profile"} rows in metrics.jsonl, and a
+    # device-lane Chrome trace stitchable under the host timeline
+    # (tools/trace_stitch.py). Mutually exclusive in practice with a
+    # profile_dir window (the jax profiler is global; an overlapping
+    # capture is counted as a failure, never fatal). 0 = off.
+    profile_every: int = 0
+    # Rotating spool for the sampled captures; "auto" derives
+    # `<checkpoint_path stem>.profiles` so concurrent runs in one
+    # directory never share a spool.
+    profile_spool_dir: str = "auto"
 
     # Observability (obs/; no reference analog).
     # Prometheus sidecar: serve the trainer's metrics registry at
@@ -642,6 +670,17 @@ class TrainConfig:
 
         root, _ = os.path.splitext(self.checkpoint_path)
         return f"{root}.steps"
+
+    def resolved_profile_spool(self) -> str:
+        """Spool dir for sampled device-profile captures
+        (obs/device_profile.py); "auto" keys it off checkpoint_path
+        like the rotation tree."""
+        if self.profile_spool_dir != "auto":
+            return self.profile_spool_dir
+        import os
+
+        root, _ = os.path.splitext(self.checkpoint_path)
+        return f"{root}.profiles"
 
     seed: int = 1337  # train.py:329-330
 

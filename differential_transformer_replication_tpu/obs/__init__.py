@@ -32,6 +32,20 @@ Cross-process additions (ISSUE 7):
   against the registry's own histograms/counters, re-exposed as
   ``slo_*`` burn-rate gauges and CI-gated by ``tools/slo_report.py``.
 
+Device-side additions (ISSUE 12):
+
+- :mod:`obs.xprof` — stdlib xplane-protobuf parsing (the
+  ``jax.profiler`` capture format): per-kernel bucket attribution
+  (flash/fused-FFN/decode-attention/collectives/rest), step-time
+  decomposition, derived MFU/HBM estimates, and Chrome-trace
+  conversion for the stitched device lane.
+- :mod:`obs.device_profile` — the sampled capture-window scheduler:
+  every N steps/iterations one step is wrapped in a profiler capture,
+  parsed off-loop on a daemon worker, and published as ``device_*``
+  registry gauges, ``{"record": "device_profile"}`` JSONL rows, and a
+  device-lane trace ``tools/trace_stitch.py`` merges under the host
+  timeline. Gated in CI by ``tools/perf_gate.py``.
+
 :mod:`obs.introspect` adds the paper-level window: a jitted-cheap
 summary op extracting per-layer effective lambda (the Differential
 Transformer's central learnable quantity) and per-layer-group param
@@ -70,6 +84,9 @@ from differential_transformer_replication_tpu.obs.slo import (
 from differential_transformer_replication_tpu.obs.http import (
     start_metrics_server,
 )
+from differential_transformer_replication_tpu.obs.device_profile import (
+    DeviceProfileSampler,
+)
 
 __all__ = [
     "Counter",
@@ -90,4 +107,5 @@ __all__ = [
     "LatencyObjective",
     "SLOMonitor",
     "start_metrics_server",
+    "DeviceProfileSampler",
 ]

@@ -318,6 +318,13 @@ class EngineRunner:
                 "(stuck in an engine step?); leaking the thread — engine "
                 "state is untrusted, do not reuse this runner"
             )
+        # engine-side host resources (the device-profile sampler's
+        # parse worker) drain only after the loop thread is down — the
+        # engine is single-threaded by contract. getattr: test doubles
+        # keep their narrow surface.
+        engine_close = getattr(self.engine, "close", None)
+        if engine_close is not None:
+            engine_close()
 
     # -- internals -----------------------------------------------------
 
@@ -899,6 +906,16 @@ def main() -> None:
                    help="watchdog: mark the engine degraded on /health "
                         "when one decode iteration exceeds this many "
                         "seconds (0 = off)")
+    p.add_argument("--profile-every", type=int, default=0,
+                   help="continuous on-device profiling "
+                        "(obs/device_profile.py): every N engine "
+                        "iterations capture ONE iteration's device "
+                        "profile, parse it off-loop, and publish "
+                        "device_* gauges on /metrics, device_profile "
+                        "JSONL rows and a stitchable device-lane trace "
+                        "under --profile-dir; 0 = off")
+    p.add_argument("--profile-dir", default="device_profiles",
+                   help="rotating spool for --profile-every captures")
     p.add_argument("--trace-path", default=None,
                    help="write a Chrome-trace-event JSON of engine "
                         "iterations (schedule/prefill/decode/sample/emit "
@@ -985,6 +1002,8 @@ def main() -> None:
         restart_backoff_s=args.restart_backoff,
         restart_backoff_max_s=args.restart_backoff_max,
         step_time_budget_s=args.step_time_budget,
+        profile_every=args.profile_every,
+        profile_dir=args.profile_dir,
     )
     tracer = None
     if args.trace_path:

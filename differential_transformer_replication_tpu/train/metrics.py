@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import threading
 import time
 from typing import Optional
 
@@ -65,6 +66,12 @@ class MetricLogger:
         self.cfg = cfg
         self._jsonl = None
         self._wandb = None
+        # records arrive from the train loop AND from background
+        # producers (the device-profile sampler's parse worker routes
+        # its rows through log_record) — TextIOWrapper writes are not
+        # thread-safe, and a torn mid-line interleave would silently
+        # drop records at metrics_report's json.loads
+        self._emit_lock = threading.Lock()
         # multi-host: only process 0 writes logs/files (every process
         # would otherwise duplicate records and race on the jsonl)
         self._primary = jax.process_index() == 0
@@ -167,10 +174,11 @@ class MetricLogger:
 
     def _emit(self, payload: dict) -> None:
         payload.setdefault("ts", round(time.time(), 3))
-        if self._jsonl is not None:
-            self._jsonl.write(json.dumps(payload) + "\n")
-        if self._wandb is not None:
-            self._wandb.log(payload)
+        with self._emit_lock:
+            if self._jsonl is not None:
+                self._jsonl.write(json.dumps(payload) + "\n")
+            if self._wandb is not None:
+                self._wandb.log(payload)
 
     def finish(self) -> None:
         if self._jsonl is not None:

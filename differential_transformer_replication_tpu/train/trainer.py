@@ -554,6 +554,56 @@ def train(cfg: TrainConfig) -> dict:
         make_param_summary(model_cfg) if cfg.mesh.pipeline <= 1 else None
     )
 
+    # Continuous on-device profiling (obs/device_profile.py): every
+    # profile_every iterations one step is wrapped in a jax.profiler
+    # capture, parsed off-loop, and published as device_* gauges,
+    # {"record":"device_profile"} metrics.jsonl rows, and a stitchable
+    # device-lane trace. The FLOPs/HBM estimates feed the derived
+    # device_mfu gauge with bench.py's exact 6*N*D accounting, so the
+    # continuous samples and bench rounds are directly comparable.
+    device_prof = None
+    if cfg.profile_every > 0 and is_primary():
+        from differential_transformer_replication_tpu.models import (
+            param_count,
+        )
+        from differential_transformer_replication_tpu.obs import xprof
+        from differential_transformer_replication_tpu.obs.device_profile import (
+            DeviceProfileSampler,
+        )
+
+        n_params = param_count(state["params"])
+        n_embed = xprof.embedding_param_count(
+            model_cfg.model, model_cfg.vocab_size, model_cfg.n_embd,
+            model_cfg.block_size,
+        )
+        tokens_per_step = (
+            cfg.micro_batch_size * cfg.grad_acc_steps * model_cfg.block_size
+        )
+        # the parsed plane is ONE device's timeline and the MFU
+        # denominator is ONE chip's peak, so the numerator must be the
+        # PER-CHIP share of the step's work — on an n-device mesh each
+        # chip executes ~1/n of the global FLOPs (data splits the
+        # batch, tensor/fsdp/pipeline split the math), and the same
+        # division approximates per-chip HBM traffic (right for
+        # sharded params; an underestimate for DP-replicated ones,
+        # which re-read the full set per chip — roofline-order only)
+        n_dev = max(1, cfg.mesh.n_devices)
+        device_prof = DeviceProfileSampler(
+            every=cfg.profile_every,
+            spool_dir=cfg.resolved_profile_spool(),
+            registry=registry,
+            sink=logger.log_record,
+            jsonl_path=None,  # rows ride the run's own metrics.jsonl
+            tracer=tracer,
+            process="trainer",
+            flops_per_step=xprof.train_flops_per_step(
+                n_params, n_embed, tokens_per_step
+            ) / n_dev,
+            hbm_bytes_per_step=(
+                xprof.train_hbm_bytes_per_step(n_params) / n_dev
+            ),
+        )
+
     def _compile_entries():
         """Compile-cache size of the jitted step (None when the step
         wrapper does not expose one): steady state must hold at 1; a
@@ -713,9 +763,20 @@ def train(cfg: TrainConfig) -> dict:
                     (cfg.grad_acc_steps,), scale, np.float32
                 )
             rng = jax.random.fold_in(dropout_key, iter_num) if use_dropout else None
+            # non-due steps pay one integer compare here; a due step
+            # opens a capture window around exactly this dispatch
+            capturing = (
+                device_prof is not None
+                and device_prof.maybe_begin(iter_num)
+            )
             with tracer.span("dispatch", iter=iter_num):
                 state, metrics = train_step(state, batch, rng)
             iter_num += 1
+            if capturing:
+                # closes the window (blocking on the step's loss so the
+                # device work is inside it) and hands the trace to the
+                # off-loop parse worker
+                device_prof.end(sync=metrics["loss"])
             profiler.step(iter_num, sync=metrics["loss"])
             tokens_seen += cfg.micro_batch_size * cfg.grad_acc_steps * model_cfg.block_size
 
@@ -963,7 +1024,15 @@ def train(cfg: TrainConfig) -> dict:
             if ckpt_writer is not None:
                 ckpt_writer.close(timeout=600.0)
 
-        for closer in (_drain_ckpt_writer, profiler.close, logger.finish,
+        def _drain_device_prof():
+            # finish the queued device-profile parse (its record must
+            # land in metrics.jsonl before logger.finish closes it) and
+            # stop any capture window a crashed step left open
+            if device_prof is not None:
+                device_prof.close()
+
+        for closer in (_drain_device_prof, _drain_ckpt_writer,
+                       profiler.close, logger.finish,
                        _close_tracer, _stop_metrics_server):
             try:
                 closer()

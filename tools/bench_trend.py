@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Render the committed bench trajectory as one machine-readable line.
+
+The driver archives every bench round at the repo root —
+``BENCH_r0*.json`` (single-chip train step: tokens/sec, vs_baseline,
+mfu_6nd, and the run's final loss in the stderr ``tail``) and
+``MULTICHIP_r0*.json`` (the 8-device dry-run result). This tool reads
+that history and prints ONE JSON summary line, so "are we still getting
+faster round over round?" is a jq expression instead of five file
+opens::
+
+    python tools/bench_trend.py                  # repo-root BENCH_r*/MULTICHIP_r*
+    python tools/bench_trend.py --ascii          # + sparklines on stderr
+    python tools/bench_trend.py BENCH_r0*.json   # explicit round files
+
+Baseline math is IMPORTED from tools/perf_gate.py (median + MAD over
+the trailing window) so this trend view and the CI gate judge a
+trajectory identically — the summary's per-series ``baseline`` block is
+exactly what ``perf_gate.py --key`` would gate the next round against.
+
+Caveat carried in the output: rounds r01–r05 predate the PR 9–10 fused
+kernels (Pallas SwiGLU/norm, decode attention, int8 KV) — their numbers
+measure the pre-kernel hot path, so the next hardware round is expected
+to step, not drift. Stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from perf_gate import baseline_stats  # noqa: E402  (shared gate math)
+
+PREDATE_NOTE = (
+    "rounds r01-r05 predate the PR 9-10 fused kernels "
+    "(Pallas SwiGLU/norm, decode attention, int8 KV): their numbers "
+    "measure the pre-kernel hot path"
+)
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(xs: List[Optional[float]]) -> str:
+    vals = [x for x in xs if x is not None]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    out = []
+    for x in xs:
+        if x is None:
+            out.append(" ")
+        else:
+            out.append(_SPARK[int((x - lo) / span * (len(_SPARK) - 1))])
+    return "".join(out)
+
+
+def _round_of(path: str) -> int:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def load_round(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    out = {
+        "round": _round_of(path),
+        "file": os.path.basename(path),
+        "rc": doc.get("rc") if isinstance(doc, dict) else None,
+    }
+    if isinstance(parsed, dict):
+        out["value"] = parsed.get("value")
+        out["vs_baseline"] = parsed.get("vs_baseline")
+        out["mfu_6nd"] = parsed.get("mfu_6nd")
+    # the run's final training loss only appears in the archived stderr
+    # tail ("loss=9.0810"); a missing tail degrades to None
+    tail = doc.get("tail", "") if isinstance(doc, dict) else ""
+    m = re.search(r"loss=([0-9.]+)", tail or "")
+    out["loss"] = float(m.group(1).rstrip(".")) if m else None
+    return out
+
+
+def _series(rounds: List[dict], key: str) -> List[Optional[float]]:
+    return [r.get(key) for r in rounds]
+
+
+def _baseline(series: List[Optional[float]], window: int) -> Optional[dict]:
+    vals = [v for v in series if v is not None]
+    if len(vals) < 2:
+        return None
+    med, noise = baseline_stats(vals[-window:])
+    return {"median": round(med, 4), "mad": round(noise, 4),
+            "window_n": min(window, len(vals))}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("files", nargs="*",
+                   help="round archives (default: BENCH_r*.json next "
+                        "to the repo root, MULTICHIP_r*.json alongside)")
+    p.add_argument("--multichip", action="append", default=None,
+                   help="MULTICHIP round archives (default: globbed "
+                        "beside the BENCH files)")
+    p.add_argument("--window", type=int, default=5,
+                   help="trailing rounds forming the baseline block "
+                        "(perf_gate math)")
+    p.add_argument("--ascii", action="store_true",
+                   help="also draw per-series sparklines on stderr")
+    args = p.parse_args()
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench_files = args.files or sorted(
+        glob.glob(os.path.join(root, "BENCH_r*.json")), key=_round_of
+    )
+    if not bench_files:
+        print(json.dumps({"metric": "bench_trend",
+                          "error": "no BENCH_r*.json rounds found"}))
+        return 2
+    bench_files = sorted(bench_files, key=_round_of)
+    multichip_files = sorted(
+        args.multichip if args.multichip is not None else
+        glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(bench_files[0])) or ".",
+            "MULTICHIP_r*.json",
+        )),
+        key=_round_of,
+    )
+
+    rounds = [load_round(p_) for p_ in bench_files]
+    series = {
+        key: _series(rounds, key)
+        for key in ("value", "vs_baseline", "mfu_6nd", "loss")
+    }
+    multichip_ok = []
+    for p_ in multichip_files:
+        try:
+            with open(p_, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            multichip_ok.append(bool(doc.get("ok")))
+        except (OSError, json.JSONDecodeError):
+            multichip_ok.append(False)
+
+    summary = {
+        "metric": "bench_trend",
+        "rounds": [r["round"] for r in rounds],
+        "tokens_per_sec": series["value"],
+        "vs_baseline": series["vs_baseline"],
+        "mfu_6nd": series["mfu_6nd"],
+        "loss": series["loss"],
+        "multichip_ok": multichip_ok,
+        # perf_gate's exact baseline math over the same window: what
+        # the NEXT round will be judged against
+        "baseline": {
+            key: _baseline(series[key], args.window)
+            for key in ("value", "vs_baseline", "mfu_6nd")
+        },
+        "note": PREDATE_NOTE,
+    }
+    print(json.dumps(summary))
+    if args.ascii:
+        for key in ("value", "vs_baseline", "mfu_6nd", "loss"):
+            vals = series[key]
+            shown = [f"{v:g}" if v is not None else "-" for v in vals]
+            print(f"[bench_trend] {key:14s} {sparkline(vals)}  "
+                  f"({' '.join(shown)})", file=sys.stderr)
+        print(f"[bench_trend] NOTE: {PREDATE_NOTE}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,8 +3,10 @@
 
 Reads the trainer's JSONL stream (train/metrics.py) and prints ONE JSON
 summary line — loss trajectory, step-time percentiles, data-stall
-fraction, anomaly-guard totals, throughput — so a post-run script (or a
-human) gets the health of a run without scraping stdout::
+fraction, anomaly-guard totals, throughput, and the continuous
+profiler's device_profile rows (last-seen MFU/busy-ms + capture-failure
+count; obs/device_profile.py) — so a post-run script (or a human) gets
+the health of a run without scraping stdout::
 
     python tools/metrics_report.py metrics.jsonl
     python tools/metrics_report.py metrics.jsonl --check \
@@ -35,7 +37,8 @@ def _percentile(xs, q):
 
 
 def load(path: str) -> dict:
-    headers, steps, evals, intro = [], [], [], []
+    headers, steps, evals, intro, device = [], [], [], [], []
+    unknown: dict = {}
     with open(path) as fh:
         for line in fh:
             line = line.strip()
@@ -50,12 +53,20 @@ def load(path: str) -> dict:
                 headers.append(rec)
             elif kind == "introspection":
                 intro.append(rec)
+            elif kind == "device_profile":
+                # the continuous profiler's rows (obs/device_profile.py)
+                device.append(rec)
+            elif kind is not None:
+                # typed records this tool does not understand are
+                # COUNTED, not silently dropped — a new record type
+                # shows up in the summary the day it ships
+                unknown[kind] = unknown.get(kind, 0) + 1
             elif "val_loss" in rec:
                 evals.append(rec)
             elif "loss" in rec:
                 steps.append(rec)
     return {"headers": headers, "steps": steps, "evals": evals,
-            "intro": intro}
+            "intro": intro, "device": device, "unknown": unknown}
 
 
 def summarize(recs: dict) -> dict:
@@ -107,6 +118,28 @@ def summarize(recs: dict) -> dict:
     compiles = [r["compile_events"] for r in steps if "compile_events" in r]
     if compiles:
         out["compile_events_last"] = compiles[-1]
+    device = recs.get("device", [])
+    if device:
+        # continuous on-device profiling (obs/device_profile.py): the
+        # last-seen derived MFU and the cumulative capture/failure
+        # counts the rows carry
+        out["device_profile_records"] = len(device)
+        mfus = [r["mfu"] for r in device if "mfu" in r]
+        if mfus:
+            out["device_mfu_last"] = mfus[-1]
+        busy = [r["busy_ms"] for r in device if "busy_ms" in r]
+        if busy:
+            out["device_busy_ms_last"] = busy[-1]
+        fails = [
+            r["capture_failures"] for r in device
+            if "capture_failures" in r
+        ]
+        out["device_profile_capture_failures"] = (
+            fails[-1] if fails
+            else sum(1 for r in device if "error" in r)
+        )
+    if recs.get("unknown"):
+        out["unknown_records"] = recs["unknown"]
     return out
 
 
@@ -146,6 +179,14 @@ def check(summary: dict, args) -> list:
             f"{summary['compile_events_last']} train-step compile "
             f"entries > {args.max_compile_events} (retrace pathology)"
         )
+    if summary.get(
+        "device_profile_capture_failures", 0
+    ) > args.max_capture_failures:
+        bad.append(
+            f"{summary['device_profile_capture_failures']} device-"
+            f"profile capture failures > {args.max_capture_failures} "
+            "(the continuous profiler is not landing its samples)"
+        )
     return bad
 
 
@@ -170,6 +211,10 @@ def main() -> int:
     p.add_argument("--max-compile-events", type=int, default=0,
                    help="gate: train-step compile-cache ceiling "
                         "(0 = gate off; steady state is 1)")
+    p.add_argument("--max-capture-failures", type=int, default=0,
+                   help="gate: device-profile capture-failure budget "
+                        "(obs/device_profile.py; applies only when the "
+                        "stream carries device_profile records)")
     args = p.parse_args()
 
     path = args.from_jsonl or args.metrics

@@ -1,9 +1,11 @@
-"""Per-op profile of the flagship train step on the real TPU.
+"""Per-op profile of the flagship train step — the one-shot CLI.
 
 Captures a few steps under ``jax.profiler.trace`` and prints the
-device-side XLA op breakdown (grouped + top ops) by parsing the xplane
-protobuf with tensorflow's bundled proto (present in this image). This
-is the workflow that produced the step decompositions in BASELINE.md.
+device-side XLA op breakdown (grouped + top ops). This is the workflow
+that produced the step decompositions in BASELINE.md; the xplane
+parsing itself lives in ``obs/xprof.py`` (a stdlib wire-format reader,
+shared with the CONTINUOUS sampler ``obs/device_profile.py`` — this
+tool is now a thin capture+report shell over that library).
 
     python tools/profile_step.py [--steps 5] [--attn pallas] [--top 25]
     python tools/profile_step.py --json          # one machine-readable line
@@ -11,11 +13,13 @@ is the workflow that produced the step decompositions in BASELINE.md.
 ``--json`` emits the grouped breakdown as ONE JSON line (grouped op
 families, the custom-kernel buckets, device-busy ms/step, compile count)
 so before/after MFU deltas are diffable in CI instead of eyeballed from
-text. The fused Pallas kernels get their own buckets: ``flash_attention``
-(ops/flash.py), ``fused_ffn`` (ops/fused_ffn.py +
-ops/fused_norm_residual.py custom-call/fusion names) and
-``decode_attention`` (ops/decode_attention.py ``_dattn_*`` serving
-kernels, when profiling a decode workload).
+text. The fused Pallas kernels get their own buckets
+(obs/xprof.py:KERNEL_BUCKETS): ``flash_attention`` (ops/flash.py),
+``fused_ffn`` (ops/fused_ffn.py + ops/fused_norm_residual.py),
+``decode_attention`` (ops/decode_attention.py ``_dattn_*`` kernels) and
+``collectives`` (HLO communication ops). Without a TPU the breakdown
+degrades to the host plane (plumbing-grade) or an explicit ``error``
+field — never a crash.
 
 The capture window runs inside ``RecompileSentinel(budget=0)`` exactly
 like bench.py's measured window: a profile of a RETRACING step would
@@ -31,31 +35,12 @@ instrument is GPU-memory prints); this plus utils/profiling.py
 from __future__ import annotations
 
 import argparse
-import glob
 import json
-import re
 import sys
 import tempfile
-from collections import defaultdict
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
-
-# Custom-kernel buckets for the grouped breakdown: XLA names Pallas
-# programs after the kernel function (custom-call/fusion metadata), so
-# substring membership is stable across jax versions. The decode and
-# fused-FFN buckets are checked BEFORE flash: their kernel names
-# (_dattn_fwd_kernel, _ffn_fwd_kernel, _addnorm_*) end with the flash
-# needle "_fwd_kernel", so flash-first would swallow their time into
-# flash_attention and under-report the fused work.
-_KERNEL_BUCKETS = (
-    ("decode_attention", ("_dattn_",)),
-    ("fused_ffn", ("_ffn_fwd", "_ffn_bwd", "_addnorm_",
-                   "fused_ffn", "fused_norm", "fused_add_norm",
-                   "_swiglu2", "_norm2", "_add_norm2")),
-    ("flash_attention", ("_fwd_kernel", "_bwd_dq", "_bwd_dkv", "flash",
-                         "_tm_", "tm_packed")),
-)
 
 
 def capture(args):
@@ -107,61 +92,13 @@ def capture(args):
     return out_dir, sentinel.count
 
 
-def _parse_trace(out_dir: str, steps: int):
-    """(groups_ms_per_step, totals, counts, busy_ms_per_step) or an
-    error string when the xplane proto is unavailable."""
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except ImportError:
-        return "tensorflow's xplane proto is not importable here"
-
-    paths = glob.glob(f"{out_dir}/plugins/profile/*/*.xplane.pb")
-    if not paths:
-        return f"no xplane.pb under {out_dir}"
-    xs = xplane_pb2.XSpace()
-    with open(sorted(paths)[-1], "rb") as f:
-        xs.ParseFromString(f.read())
-    tpu = [p for p in xs.planes if p.name.startswith("/device:TPU")]
-    if not tpu:
-        return f"no TPU plane in the trace (planes: {[p.name for p in xs.planes]})"
-    plane = tpu[0]
-    meta = plane.event_metadata
-    line = max(
-        (l for l in plane.lines if l.name == "XLA Ops"),
-        key=lambda l: len(l.events),
-        default=None,
-    )
-    if line is None:
-        return "no 'XLA Ops' line in the TPU plane"
-
-    totals: dict = defaultdict(float)
-    counts: dict = defaultdict(int)
-    groups: dict = defaultdict(float)
-    buckets: dict = defaultdict(float)
-    for ev in line.events:
-        name = meta[ev.metadata_id].name
-        ms = ev.duration_ps / 1e9
-        totals[name] += ms
-        counts[name] += 1
-        m = re.match(r"%([a-zA-Z_\.]+)", name)
-        groups[m.group(1) if m else name[:24]] += ms
-        for bucket, needles in _KERNEL_BUCKETS:
-            if any(n in name for n in needles):
-                buckets[bucket] += ms
-                break
-    busy = sum(totals.values())
-    return {
-        "groups": {k: v / steps for k, v in groups.items()},
-        "kernel_buckets": {k: v / steps for k, v in buckets.items()},
-        "totals": totals,
-        "counts": counts,
-        "busy_ms_per_step": busy / steps,
-    }
-
-
 def report(out_dir: str, steps: int, top: int, compiles: int,
            as_json: bool) -> None:
-    parsed = _parse_trace(out_dir, steps)
+    from differential_transformer_replication_tpu.obs.xprof import (
+        summarize_trace,
+    )
+
+    parsed = summarize_trace(out_dir, steps=steps)
     if as_json:
         doc = {
             "metric": "profile_step_breakdown",
@@ -172,6 +109,12 @@ def report(out_dir: str, steps: int, top: int, compiles: int,
         if isinstance(parsed, str):
             doc["error"] = parsed
         else:
+            # which plane the numbers came from: plane_kind == "host"
+            # means the plumbing-grade fallback (no device plane in
+            # the capture — nested host events overcount), never to be
+            # diffed against real device telemetry
+            doc["plane"] = parsed["plane"]
+            doc["plane_kind"] = parsed["plane_kind"]
             doc["device_busy_ms_per_step"] = round(
                 parsed["busy_ms_per_step"], 3
             )
@@ -191,7 +134,11 @@ def report(out_dir: str, steps: int, top: int, compiles: int,
         return
     print(
         f"device busy: {parsed['busy_ms_per_step']:.2f} ms/step over "
-        f"{steps} steps ({compiles} compiles in window)\n"
+        f"{steps} steps ({compiles} compiles in window; "
+        f"{parsed['plane']} plane"
+        + (" — HOST fallback, plumbing-grade numbers"
+           if parsed["plane_kind"] == "host" else "")
+        + ")\n"
     )
     print("grouped by op family (ms/step):")
     for k, ms in sorted(parsed["groups"].items(), key=lambda kv: -kv[1])[:15]:

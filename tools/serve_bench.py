@@ -64,6 +64,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 from pathlib import Path
@@ -337,6 +338,14 @@ def main() -> None:
                         "0 = none")
     p.add_argument("--out", default=None,
                    help="also append the JSON line to this file")
+    p.add_argument("--profile-every", type=int, default=0,
+                   help="continuous on-device profiling of the "
+                        "in-process engine (obs/device_profile.py): "
+                        "capture every Nth engine iteration's device "
+                        "profile into <--trace-dir or a temp dir>/"
+                        "device_profiles (device_* gauges, "
+                        "device_profile JSONL rows, stitchable "
+                        "device-lane traces); 0 = off")
     p.add_argument("--trace-dir", default=None,
                    help="directory for span traces: the in-process "
                         "engine writes <dir>/serve_bench.engine."
@@ -410,6 +419,12 @@ def main() -> None:
         )
         params = init_model(jax.random.PRNGKey(args.seed), model_cfg)
 
+    profile_dir = None
+    if args.profile_every > 0:
+        profile_dir = os.path.join(
+            args.trace_dir or tempfile.mkdtemp(prefix="serve_bench_"),
+            "device_profiles",
+        )
     serving = ServingConfig(
         num_slots=args.num_slots, prefill_chunk=args.prefill_chunk,
         prefill_budget=args.prefill_budget,
@@ -417,6 +432,8 @@ def main() -> None:
         default_deadline_s=args.deadline,
         decode_attention_impl=args.decode_attention_impl,
         kv_cache_dtype=args.kv_cache_dtype,
+        profile_every=args.profile_every,
+        profile_dir=profile_dir or "device_profiles",
         # let RoPE families roll past block_size so a full-window prompt
         # plus new_tokens always fits (the diff family ignores this and
         # stays hard-capped at block_size)
@@ -641,6 +658,14 @@ def main() -> None:
         "slow_exemplars": _slow_exemplars(completed),
         "trace_dir": args.trace_dir,
         "compiles_in_window": sentinel.count,
+        # continuous-profiling summary (when --profile-every sampled
+        # this run): parsed capture count + where the device lanes and
+        # device_profile JSONL rows landed
+        "device_profile_captures": (
+            engine._device_prof.captures
+            if engine._device_prof is not None else 0
+        ),
+        "device_profile_dir": profile_dir,
         "model": model_cfg.model,
         # resolved from the ENGINE's config (serving-side overrides
         # applied) so the JSON names what actually ran

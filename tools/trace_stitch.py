@@ -29,6 +29,19 @@ span parented to it (matched by the propagated ``span_id`` →
 the median of the per-pair shifts that restore that enclosure —
 0 when the clocks already agree. ``--no-align`` keeps raw clocks.
 
+**Device lanes.** The continuous profiler (obs/device_profile.py)
+writes ``device-NNNN.trace.json`` files: the captured step's XLA-op
+timeline, wall-clock anchored, with one enclosing ``capture_window``
+event whose ``capture`` arg matches the ``device_capture`` host span
+the sampler emitted around the profiled step. Pass them alongside the
+host traces (host/reference trace FIRST) and each becomes its own
+process lane, aligned so the capture window sits exactly inside the
+host span that wrapped it — one Perfetto view from HTTP request (or
+trainer iteration) down to the Pallas kernels::
+
+    python tools/trace_stitch.py server.trace.json \
+        device_profiles/device-*.trace.json -o full.trace.json
+
 Also prints one JSON summary line (file count, event count, applied
 offsets, distinct trace ids) in the style of the other tools. Stdlib
 only; tolerant of truncated inputs (a crashed process's unterminated
@@ -83,15 +96,38 @@ def _spans_by_span_id(events: List[dict]) -> Dict[str, Tuple[float, float]]:
     return out
 
 
+def _capture_anchor_shifts(reference: List[dict],
+                           other: List[dict]) -> List[float]:
+    """Exact shifts aligning ``other``'s device ``capture_window``
+    events to the reference's ``device_capture`` host spans with the
+    same ``capture`` arg (the join key obs/device_profile.py stamps on
+    both sides of a sampled window)."""
+    ref_caps: Dict[object, float] = {}
+    for e in reference:
+        if e.get("ph") == "X" and e.get("name") == "device_capture":
+            cap = (e.get("args") or {}).get("capture")
+            if cap is not None:
+                ref_caps[cap] = float(e.get("ts", 0.0))
+    shifts: List[float] = []
+    for e in other:
+        if e.get("ph") == "X" and e.get("name") == "capture_window":
+            cap = (e.get("args") or {}).get("capture")
+            if cap in ref_caps:
+                shifts.append(ref_caps[cap] - float(e.get("ts", 0.0)))
+    return shifts
+
+
 def estimate_offset_us(reference: List[dict],
                        other: List[dict]) -> float:
     """Median shift (microseconds, added to ``other``) that places each
     of ``other``'s parented spans inside the reference span that caused
     it. Pairs come from the propagated trace context: an event in
     ``other`` whose ``parent_id`` names a ``span_id`` in ``reference``
-    was, by construction, caused DURING that reference span."""
+    was, by construction, caused DURING that reference span — or, for
+    device lanes, from capture-window join keys (exact alignment; see
+    :func:`_capture_anchor_shifts`)."""
     ref_spans = _spans_by_span_id(reference)
-    shifts: List[float] = []
+    shifts: List[float] = _capture_anchor_shifts(reference, other)
     for e in other:
         if e.get("ph") not in ("X", "i"):
             continue
@@ -139,7 +175,10 @@ def stitch(paths: List[str], align: bool = True,
             offsets[i] = estimate_offset_us(traces[0], traces[i])
     merged: List[dict] = []
     trace_ids = set()
+    device_lanes = 0
     for i, (path, events) in enumerate(zip(paths, traces)):
+        if any(e.get("name") == "capture_window" for e in events):
+            device_lanes += 1
         for e in events:
             e = dict(e)
             e["pid"] = i  # one lane per input file, collision-free
@@ -150,6 +189,9 @@ def stitch(paths: List[str], align: bool = True,
                     merged.append(e)
                 elif e.get("name") == "process_sort_index":
                     e["args"] = {"sort_index": i}
+                    merged.append(e)
+                elif e.get("name") == "thread_name":
+                    # device lanes label their xplane lines per thread
                     merged.append(e)
                 continue
             args = e.get("args") or {}
@@ -170,6 +212,7 @@ def stitch(paths: List[str], align: bool = True,
         "offsets_us": [round(o, 1) for o in offsets],
         "distinct_trace_ids": len(trace_ids),
         "filtered_trace_id": trace_id,
+        "device_lanes": device_lanes,
     }
     return merged, summary
 

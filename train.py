@@ -160,6 +160,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="capture a 5-step steady-state jax.profiler trace (starting "
              "~10 iters after this run begins/resumes) into this dir",
     )
+    p.add_argument("--profile-every", type=int, default=t.profile_every,
+                   help="continuous on-device profiling: every N "
+                        "iterations capture ONE step's device profile, "
+                        "parse it off-loop, and publish device_* "
+                        "gauges, device_profile metrics.jsonl rows and "
+                        "a stitchable device-lane trace "
+                        "(obs/device_profile.py); 0 = off")
+    p.add_argument("--profile-spool-dir", default=t.profile_spool_dir,
+                   help="rotating spool for --profile-every captures "
+                        "('auto' = <checkpoint stem>.profiles)")
     p.add_argument("--data-parallel", type=int, default=1,
                    help="devices on the data mesh axis")
     p.add_argument("--tensor-parallel", type=int, default=1,
@@ -237,6 +247,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         trace_path=args.trace_path,
         use_wandb=args.wandb,
         profile_dir=args.profile_dir,
+        profile_every=args.profile_every,
+        profile_spool_dir=args.profile_spool_dir,
     )
 
 
