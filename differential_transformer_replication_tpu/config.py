@@ -648,6 +648,40 @@ class TrainConfig:
     # buckets.
     dp_bucket_layers: int = 2
 
+    # Distributed-training resilience (train/watchdog.py,
+    # parallel/heartbeat.py). step_deadline_s is the trainer analogue
+    # of ServingConfig.step_time_budget_s: armed around each jitted-
+    # step dispatch/block (eval and checkpoint writes run disarmed); a
+    # hung iteration dumps hang_report.json (all-thread stacks, last
+    # device_profile row, compile counter) and exits with the distinct
+    # hang code the supervisor restarts under its own budget. Both are
+    # pure host-side threads: compile count is unaffected (pinned in
+    # tests/test_watchdog.py). 0 = off.
+    step_deadline_s: float = 0.0
+    # hang_report.json destination; "auto" derives
+    # `<checkpoint_path stem>.hang_report.json`.
+    hang_report_path: str = "auto"
+    # Multi-host liveness mesh: a shared-filesystem directory (every
+    # host must see it — the checkpoint mount qualifies) where each
+    # process publishes a heartbeat file every heartbeat_interval_s
+    # seconds off-loop. A peer silent past heartbeat_timeout_s trips
+    # the local watchdog immediately (coordinated abort) instead of
+    # waiting out a wedged collective. None = off.
+    heartbeat_dir: Optional[str] = None
+    heartbeat_interval_s: float = 1.0
+    heartbeat_timeout_s: float = 10.0
+    # Elastic resume: a checkpoint may be resumed onto a DIFFERENT
+    # mesh shape / global batch (checkpoints are stored host-canonical,
+    # so same param shapes reshard freely; the epoch sampler fast-
+    # forwards from the checkpoint's recorded consumed-window count so
+    # the permutation stays exact across batch-size changes). When
+    # exactness is impossible — the consumed count lands mid-way
+    # through a new-size accumulation boundary, or a legacy checkpoint
+    # predates the recorded count while the batch math changed — the
+    # resume raises a typed ElasticResumeError unless this escape
+    # hatch accepts the (bounded) inexactness.
+    allow_inexact_resume: bool = False
+
     # Fault injection spec (utils/faults.py), merged with the DTX_FAULTS
     # env var. Testing/chaos only; None = inert.
     faults: Optional[str] = None
@@ -670,6 +704,18 @@ class TrainConfig:
 
         root, _ = os.path.splitext(self.checkpoint_path)
         return f"{root}.steps"
+
+    def resolved_hang_report_path(self) -> str:
+        """Watchdog hang-report destination (train/watchdog.py);
+        "auto" keys it off checkpoint_path like the rotation tree, so
+        concurrent runs in one directory never clobber each other's
+        post-mortem."""
+        if self.hang_report_path != "auto":
+            return self.hang_report_path
+        import os
+
+        root, _ = os.path.splitext(self.checkpoint_path)
+        return f"{root}.hang_report.json"
 
     def resolved_profile_spool(self) -> str:
         """Spool dir for sampled device-profile captures

@@ -146,6 +146,7 @@ class DeviceProfileSampler:
         self._every = int(every)
         self._spool = spool_dir
         self._sink = sink
+        self.last_record = None  # newest emitted row (hang reports)
         self._tracer = tracer or NOOP_TRACER
         self._process = process
         self._keep = max(1, int(keep))
@@ -421,6 +422,12 @@ class DeviceProfileSampler:
 
     def _emit(self, record: dict) -> None:
         record.setdefault("ts", round(time.time(), 3))
+        # last published row, kept for the watchdog's hang report
+        # (train/watchdog.py): "what was the device doing the last
+        # time we could see it" is the first post-mortem question.
+        # Plain attribute swap — atomic under the GIL, read-only
+        # consumers (the hang report) tolerate a stale value.
+        self.last_record = record
         with self._emit_lock:
             if self._sink is not None:
                 self._sink(record)

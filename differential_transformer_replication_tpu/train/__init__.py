@@ -16,6 +16,8 @@ from differential_transformer_replication_tpu.train.step import (
 from differential_transformer_replication_tpu.train.checkpoint import (
     AsyncCheckpointWriter,
     CheckpointError,
+    ElasticResumeError,
+    elastic_resume_info,
     from_pretrained,
     load_checkpoint,
     resolve_resume_auto,
@@ -23,6 +25,10 @@ from differential_transformer_replication_tpu.train.checkpoint import (
     save_pretrained,
     save_step_checkpoint,
     verify_checkpoint,
+)
+from differential_transformer_replication_tpu.train.watchdog import (
+    HANG_EXIT_CODE,
+    StepWatchdog,
 )
 from differential_transformer_replication_tpu.train.metrics import MetricLogger
 from differential_transformer_replication_tpu.train.trainer import (
@@ -35,6 +41,10 @@ __all__ = [
     "TrainingDivergedError",
     "init_guard_state",
     "CheckpointError",
+    "ElasticResumeError",
+    "elastic_resume_info",
+    "HANG_EXIT_CODE",
+    "StepWatchdog",
     "cosine_warmup_schedule",
     "make_optimizer",
     "create_train_state",

@@ -46,7 +46,9 @@ from differential_transformer_replication_tpu.train.ckpt_writer import (
 __all__ = [
     "AsyncCheckpointWriter",
     "CheckpointError",
+    "ElasticResumeError",
     "canonicalize_state",
+    "elastic_resume_info",
     "from_pretrained",
     "load_checkpoint",
     "load_params_for_inference",
@@ -57,6 +59,16 @@ __all__ = [
     "save_step_checkpoint",
     "verify_checkpoint",
 ]
+
+
+class ElasticResumeError(RuntimeError):
+    """A checkpoint cannot be resumed onto THIS runtime configuration:
+    the model's parameter shapes differ (resharding host state cannot
+    invent or drop weights), or the sampler's position cannot be
+    reproduced exactly under the new batch math (and
+    ``--allow-inexact-resume`` was not given). Always says which field
+    diverged and what would make the resume legal — the alternative is
+    a deep flax shape error or, worse, a silently wrong data order."""
 
 # legacy alias: the atomic write grew directory fsyncs and fault points
 # and moved to ckpt_writer.py, where the jax-free tools can reach it
@@ -113,6 +125,7 @@ def _stack(state: dict) -> dict:
 def save_checkpoint(
     path: str, state: dict, best_val_loss: float, cfg: TrainConfig,
     tokenizer_fingerprint: str | None = None,
+    consumed_windows: Optional[int] = None,
 ) -> None:
     """train.py:310-317 equivalent (model+optimizer+scheduler state; the
     schedule is stateless here, so `step` covers it). Always written in
@@ -135,7 +148,8 @@ def save_checkpoint(
     state = _host_checkpoint_state(state, cfg)
     _write_checkpoint_dir(
         path, state, _checkpoint_meta(state, best_val_loss, cfg,
-                                      tokenizer_fingerprint)
+                                      tokenizer_fingerprint,
+                                      consumed_windows)
     )
 
 
@@ -154,11 +168,25 @@ def _host_checkpoint_state(state: dict, cfg: TrainConfig) -> dict:
 def _checkpoint_meta(
     state: dict, best_val_loss: float, cfg: TrainConfig,
     tokenizer_fingerprint: Optional[str],
+    consumed_windows: Optional[int] = None,
 ) -> dict:
     meta = {
         "best_val_loss": float(best_val_loss),
         "iter_num": int(state["step"]),
         "config": cfg.to_dict(),
+        # the epoch sampler's exact position, in WINDOWS CONSUMED —
+        # the elastic-resume anchor: a resumed run with a different
+        # global batch size fast-forwards the permutation from this
+        # count, not from step arithmetic under the new batch math
+        # (elastic_resume_info). The trainer supplies the precise
+        # value (it may itself have resumed elastically, so step *
+        # batch under cfg is not always right); the derivation below
+        # covers direct save_checkpoint callers.
+        "consumed_windows": int(
+            consumed_windows if consumed_windows is not None
+            else int(state["step"]) * cfg.grad_acc_steps
+            * cfg.micro_batch_size
+        ),
     }
     if tokenizer_fingerprint:
         # lets downstream tools (sample.py, tools/attn_probe.py) verify
@@ -211,6 +239,7 @@ def save_step_checkpoint(
     writer: Optional[AsyncCheckpointWriter] = None,
     keep_last: int = 3,
     keep_every: int = 0,
+    consumed_windows: Optional[int] = None,
 ) -> float:
     """One rotating periodic checkpoint: ``<root>/step-NNNNNNNN``,
     certified by its manifest, followed by retention GC (keep the
@@ -233,7 +262,8 @@ def save_step_checkpoint(
         return 0.0
     state = _host_checkpoint_state(state, cfg)
     path = os.path.join(root, step_dir_name(int(state["step"])))
-    meta = _checkpoint_meta(state, best_val_loss, cfg, tokenizer_fingerprint)
+    meta = _checkpoint_meta(state, best_val_loss, cfg,
+                            tokenizer_fingerprint, consumed_windows)
 
     def job() -> None:
         # chaos stall point (utils/faults.py "ckpt_hang"): a slow disk.
@@ -248,6 +278,124 @@ def save_step_checkpoint(
         job()
         return 0.0
     return writer.submit(job)
+
+
+# model-config fields that DETERMINE parameter shapes: a checkpoint
+# whose saved values differ here cannot be resharded onto the runtime
+# (host state would have to invent or drop weights); everything else
+# (impl selectors, dtypes-in-compute, dropout) is resume-compatible
+_SHAPE_FIELDS = (
+    "model", "n_embd", "n_head", "n_layer", "block_size", "n_terms",
+)
+
+
+def elastic_resume_info(meta: dict, cfg: TrainConfig) -> dict:
+    """Validate checkpoint-vs-runtime compatibility for a (possibly
+    elastic) resume and return the facts the trainer needs.
+
+    Checkpoints are stored host-canonical (unsharded, list-of-blocks),
+    so a resume onto a *different* mesh shape — the normal outcome of
+    a Cloud-TPU preemption returning fewer devices — is legal whenever
+    the parameter shapes match: ``shard_state`` simply reshards the
+    host pytree onto the new mesh, optimizer moments included. That
+    used to work by accident; this makes it an explicit, tested
+    contract:
+
+    - **shape compatibility is asserted** field-by-field
+      (:data:`_SHAPE_FIELDS` + vocab_size + control_head_multiplier),
+      raising :class:`ElasticResumeError` naming every divergent field
+      instead of a deep flax deserialization error,
+    - **the sampler anchor is re-derived from consumed windows**, not
+      step count: the meta's recorded ``consumed_windows`` (or, for
+      older checkpoints, step x the SAVING run's batch math) keeps the
+      epoch permutation exact when the new global batch size differs,
+    - **inexactness is typed**: when the consumed count is not a
+      multiple of the new global batch (the optimizer-step boundary
+      and the data position can no longer coincide — a
+      mid-accumulation boundary) or a legacy checkpoint predates the
+      recorded count while the batch math changed, the resume raises
+      unless ``cfg.allow_inexact_resume`` accepts the drift.
+
+    Returns ``{"elastic", "batch_changed", "exact", "saved_mesh",
+    "consumed_windows"}`` (``consumed_windows`` is None only for a
+    legacy checkpoint with an unchanged batch — derive with the
+    current math)."""
+    saved_cfg = meta.get("config") or {}
+    saved_model = saved_cfg.get("model") or {}
+
+    new_model = cfg.model
+    mismatches = []
+    for f in _SHAPE_FIELDS:
+        if f in saved_model and saved_model[f] != getattr(new_model, f):
+            mismatches.append(
+                f"model.{f}: checkpoint {saved_model[f]!r} vs runtime "
+                f"{getattr(new_model, f)!r}"
+            )
+    for f in ("vocab_size", "control_head_multiplier"):
+        if f in saved_cfg and saved_cfg[f] != getattr(cfg, f):
+            mismatches.append(
+                f"{f}: checkpoint {saved_cfg[f]!r} vs runtime "
+                f"{getattr(cfg, f)!r}"
+            )
+    if mismatches:
+        raise ElasticResumeError(
+            "checkpoint parameter shapes are incompatible with this "
+            "run — elastic resume reshards, it cannot reshape: "
+            + "; ".join(mismatches)
+            + ". Match the model config, or start fresh."
+        )
+
+    saved_mesh = saved_cfg.get("mesh") or {}
+    new_mesh = dataclasses.asdict(cfg.mesh)
+    elastic = bool(saved_mesh) and saved_mesh != new_mesh
+
+    consumed = meta.get("consumed_windows")
+    saved_batch = None
+    if "grad_acc_steps" in saved_cfg and "micro_batch_size" in saved_cfg:
+        saved_batch = (
+            int(saved_cfg["grad_acc_steps"])
+            * int(saved_cfg["micro_batch_size"])
+        )
+        if consumed is None and "iter_num" in meta:
+            # pre-consumed_windows checkpoint: the SAVING run's batch
+            # math is still recorded in its config — derive exactly
+            consumed = int(meta["iter_num"]) * saved_batch
+    new_batch = cfg.grad_acc_steps * cfg.micro_batch_size
+    batch_changed = saved_batch is not None and saved_batch != new_batch
+
+    exact = True
+    problem = None
+    if consumed is None:
+        if batch_changed:
+            problem = (
+                "the checkpoint records neither consumed_windows nor "
+                "its batch math, and the global batch size changed "
+                f"(now {new_batch}) — the epoch-sampler position "
+                "cannot be reproduced"
+            )
+    elif int(consumed) % new_batch != 0:
+        problem = (
+            f"consumed_windows={int(consumed)} is not a multiple of "
+            f"the new global batch ({new_batch} windows/step): the "
+            "resume lands mid-accumulation, so optimizer steps and "
+            "data position cannot stay aligned exactly"
+        )
+    if problem is not None:
+        exact = False
+        if not cfg.allow_inexact_resume:
+            raise ElasticResumeError(
+                f"elastic resume cannot be exact: {problem}. Restore "
+                "the original --grad-acc-steps/--micro-batch-size, or "
+                "pass --allow-inexact-resume to accept a bounded "
+                "sampler drift."
+            )
+    return {
+        "elastic": elastic,
+        "batch_changed": batch_changed,
+        "exact": exact,
+        "saved_mesh": saved_mesh or None,
+        "consumed_windows": None if consumed is None else int(consumed),
+    }
 
 
 def resolve_resume_auto(

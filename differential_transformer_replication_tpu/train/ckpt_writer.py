@@ -405,6 +405,15 @@ class AsyncCheckpointWriter:
         )
         self._thread.start()
 
+    @property
+    def drained(self) -> bool:
+        """Whether the writer is closed AND its thread has exited —
+        i.e. no save can still be touching the checkpoint tree. The
+        trainer's rescue save asserts this before writing (a rescue
+        interleaving with an in-flight periodic save would race its
+        retention GC)."""
+        return self._closed and not self._thread.is_alive()
+
     def _raise_pending(self) -> None:
         with self._error_lock:
             err, self._error = self._error, None

@@ -8,6 +8,7 @@ device-resident data.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Optional
 
@@ -28,10 +29,14 @@ from differential_transformer_replication_tpu.train.anomaly import (
 )
 from differential_transformer_replication_tpu.train.checkpoint import (
     AsyncCheckpointWriter,
+    elastic_resume_info,
     load_checkpoint,
     resolve_resume_auto,
     save_checkpoint,
     save_step_checkpoint,
+)
+from differential_transformer_replication_tpu.train.watchdog import (
+    StepWatchdog,
 )
 from differential_transformer_replication_tpu.obs import (
     NOOP_TRACER,
@@ -253,6 +258,7 @@ def train(cfg: TrainConfig) -> dict:
                 print(f"[ckpt] --resume-from auto: resuming from {resolved}")
         cfg = cfg.replace(resume_from=resolved)
         resume_verify = resolved is None
+    resume_info = None  # elastic-resume facts (mesh/batch/consumed)
     if cfg.resume_from:
         # Resume must continue on the SAME token stream: if the cache
         # entry was lost and the corpus re-resolved to different content,
@@ -266,9 +272,31 @@ def train(cfg: TrainConfig) -> dict:
             read_meta,
         )
 
+        # a meta-less dir leaves resume_info None, which is safe: the
+        # later load_checkpoint -> read_meta raises CheckpointError for
+        # it, so no resume can proceed without passing through
+        # elastic_resume_info here first
         meta_path = _os.path.join(cfg.resume_from, "meta.json")
         if _os.path.exists(meta_path):
             meta = read_meta(cfg.resume_from)
+            # Elastic resume (train/checkpoint.py): assert param-shape
+            # compatibility up front (a typed error, not a deep flax
+            # shape mismatch) and recover the sampler's exact position
+            # in consumed windows — a preemption that returns a
+            # DIFFERENT device count (or a retuned global batch) still
+            # resumes onto the new mesh, bit-exact where the batch
+            # math allows. Raises ElasticResumeError when exactness is
+            # impossible and --allow-inexact-resume was not given.
+            resume_info = elastic_resume_info(meta, cfg)
+            if is_primary() and resume_info["elastic"]:
+                print(
+                    f"[elastic] resuming a checkpoint trained on mesh "
+                    f"{resume_info['saved_mesh']} onto "
+                    f"{dataclasses.asdict(cfg.mesh)} "
+                    f"({'exact' if resume_info['exact'] else 'INEXACT'} "
+                    f"sampler fast-forward from "
+                    f"{resume_info['consumed_windows']} consumed windows)"
+                )
             # compare against the CHECKPOINT's recorded vocab size, not
             # cfg.vocab_size — the latter was just overwritten from this
             # very tokenizer (cfg.replace above), which made the size leg
@@ -357,6 +385,22 @@ def train(cfg: TrainConfig) -> dict:
         "but is less protected; a growing count means the checkpoint "
         "storage is broken).",
     )
+    obs_watchdog_fires = registry.counter(
+        "train_watchdog_fires_total",
+        "Step-deadline watchdog fires (train/watchdog.py): a training "
+        "iteration hung past step_deadline_s, or a peer's heartbeat "
+        "silence coordinated an abort. The process exits with the "
+        "hang code right after incrementing, so any scrape showing "
+        ">0 is the post-mortem of a dying incarnation.",
+    )
+    obs_heartbeat_age = registry.gauge(
+        "train_heartbeat_age_seconds",
+        "Seconds since each peer process's heartbeat record last "
+        "changed, judged by this host's monotonic clock "
+        "(parallel/heartbeat.py). Healthy: ~heartbeat_interval_s; "
+        "growing toward heartbeat_timeout_s: that peer is dying.",
+        labelnames=("peer",),
+    )
     if ckpt_auto_skipped:
         obs_ckpt_verify_failures.inc(ckpt_auto_skipped)
     tracer = (
@@ -439,6 +483,30 @@ def train(cfg: TrainConfig) -> dict:
     if cfg.mesh.pipeline <= 1:
         eval_many = make_eval_many(cfg, mesh=eval_mesh)
 
+    # -- consumed-window accounting (elastic resume) -------------------
+    # The epoch sampler's position is tracked in WINDOWS CONSUMED, not
+    # derived from step arithmetic: a resumed run whose global batch
+    # size changed (elastic resume) would otherwise fast-forward the
+    # permutation to the wrong place. The base comes from the
+    # checkpoint's recorded consumed_windows (elastic_resume_info);
+    # everything after the base advances under THIS run's batch math.
+    start_iter = int(jax.device_get(state["step"]))
+    consumed_base_iter = start_iter
+    if resume_info is not None and resume_info["consumed_windows"] is not None:
+        consumed_base = resume_info["consumed_windows"]
+    else:
+        consumed_base = (
+            start_iter * cfg.grad_acc_steps * cfg.micro_batch_size
+        )
+
+    def consumed_at(it: int) -> int:
+        """Windows consumed once iteration ``it`` of THIS run has
+        completed — the sampler fast-forward anchor and the
+        consumed_windows every checkpoint save records."""
+        return consumed_base + (it - consumed_base_iter) * (
+            cfg.grad_acc_steps * cfg.micro_batch_size
+        )
+
     if cfg.checkpoint_min_interval_s > 0:
         # The throttle's deferred-improvement snapshot pins a SECOND full
         # train state in HBM until the next write or exit; surface the
@@ -517,13 +585,13 @@ def train(cfg: TrainConfig) -> dict:
 
         perm = EpochPermutation(len(train_ds), cfg.seed)
         # fast-forward past windows already consumed before a resume, so
-        # the once-per-epoch guarantee survives checkpoint restarts
-        consumed = (
-            int(jax.device_get(state["step"]))
-            * cfg.grad_acc_steps
-            * cfg.micro_batch_size
+        # the once-per-epoch guarantee survives checkpoint restarts —
+        # from the checkpoint's RECORDED consumed count (consumed_at),
+        # so an elastic resume under a changed global batch size keeps
+        # the permutation position exact
+        perm.epoch, perm.cursor = divmod(
+            consumed_at(start_iter), len(train_ds)
         )
-        perm.epoch, perm.cursor = divmod(consumed, len(train_ds))
 
         def draw_batch():
             offs = perm.take(cfg.grad_acc_steps * cfg.micro_batch_size)
@@ -616,6 +684,58 @@ def train(cfg: TrainConfig) -> dict:
             return int(cache_size())
         except Exception:
             return None
+
+    # -- resilience layer (train/watchdog.py, parallel/heartbeat.py) --
+    # Both are pure HOST-side daemon threads: they never touch traced
+    # code, so the compile count stays pinned at 1 with them enabled
+    # (tests/test_watchdog.py). The watchdog object also exists when
+    # only the heartbeat is configured — a dead peer trips it directly
+    # (coordinated abort), deadline monitor or not.
+    watchdog = None
+    heartbeat = None
+    wd_warm = False  # becomes True once the first iteration compiled
+    hb_iter = {"i": start_iter}  # host iter, read by the publisher
+    if cfg.step_deadline_s > 0 or cfg.heartbeat_dir:
+        watchdog = StepWatchdog(
+            cfg.step_deadline_s,
+            report_path=cfg.resolved_hang_report_path(),
+            sink=logger.log_record,
+            fires_counter=obs_watchdog_fires,
+            context={
+                "compile_events": _compile_entries,
+                "device_profile": lambda: getattr(
+                    device_prof, "last_record", None
+                ),
+                "process_index": jax.process_index,
+            },
+        )
+    if cfg.heartbeat_dir:
+        from differential_transformer_replication_tpu.parallel.heartbeat import (
+            FileHeartbeatTransport,
+            Heartbeat,
+        )
+
+        def _peer_dead(peer: int, age: float) -> None:
+            # a silent peer means the next collective wedges every
+            # surviving host: fire the watchdog NOW instead of waiting
+            # out the step deadline inside a psum
+            watchdog.trip(
+                f"peer process {peer} heartbeat silent for {age:.1f}s "
+                f"(timeout {cfg.heartbeat_timeout_s:.1f}s): "
+                "coordinated abort"
+            )
+
+        heartbeat = Heartbeat(
+            FileHeartbeatTransport(cfg.heartbeat_dir),
+            process_index=jax.process_index(),
+            num_processes=process_count(),
+            interval_s=cfg.heartbeat_interval_s,
+            timeout_s=cfg.heartbeat_timeout_s,
+            iter_supplier=lambda: hb_iter["i"],
+            on_dead=_peer_dead,
+            age_gauge=obs_heartbeat_age,
+        )
+        watchdog.add_context(heartbeat_ages=heartbeat.peer_ages)
 
     # Anomaly guard (train/anomaly.py): the jitted step skips bad
     # updates on-device; the host side here keeps a periodic good-state
@@ -712,6 +832,7 @@ def train(cfg: TrainConfig) -> dict:
     metrics = None  # last step's metrics; gates the rescue save below
     last_ckpt_path = cfg.resolved_last_checkpoint_path()
     best_snapshot = None  # device-side best state not yet written to disk
+    best_snapshot_iter = 0  # its iteration (consumed-window accounting)
     # seeded at loop entry: "at most one best write per interval" holds
     # from the start (interval 0 still writes on every improvement).
     # monotonic: a backward wall-clock step (NTP) must not defer writes
@@ -744,6 +865,19 @@ def train(cfg: TrainConfig) -> dict:
                     print(f"SIGTERM received: stopping at iter {iter_num}")
                 break
             faults.fire(iter_num)  # injected raise/SIGTERM/SIGKILL points
+            if watchdog is not None and wd_warm:
+                # armed across the step's dispatch and the host syncs
+                # that follow it; legitimately slow sections (eval,
+                # checkpoint writes) run disarmed below. The FIRST
+                # iteration of this process runs unarmed: its dispatch
+                # traces + compiles the step (tens of seconds to
+                # minutes), which is slow-but-alive, not a hang —
+                # deadlining it would turn every cold start and every
+                # supervised relaunch into a false watchdog fire.
+                watchdog.arm(iter_num)
+            # chaos stalls (train_hang / collective_skew) land INSIDE
+            # the armed window — they simulate a wedged or lagging loop
+            faults.train_stall(iter_num)
             if faults.corrupt_params_at(iter_num):
                 # simulated state corruption (bitflip-class fault): NaN a
                 # param leaf — batch skipping cannot cure this; only the
@@ -772,6 +906,7 @@ def train(cfg: TrainConfig) -> dict:
             with tracer.span("dispatch", iter=iter_num):
                 state, metrics = train_step(state, batch, rng)
             iter_num += 1
+            hb_iter["i"] = iter_num  # heartbeat telemetry (off-loop read)
             if capturing:
                 # closes the window (blocking on the step's loss so the
                 # device work is inside it) and hands the trace to the
@@ -809,6 +944,14 @@ def train(cfg: TrainConfig) -> dict:
                             f"{snapshot_iter} (rollback {rollbacks}/"
                             f"{cfg.anomaly_max_rollbacks})"
                         )
+                    if watchdog is not None:
+                        # the full-state restore below is a legitimate
+                        # slow recovery section, not a hang — it must
+                        # not run against the deadline armed at the top
+                        # of this iteration (and the completed dispatch
+                        # already proved the step compiled)
+                        watchdog.disarm()
+                        wd_warm = True
                     # an in-HBM resume: restore the snapshot (copy — the
                     # donated step must not consume it) and rewind the
                     # epoch sampler to the matching position, exactly the
@@ -818,11 +961,16 @@ def train(cfg: TrainConfig) -> dict:
                     iter_num = snapshot_iter
                     metrics = None
                     if cfg.sampler == "epoch":
-                        consumed = (
-                            iter_num * cfg.grad_acc_steps * cfg.micro_batch_size
+                        perm.epoch, perm.cursor = divmod(
+                            consumed_at(iter_num), len(train_ds)
                         )
-                        perm.epoch, perm.cursor = divmod(consumed, len(train_ds))
                     continue
+
+            if watchdog is not None:
+                # the slow tails below (checkpoint write, eval) are
+                # legitimate; only the step+sync window is deadlined
+                watchdog.disarm()
+                wd_warm = True  # compile is done: deadline from now on
 
             # host-observed iteration accounting: wall time of the whole
             # loop body (dispatch-pipelined, so this is NOT device step
@@ -852,6 +1000,7 @@ def train(cfg: TrainConfig) -> dict:
                             writer=ckpt_writer,
                             keep_last=cfg.ckpt_keep_last,
                             keep_every=cfg.ckpt_keep_every,
+                            consumed_windows=consumed_at(iter_num),
                         )
                         ckpt_acc_blocked += blocked
                         if ckpt_writer is None and is_primary():
@@ -866,6 +1015,11 @@ def train(cfg: TrainConfig) -> dict:
 
             if iter_num % cfg.log_interval == 0:
                 extra = {}
+                if watchdog is not None and wd_warm:
+                    # the log-boundary device_get is where a wedged
+                    # collective actually manifests on the host —
+                    # deadline it like the dispatch window
+                    watchdog.arm(iter_num)
                 with tracer.span("block", what="log_metrics"):
                     # THE deliberate log-boundary sync, amortized by
                     # log_interval — one batched device_get instead of
@@ -890,6 +1044,8 @@ def train(cfg: TrainConfig) -> dict:
                         # host-side `rollbacks` is monotone by
                         # construction, so set() cannot decrease it
                         obs_anomaly_counter.set(rollbacks, kind="rollback")
+                if watchdog is not None:
+                    watchdog.disarm()
                 n = max(obs_acc_n, 1)
                 extra["step_time_ms"] = round(1e3 * obs_acc_step / n, 3)
                 extra["data_wait_frac"] = round(
@@ -987,6 +1143,7 @@ def train(cfg: TrainConfig) -> dict:
                         save_checkpoint(
                             cfg.checkpoint_path, state, best_val_loss, cfg,
                             tokenizer_fingerprint=tok_fp,
+                            consumed_windows=consumed_at(iter_num),
                         )
                         best_snapshot = None
                         last_best_write = time.monotonic()
@@ -994,6 +1151,7 @@ def train(cfg: TrainConfig) -> dict:
                         best_snapshot = jax.tree_util.tree_map(
                             jnp.copy, state
                         )
+                        best_snapshot_iter = iter_num
 
         dt = time.time() - t0
         if dt > 0:
@@ -1031,7 +1189,19 @@ def train(cfg: TrainConfig) -> dict:
             if device_prof is not None:
                 device_prof.close()
 
-        for closer in (_drain_device_prof, _drain_ckpt_writer,
+        def _close_resilience():
+            # stop the watchdog monitor FIRST — the rescue save below
+            # is a legitimately slow section and must not be deadlined
+            # — then the heartbeat threads (peers see this process's
+            # silence only after its heartbeat_timeout_s, by which
+            # time a clean exit has already torn the job down)
+            if watchdog is not None:
+                watchdog.close()
+            if heartbeat is not None:
+                heartbeat.close()
+
+        for closer in (_close_resilience, _drain_device_prof,
+                       _drain_ckpt_writer,
                        profiler.close, logger.finish,
                        _close_tracer, _stop_metrics_server):
             try:
@@ -1057,6 +1227,23 @@ def train(cfg: TrainConfig) -> dict:
         skip_collective_rescue = crashed and process_count() > 1
         try:
             try:
+                if ckpt_writer is not None and not ckpt_writer.drained:
+                    # Drain-ordering invariant: the rescue save below
+                    # must never interleave with an in-flight async
+                    # periodic save (same rotation tree, racing GC).
+                    # The closer above normally drained the writer; if
+                    # that drain FAILED (stuck disk, timeout), retry
+                    # here — and if it still will not drain, skip the
+                    # rescue rather than interleave two writers
+                    # (tests/test_ckpt.py pins the ordering with
+                    # ckpt_hang).
+                    try:
+                        ckpt_writer.close(timeout=600.0)
+                    except Exception as e:  # noqa: BLE001
+                        print(f"checkpoint writer would not drain; "
+                              f"skipping rescue save (the certified "
+                              f"step tree remains): {e!r}")
+                        last_ckpt_path = None
                 if last_ckpt_path and not skip_collective_rescue:
                     # resumable last-state checkpoint, written whatever the
                     # exit path (save_checkpoint canonicalizes pipeline
@@ -1077,6 +1264,7 @@ def train(cfg: TrainConfig) -> dict:
                         save_checkpoint(
                             last_ckpt_path, state, best_val_loss, cfg,
                             tokenizer_fingerprint=tok_fp,
+                            consumed_windows=consumed_at(iter_num),
                         )
                     elif is_primary():
                         print(
@@ -1110,6 +1298,7 @@ def train(cfg: TrainConfig) -> dict:
                     save_checkpoint(
                         cfg.checkpoint_path, best_snapshot, best_val_loss,
                         cfg, tokenizer_fingerprint=tok_fp,
+                        consumed_windows=consumed_at(best_snapshot_iter),
                     )
                     best_snapshot = None
             except Exception as e:  # noqa: BLE001

@@ -48,6 +48,23 @@ call-point faults):
                         inside the writer THREAD — proves the train
                         loop keeps stepping while checkpoint I/O drags
                         and exercises submit() back-pressure
+  ``train_hang@K``      stall the HOST train loop at iteration K for
+                        ``DTX_TRAIN_HANG_S`` seconds (default 30.0) —
+                        the wedge a dead peer or a stuck collective
+                        produces; the step-deadline watchdog's trigger
+                        (train/watchdog.py). One-shot.
+  ``collective_skew@K`` stall iteration K for ``DTX_SKEW_S`` seconds
+                        (default 0.5) — one host entering the step's
+                        collectives LATE. Short enough that a sane
+                        watchdog budget must tolerate it (skew is
+                        normal; silence is not). One-shot.
+  ``heartbeat_silence@P``
+                        MUTE heartbeat publications from process index
+                        P (parallel/heartbeat.py skips its publish) —
+                        a host that is alive but unreachable; peers
+                        must see its heartbeat age grow past
+                        ``heartbeat_timeout_s`` and coordinate an
+                        abort. NOT one-shot: the peer stays silent.
 
 Serving fault points (``@N`` counts ENGINE iterations —
 ``ServingEngine.stats["iterations"]`` — not training steps; exercised
@@ -103,9 +120,15 @@ ENV_VAR = "DTX_FAULTS"
 HANG_ENV_VAR = "DTX_SERVE_HANG_S"
 CKPT_HANG_ENV_VAR = "DTX_CKPT_HANG_S"
 ROUTER_HANG_ENV_VAR = "DTX_ROUTER_HANG_S"
+TRAIN_HANG_ENV_VAR = "DTX_TRAIN_HANG_S"
+SKEW_ENV_VAR = "DTX_SKEW_S"
 
 _STEP_KINDS = (
     "raise", "sigterm", "sigkill", "nan", "corrupt_params",
+    # host-loop stall kinds: train_hang is the watchdog's trigger,
+    # collective_skew the tolerance case; heartbeat_silence's "step"
+    # is a PROCESS INDEX to mute (parallel/heartbeat.py), not a step
+    "train_hang", "collective_skew", "heartbeat_silence",
     # serving kinds: steps are ENGINE iterations, not training steps
     "serve_raise", "serve_hang", "serve_corrupt",
 )
@@ -226,6 +249,30 @@ def serve_corrupt_at(iteration: int) -> bool:
         p["serve_corrupt"].discard(iteration)
         return True
     return False
+
+
+def train_stall(step: int) -> None:
+    """Host-loop stall faults for this training iteration; called just
+    after the watchdog arms (train/trainer.py) so the stall lands
+    INSIDE the armed window. ``train_hang`` sleeps long enough
+    (``DTX_TRAIN_HANG_S``, default 30 s) that a sane step deadline
+    fires first; ``collective_skew`` sleeps briefly (``DTX_SKEW_S``,
+    default 0.5 s) — ordinary straggler skew the watchdog must ride
+    out. Both one-shot."""
+    p = _get()
+    if step in p["train_hang"]:
+        p["train_hang"].discard(step)
+        time.sleep(float(os.environ.get(TRAIN_HANG_ENV_VAR, "30.0")))
+    if step in p["collective_skew"]:
+        p["collective_skew"].discard(step)
+        time.sleep(float(os.environ.get(SKEW_ENV_VAR, "0.5")))
+
+
+def heartbeat_silenced(process_index: int) -> bool:
+    """Whether heartbeat publications from this process index are muted
+    (``heartbeat_silence@P``). Deliberately NOT one-shot — a partitioned
+    host stays silent until something kills it."""
+    return process_index in _get()["heartbeat_silence"]
 
 
 def nan_armed() -> bool:

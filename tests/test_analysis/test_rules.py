@@ -2051,3 +2051,64 @@ class TestChangedMode:
             env={**_os.environ, "GIT_CEILING_DIRECTORIES": str(tmp_path)},
         )
         assert r.returncode == 2
+
+
+class TestGL602CoversResilienceThreads:
+    """Mutation test for the heartbeat/watchdog threads' lock usage:
+    GL602 is the machine check that those daemon threads never block
+    under a held lock (a heartbeat monitor sleeping under its lock
+    would stall the publisher — and with it the liveness signal every
+    peer depends on). Planting exactly that bug in the real module
+    source MUST fire; the unmutated module must stay clean."""
+
+    HEARTBEAT = (
+        REPO / "differential_transformer_replication_tpu" / "parallel"
+        / "heartbeat.py"
+    )
+    ANCHOR = (
+        "with self._lock:\n"
+        "            for p in list(self._last_change):"
+    )
+
+    def test_unmutated_heartbeat_is_gl602_clean(self, tmp_path):
+        src = self.HEARTBEAT.read_text()
+        (tmp_path / "heartbeat.py").write_text(src)
+        result = lint_paths([str(tmp_path / "heartbeat.py")],
+                            rules=["GL601", "GL602"])
+        assert active_ids(result) == []
+
+    def test_planted_blocking_call_under_lock_fires(self, tmp_path):
+        src = self.HEARTBEAT.read_text()
+        assert self.ANCHOR in src, (
+            "mutation anchor vanished — heartbeat.py's monitor lock "
+            "block moved; update the anchor so this mutation test "
+            "keeps guarding it"
+        )
+        mutated = src.replace(
+            self.ANCHOR,
+            "with self._lock:\n"
+            "            time.sleep(0.5)  # planted: blocking under lock\n"
+            "            for p in list(self._last_change):",
+        )
+        (tmp_path / "heartbeat.py").write_text(mutated)
+        result = lint_paths([str(tmp_path / "heartbeat.py")],
+                            rules=["GL602"])
+        assert active_ids(result) == ["GL602"]
+        (finding,) = result.active
+        assert "time.sleep" in finding.message
+        assert "Heartbeat._lock" in finding.message
+
+    def test_planted_lockless_sleep_stays_clean(self, tmp_path):
+        """The negative control: the same sleep OUTSIDE the lock is the
+        correct pacing idiom and must not fire (otherwise the clean
+        gate would force suppressions onto legitimate code)."""
+        src = self.HEARTBEAT.read_text()
+        mutated = src.replace(
+            self.ANCHOR,
+            "time.sleep(0.0)  # outside the lock: fine\n"
+            "        " + self.ANCHOR,
+        )
+        (tmp_path / "heartbeat.py").write_text(mutated)
+        result = lint_paths([str(tmp_path / "heartbeat.py")],
+                            rules=["GL602"])
+        assert active_ids(result) == []

@@ -335,6 +335,22 @@ class TestAsyncWriter:
         assert reg.histogram("ckpt_save_seconds").snapshot()["count"] == 1
         assert reg.histogram("ckpt_blocked_seconds").snapshot()["count"] == 1
 
+    def test_drained_property_tracks_thread_lifecycle(self):
+        """`drained` is the rescue save's gate: False while a job is
+        in flight OR the writer is merely idle-but-open, True only
+        once close() has stopped the thread — and a post-close submit
+        raises rather than interleaving with a drained tree."""
+        w = AsyncCheckpointWriter()
+        assert not w.drained  # open, idle: a job could still arrive
+        gate = threading.Event()
+        w.submit(lambda: gate.wait(10))
+        assert not w.drained  # in flight
+        gate.set()
+        w.close()
+        assert w.drained
+        with pytest.raises(RuntimeError, match="closed"):
+            w.submit(lambda: None)
+
 
 class TestResolveAuto:
     def test_picks_newest_verified_across_sources(self, tmp_path):
@@ -406,6 +422,34 @@ class TestTrainerIntegration:
         recs = [json.loads(l) for l in open(cfg.metrics_path)]
         blocked = sum(r.get("ckpt_blocked_ms", 0.0) for r in recs)
         assert blocked > 0.0  # the save at 8 waited on the stalled save at 4
+
+    def test_sigterm_rescue_waits_for_inflight_async_save(
+        self, tmp_path, monkeypatch
+    ):
+        """Regression (drain ordering): a SIGTERM graceful stop arriving
+        while an async periodic save is STALLED in flight (ckpt_hang on
+        the writer thread) must drain the writer BEFORE the inline
+        rescue save — never interleave two writers over one tree. Both
+        checkpoints certify, and the manifests' written_at order proves
+        the stalled save landed first."""
+        monkeypatch.setenv(faults.CKPT_HANG_ENV_VAR, "1.0")
+        cfg = tiny_cfg(tmp_path, faults="ckpt_hang@2,sigterm@9",
+                       ckpt_interval=4, log_interval=1, eval_interval=50)
+        state = train(cfg)
+        stopped = int(state["step"])
+        assert stopped < 20  # the graceful stop really cut the run short
+        # the stalled step-8 save finished and certified (drained, not
+        # abandoned), and the rescue checkpoint certified after it
+        step8 = os.path.join(cfg.resolved_ckpt_dir(), cw.step_dir_name(8))
+        assert cw.is_verified(step8)
+        assert cw.is_verified(cfg.last_checkpoint_path)
+        m_step = cw.read_manifest(step8)
+        m_rescue = cw.read_manifest(cfg.last_checkpoint_path)
+        assert m_rescue["written_at"] >= m_step["written_at"]
+        # the rescue state resumes at the stop iteration
+        target = create_train_state(jax.random.PRNGKey(0), cfg)
+        restored, _ = load_checkpoint(cfg.last_checkpoint_path, cfg, target)
+        assert int(restored["step"]) == stopped
 
     def test_resume_auto_skips_corrupt_and_falls_back(self, tmp_path, capsys):
         """--resume-from auto end to end: with the newest checkpoints

@@ -1,0 +1,264 @@
+"""Elastic mesh-shape resume tests (train/checkpoint.py +
+train/trainer.py): checkpoints are host-canonical, so a resume onto a
+*different* mesh shape — the normal outcome of a preemption returning
+fewer devices — must reshard exactly (optimizer state included), the
+epoch-sampler fast-forward must come from the checkpoint's recorded
+consumed-window count (exact across batch-size changes), and every
+impossible case must be a typed ElasticResumeError, not a deep flax
+shape traceback. Runs on the conftest-forced 8-device CPU mesh.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from differential_transformer_replication_tpu.train import (
+    ElasticResumeError,
+    elastic_resume_info,
+    train,
+)
+
+TINY_MODEL = dict(vocab_size=256, n_embd=32, n_head=2, n_layer=2,
+                  block_size=16, dropout=0.0, compute_dtype="float32")
+
+
+def tiny_cfg(tmp_path, name, mesh=None, **kw):
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    defaults = dict(
+        vocab_size=256, dataset="synthetic", num_train_samples=200,
+        micro_batch_size=8, grad_acc_steps=1, max_iters=6,
+        eval_interval=100, eval_iters=2, log_interval=5,
+        learning_rate=3e-3, min_lr=3e-4, warmup_iters=5,
+        control_head_multiplier=1,
+        tokenizer_dir=str(tmp_path / "tokenizer"),
+        checkpoint_path=str(d / "best"),
+        last_checkpoint_path=str(d / "last"),
+        metrics_path=str(d / "metrics.jsonl"),
+        seed=7,
+    )
+    model_kw = kw.pop("model_kw", {})
+    return TrainConfig(
+        model=ModelConfig(model="diff", **{**TINY_MODEL, **model_kw}),
+        mesh=mesh or MeshConfig(),
+        **{**defaults, **kw},
+    )
+
+
+def _state_bytes(cfg):
+    return open(
+        os.path.join(cfg.resolved_last_checkpoint_path(),
+                     "state.msgpack"), "rb",
+    ).read()
+
+
+def _losses(cfg):
+    return [
+        json.loads(l)["loss"] for l in open(cfg.metrics_path)
+        if '"loss"' in l
+    ]
+
+
+@pytest.fixture(scope="module")
+def dp8_checkpoint(tmp_path_factory):
+    """One dp=8 seed segment shared by the resume tests: 6 iters, a
+    host-canonical rescue checkpoint at the end."""
+    tmp = tmp_path_factory.mktemp("elastic_seed")
+    cfg = tiny_cfg(tmp, "seed8", mesh=MeshConfig(data=8))
+    train(cfg)
+    return tmp, cfg
+
+
+class TestElasticResumeInfo:
+    def _meta(self, cfg, iter_num=6, consumed=None):
+        meta = {"iter_num": iter_num, "config": cfg.to_dict()}
+        if consumed is not None:
+            meta["consumed_windows"] = consumed
+        return meta
+
+    def test_same_config_is_not_elastic(self, tmp_path):
+        cfg = tiny_cfg(tmp_path, "a")
+        info = elastic_resume_info(self._meta(cfg, consumed=48), cfg)
+        assert info == {
+            "elastic": False, "batch_changed": False, "exact": True,
+            "saved_mesh": {"pipeline": 1, "data": 1, "fsdp": 1,
+                           "tensor": 1, "sequence": 1},
+            "consumed_windows": 48,
+        }
+
+    def test_mesh_change_flagged_elastic_and_allowed(self, tmp_path):
+        saved = tiny_cfg(tmp_path, "b", mesh=MeshConfig(data=8))
+        new = tiny_cfg(tmp_path, "b2", mesh=MeshConfig(fsdp=4))
+        info = elastic_resume_info(self._meta(saved, consumed=48), new)
+        assert info["elastic"] and info["exact"]
+        assert info["saved_mesh"]["data"] == 8
+
+    def test_shape_mismatch_is_typed_error(self, tmp_path):
+        saved = tiny_cfg(tmp_path, "c")
+        for field, val in (("n_embd", 64), ("n_layer", 4),
+                           ("block_size", 32)):
+            new = tiny_cfg(tmp_path, f"c_{field}",
+                           model_kw={field: val})
+            with pytest.raises(ElasticResumeError, match=field):
+                elastic_resume_info(self._meta(saved), new)
+
+    def test_vocab_mismatch_is_typed_error(self, tmp_path):
+        saved = tiny_cfg(tmp_path, "d")
+        new = tiny_cfg(tmp_path, "d2", vocab_size=512,
+                       model_kw={"vocab_size": 512})
+        with pytest.raises(ElasticResumeError, match="vocab_size"):
+            elastic_resume_info(self._meta(saved), new)
+
+    def test_batch_change_exact_from_consumed_windows(self, tmp_path):
+        """grad_acc x micro changed 8 -> 16: the recorded 48 consumed
+        windows divide the new global batch, so the permutation
+        position is exact — 3 new-size steps in, not 6."""
+        saved = tiny_cfg(tmp_path, "e", micro_batch_size=8)
+        new = tiny_cfg(tmp_path, "e2", micro_batch_size=16)
+        info = elastic_resume_info(self._meta(saved, consumed=48), new)
+        assert info["batch_changed"] and info["exact"]
+        assert info["consumed_windows"] == 48
+
+    def test_legacy_meta_derives_consumed_from_saved_batch_math(
+        self, tmp_path
+    ):
+        """Pre-consumed_windows checkpoints still resume exactly under
+        a changed batch: the SAVING run's batch math is in its config."""
+        saved = tiny_cfg(tmp_path, "f", micro_batch_size=8)
+        new = tiny_cfg(tmp_path, "f2", micro_batch_size=16)
+        info = elastic_resume_info(self._meta(saved, iter_num=6), new)
+        assert info["consumed_windows"] == 48  # 6 iters x 8 windows
+
+    def test_mid_accumulation_boundary_is_typed_error(self, tmp_path):
+        """48 consumed windows under a new global batch of 5: the data
+        position lands mid-accumulation — exactness is impossible."""
+        saved = tiny_cfg(tmp_path, "g", micro_batch_size=8)
+        new = tiny_cfg(tmp_path, "g2", micro_batch_size=5)
+        with pytest.raises(ElasticResumeError, match="mid-accumulation"):
+            elastic_resume_info(self._meta(saved, consumed=48), new)
+
+    def test_allow_inexact_resume_escape_hatch(self, tmp_path):
+        saved = tiny_cfg(tmp_path, "h", micro_batch_size=8)
+        new = tiny_cfg(tmp_path, "h2", micro_batch_size=5,
+                       allow_inexact_resume=True)
+        info = elastic_resume_info(self._meta(saved, consumed=48), new)
+        assert not info["exact"]
+        assert info["consumed_windows"] == 48
+
+    def test_meta_without_batch_math_degrades_to_current_math(
+        self, tmp_path
+    ):
+        """A meta recording neither consumed_windows nor its batch math
+        cannot even DETECT a batch change — it degrades to the
+        pre-elastic behavior (derive position with the current math),
+        which is correct for every checkpoint this repo ever wrote
+        (cfg.to_dict() always records the batch fields)."""
+        saved = tiny_cfg(tmp_path, "i", micro_batch_size=8)
+        meta = self._meta(saved)
+        meta["config"].pop("grad_acc_steps")
+        meta["config"].pop("micro_batch_size")
+        new = tiny_cfg(tmp_path, "i2", micro_batch_size=16)
+        info = elastic_resume_info(meta, new)
+        assert not info["batch_changed"] and info["exact"]
+        assert info["consumed_windows"] is None
+
+
+class TestElasticResumeEndToEnd:
+    """dp 8 -> {4, 1} and dp -> fsdp resumes of one shared dp=8
+    checkpoint on the forced-8-device CPU mesh. Same-mesh resumed runs
+    are bit-identical (resharding is deterministic); cross-width runs
+    agree to float tolerance (the gradient psum's reduction order
+    legitimately differs with the shard count — 'bit-equal where batch
+    math allows')."""
+
+    def _resume(self, tmp, base_cfg, name, mesh, **kw):
+        cfg = tiny_cfg(
+            tmp, name, mesh=mesh, max_iters=12,
+            resume_from=base_cfg.resolved_last_checkpoint_path(), **kw,
+        )
+        state = train(cfg)
+        return cfg, state
+
+    def test_dp8_to_dp4_reshards_and_is_deterministic(
+        self, dp8_checkpoint, capsys
+    ):
+        tmp, seed_cfg = dp8_checkpoint
+        cfg_a, state_a = self._resume(tmp, seed_cfg, "dp4_a",
+                                      MeshConfig(data=4))
+        out = capsys.readouterr().out
+        assert "[elastic] resuming" in out and "exact" in out
+        assert int(jax.device_get(state_a["step"])) == 12
+        cfg_b, _ = self._resume(tmp, seed_cfg, "dp4_b", MeshConfig(data=4))
+        # resharding 8->4 is lossless and deterministic: two elastic
+        # resumes of the same checkpoint are byte-identical, optimizer
+        # moments included (the state.msgpack carries them)
+        assert _state_bytes(cfg_a) == _state_bytes(cfg_b)
+        # and the final checkpoint records the exact consumed count
+        meta = json.load(open(os.path.join(
+            cfg_a.resolved_last_checkpoint_path(), "meta.json")))
+        assert meta["consumed_windows"] == 12 * 8
+
+    def test_dp8_to_single_device_and_fsdp_agree(self, dp8_checkpoint):
+        tmp, seed_cfg = dp8_checkpoint
+        cfg_dp4, _ = self._resume(tmp, seed_cfg, "x_dp4",
+                                  MeshConfig(data=4))
+        cfg_dp1, _ = self._resume(tmp, seed_cfg, "x_dp1", MeshConfig())
+        cfg_fsdp, _ = self._resume(tmp, seed_cfg, "x_fsdp",
+                                   MeshConfig(fsdp=4))
+        # identical loss TRAJECTORIES to float tolerance across dp 4 /
+        # dp 1 / fsdp 4 — same data order (consumed-window
+        # fast-forward), same batch math, different reduction orders
+        la, lb, lc = (_losses(c) for c in (cfg_dp4, cfg_dp1, cfg_fsdp))
+        np.testing.assert_allclose(la, lb, rtol=1e-5)
+        np.testing.assert_allclose(la, lc, rtol=1e-5)
+
+    def test_batch_size_change_resumes_exactly(self, dp8_checkpoint):
+        """Global batch 8 -> 16 across the resume: runs, and the final
+        checkpoint's consumed count advances under the NEW batch math
+        from the checkpoint's recorded base (48 + 6 x 16), proving the
+        sampler anchor came from consumed windows, not step count."""
+        tmp, seed_cfg = dp8_checkpoint
+        cfg, state = self._resume(tmp, seed_cfg, "bigger_batch",
+                                  MeshConfig(data=4),
+                                  micro_batch_size=16)
+        assert int(jax.device_get(state["step"])) == 12
+        meta = json.load(open(os.path.join(
+            cfg.resolved_last_checkpoint_path(), "meta.json")))
+        assert meta["consumed_windows"] == 48 + 6 * 16
+
+    def test_mid_accumulation_resume_raises_in_trainer(
+        self, dp8_checkpoint
+    ):
+        """The typed error surfaces from train() itself (before any
+        device work), and --allow-inexact-resume lets the same config
+        through."""
+        tmp, seed_cfg = dp8_checkpoint
+        cfg = tiny_cfg(
+            tmp, "inexact", mesh=MeshConfig(data=4), max_iters=8,
+            micro_batch_size=20, grad_acc_steps=1,
+            resume_from=seed_cfg.resolved_last_checkpoint_path(),
+        )
+        with pytest.raises(ElasticResumeError, match="mid-accumulation"):
+            train(cfg)
+        state = train(cfg.replace(allow_inexact_resume=True))
+        assert int(jax.device_get(state["step"])) == 8
+
+    def test_shape_mismatch_raises_before_flax_error(
+        self, dp8_checkpoint
+    ):
+        tmp, seed_cfg = dp8_checkpoint
+        cfg = tiny_cfg(
+            tmp, "misshape", mesh=MeshConfig(data=4),
+            model_kw={"n_embd": 64},
+            resume_from=seed_cfg.resolved_last_checkpoint_path(),
+        )
+        with pytest.raises(ElasticResumeError, match="n_embd"):
+            train(cfg)

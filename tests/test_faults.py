@@ -124,6 +124,16 @@ class TestFaultSpec:
         with pytest.raises(ValueError, match="unknown fault kind"):
             faults.arm("meteor@4")
 
+    def test_resilience_kinds_parse(self):
+        """The distributed-resilience points (train/watchdog.py +
+        parallel/heartbeat.py chaos seams) ride the same spec grammar."""
+        faults.arm("train_hang@16,collective_skew@3-4,heartbeat_silence@1")
+        assert faults.armed()
+        assert faults.heartbeat_silenced(1)
+        assert not faults.heartbeat_silenced(0)
+        # heartbeat_silence is deliberately NOT one-shot
+        assert faults.heartbeat_silenced(1)
+
     def test_inert_when_unarmed(self):
         assert not faults.armed()
         faults.fire(0)

@@ -1,0 +1,254 @@
+"""Multi-host liveness mesh tests (parallel/heartbeat.py): the monitor
+state machine with fake peers and a fake clock, the shared-filesystem
+transport's torn-file tolerance, the heartbeat_silence fault point, and
+the coordinated-abort wiring into the watchdog.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from differential_transformer_replication_tpu.parallel.heartbeat import (
+    FileHeartbeatTransport,
+    Heartbeat,
+    MemoryTransport,
+)
+from differential_transformer_replication_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _Gauge:
+    def __init__(self):
+        self.values = {}
+
+    def set(self, value, **labels):
+        self.values[labels["peer"]] = value
+
+
+def _mesh(n=3, index=0, interval=1.0, timeout=5.0, **kw):
+    """A Heartbeat with NO running threads (start=False): tests drive
+    publish_once / check_peers synchronously against a fake clock."""
+    transport = kw.pop("transport", MemoryTransport())
+    clock = kw.pop("clock", FakeClock())
+    dead = []
+    hb = Heartbeat(
+        transport, process_index=index, num_processes=n,
+        interval_s=interval, timeout_s=timeout,
+        iter_supplier=kw.pop("iter_supplier", lambda: 7),
+        on_dead=lambda p, age: dead.append((p, age)),
+        clock=clock, start=False, **kw,
+    )
+    return hb, transport, clock, dead
+
+
+def _beat(transport, peer, seq, iter_num=0):
+    transport.publish({"process_index": peer, "iter": iter_num,
+                       "seq": seq, "ts": 0.0})
+
+
+class TestMonitor:
+    def test_beating_peers_stay_alive(self):
+        hb, tr, clock, dead = _mesh(n=3, index=0, timeout=5.0)
+        for t in range(20):
+            clock.t = float(t)
+            _beat(tr, 1, seq=t)
+            _beat(tr, 2, seq=t)
+            hb.check_peers()
+        assert dead == []
+        assert max(hb.peer_ages().values()) <= 1.0
+
+    def test_silent_peer_fires_on_dead_once(self):
+        hb, tr, clock, dead = _mesh(n=3, index=0, timeout=5.0)
+        for t in range(3):
+            clock.t = float(t)
+            _beat(tr, 1, seq=t)
+            _beat(tr, 2, seq=t)
+            hb.check_peers()
+        # peer 2 goes silent (its record stays frozen at seq=2)
+        for t in range(3, 12):
+            clock.t = float(t)
+            _beat(tr, 1, seq=t)
+            hb.check_peers()
+        assert len(dead) == 1
+        peer, age = dead[0]
+        assert peer == 2 and age > 5.0
+        # peer 1 never flagged; the dead peer is not re-reported
+        clock.t = 20.0
+        _beat(tr, 1, seq=20)
+        hb.check_peers()
+        assert len(dead) == 1
+
+    def test_grace_from_start_not_from_epoch(self):
+        """A peer that has never published gets a full timeout of grace
+        from monitor START — a slow bring-up (compiling) must not be an
+        instant death sentence."""
+        hb, tr, clock, dead = _mesh(n=2, index=0, timeout=5.0)
+        clock.t = 4.0
+        hb.check_peers()
+        assert dead == []
+        clock.t = 6.0
+        hb.check_peers()
+        assert [p for p, _ in dead] == [1]
+
+    def test_staleness_judged_by_local_clock_not_record_ts(self):
+        """Clock-skew immunity: a peer whose embedded wall-clock ts is
+        absurdly old is still alive as long as its record keeps
+        CHANGING."""
+        hb, tr, clock, dead = _mesh(n=2, index=0, timeout=3.0)
+        for t in range(10):
+            clock.t = float(t)
+            tr.publish({"process_index": 1, "iter": t, "seq": t,
+                        "ts": -1e9})  # skewed wall clock
+            hb.check_peers()
+        assert dead == []
+
+    def test_age_gauge_exported_per_peer(self):
+        gauge = _Gauge()
+        hb, tr, clock, dead = _mesh(n=3, index=1, timeout=10.0,
+                                    age_gauge=gauge)
+        clock.t = 1.0
+        _beat(tr, 0, seq=1)
+        hb.check_peers()
+        clock.t = 4.0
+        hb.check_peers()
+        assert gauge.values["0"] == pytest.approx(3.0)
+        assert gauge.values["2"] == pytest.approx(4.0)  # never seen
+
+    def test_timeout_must_exceed_interval(self):
+        with pytest.raises(ValueError, match="must exceed"):
+            Heartbeat(MemoryTransport(), 0, 2, interval_s=2.0,
+                      timeout_s=1.0, iter_supplier=lambda: 0,
+                      start=False)
+
+
+class TestPublisher:
+    def test_publish_carries_iter_and_monotonic_seq(self):
+        it = {"i": 3}
+        hb, tr, clock, _ = _mesh(n=1, index=0,
+                                 iter_supplier=lambda: it["i"])
+        hb.publish_once()
+        it["i"] = 9
+        hb.publish_once()
+        rec = tr.read()[0]
+        assert rec["iter"] == 9 and rec["seq"] == 2
+
+    def test_heartbeat_silence_fault_mutes_this_process(self):
+        faults.arm("heartbeat_silence@1")
+        hb0, tr, _, _ = _mesh(n=2, index=0, transport=MemoryTransport())
+        hb1, _, _, _ = _mesh(n=2, index=1, transport=tr)
+        hb0.publish_once()
+        hb1.publish_once()
+        assert 0 in tr.read()
+        assert 1 not in tr.read()  # muted — and stays muted
+        hb1.publish_once()
+        assert 1 not in tr.read()
+
+    def test_silenced_peer_detected_dead_by_the_others(self):
+        """End-to-end through the fault point: process 1 publishes,
+        then goes silent (heartbeat_silence); process 0's monitor sees
+        its age grow past the timeout and fires on_dead — the
+        coordinated-abort trigger."""
+        tr = MemoryTransport()
+        clock = FakeClock()
+        hb0, _, _, dead = _mesh(n=2, index=0, transport=tr, clock=clock,
+                                timeout=3.0)
+        hb1, _, _, _ = _mesh(n=2, index=1, transport=tr, clock=clock)
+        hb1.publish_once()
+        clock.t = 1.0
+        hb0.check_peers()
+        assert dead == []
+        faults.arm("heartbeat_silence@1")
+        for t in range(2, 8):
+            clock.t = float(t)
+            hb1.publish_once()  # muted: the record never changes
+            hb0.check_peers()
+        assert [p for p, _ in dead] == [1]
+
+
+class TestFileTransport:
+    def test_roundtrip_and_overwrite(self, tmp_path):
+        tr = FileHeartbeatTransport(str(tmp_path / "hb"))
+        tr.publish({"process_index": 0, "iter": 1, "seq": 1, "ts": 0.0})
+        tr.publish({"process_index": 3, "iter": 5, "seq": 9, "ts": 0.0})
+        tr.publish({"process_index": 0, "iter": 2, "seq": 2, "ts": 0.0})
+        recs = tr.read()
+        assert recs[0]["seq"] == 2 and recs[3]["seq"] == 9
+        assert sorted(os.listdir(tmp_path / "hb")) == [
+            "hb-0.json", "hb-3.json"
+        ]
+
+    def test_torn_and_foreign_files_skipped(self, tmp_path):
+        d = tmp_path / "hb"
+        tr = FileHeartbeatTransport(str(d))
+        tr.publish({"process_index": 1, "iter": 1, "seq": 1, "ts": 0.0})
+        (d / "hb-2.json").write_text('{"process_index": 2, "se')  # torn
+        (d / "hb-x.json").write_text("not json at all")
+        (d / "notes.txt").write_text("unrelated")
+        recs = tr.read()
+        assert list(recs) == [1]
+
+    def test_missing_directory_reads_empty(self, tmp_path):
+        tr = FileHeartbeatTransport(str(tmp_path / "hb"))
+        os.rmdir(tmp_path / "hb")
+        assert tr.read() == {}
+
+
+def test_threaded_end_to_end_silent_peer_trips_watchdog(tmp_path):
+    """Real threads, real clock, file transport: two heartbeat meshes
+    share a directory; one process dies (its publisher stops) and the
+    survivor's monitor trips the injected watchdog within the timeout.
+    Small intervals keep this well under a second of steady state."""
+    from differential_transformer_replication_tpu.train.watchdog import (
+        StepWatchdog,
+    )
+
+    d = str(tmp_path / "hb")
+    tripped = threading.Event()
+    exits = []
+
+    def exit_fn(code):
+        exits.append(code)
+        tripped.set()
+
+    wd = StepWatchdog(0.0, report_path=str(tmp_path / "hang.json"),
+                      exit_fn=exit_fn)
+    survivor = Heartbeat(
+        FileHeartbeatTransport(d), process_index=0, num_processes=2,
+        interval_s=0.05, timeout_s=0.4,
+        iter_supplier=lambda: 1,
+        on_dead=lambda p, age: wd.trip(
+            f"peer process {p} heartbeat silent for {age:.1f}s"
+        ),
+    )
+    dying = Heartbeat(
+        FileHeartbeatTransport(d), process_index=1, num_processes=2,
+        interval_s=0.05, timeout_s=0.4, iter_supplier=lambda: 1,
+    )
+    try:
+        time.sleep(0.2)
+        assert not tripped.is_set()  # both beating: no false positive
+        dying.close()  # the "process" dies; its file freezes
+        assert tripped.wait(timeout=5.0)
+        report = json.load(open(tmp_path / "hang.json"))
+        assert "peer process 1" in report["reason"]
+    finally:
+        survivor.close()
+        dying.close()
+        wd.close()

@@ -544,6 +544,55 @@ class TestFailover:
         assert headers["Retry-After"] == "3"
         assert router._shed_counter.value == 1
 
+    def test_total_fleet_ejection_sheds_typed_then_full_recovery_serves(
+        self
+    ):
+        """The worst fleet state: EVERY replica ejected simultaneously
+        (a shared dependency died — same rack, same backend). Requests
+        must shed as a typed 503 ``no_replica`` with a Retry-After the
+        client can obey — never hang, never 500 — and once the whole
+        fleet passes its slow re-admission, the SAME router serves
+        again with the shed counter frozen."""
+        cfg = _cfg(eject_after=1, readmit_after=2, shed_retry_after_s=2.0)
+        router, (ha, hb), cleanup = _two_replica_router(
+            [(200, dict(_OK_BODY), {})],
+            [(200, dict(_OK_BODY), {})],
+            cfg=cfg,
+        )
+        try:
+            a, b = router.replicas
+            # the shared dependency dies: both replicas strike out at
+            # once and the fleet is empty
+            a.note_failure(now=1.0)
+            b.note_failure(now=1.0)
+            assert a.state == EJECTED and b.state == EJECTED
+            assert router.eligible_count() == 0
+            for _ in range(3):
+                status, body, headers = router.handle_generate(
+                    {"prompt_ids": [1]}
+                )
+                assert status == 503
+                assert body["code"] == "no_replica"
+                assert headers["Retry-After"] == "2"
+            assert router._shed_counter.value == 3
+            # recovery: one good probe is NOT enough (slow re-admission
+            # holds fleet-wide, not just per replica)...
+            a.note_probe_success(True, "healthy", {}, now=2.0)
+            b.note_probe_success(True, "healthy", {}, now=2.0)
+            assert router.eligible_count() == 0
+            status, body, _ = router.handle_generate({"prompt_ids": [1]})
+            assert status == 503 and body["code"] == "no_replica"
+            # ...the second consecutive good probe re-admits the fleet
+            a.note_probe_success(True, "healthy", {}, now=3.0)
+            b.note_probe_success(True, "healthy", {}, now=3.0)
+            assert router.eligible_count() == 2
+            status, body, _ = router.handle_generate({"prompt_ids": [1]})
+            assert status == 200
+            assert body["replica"] in (a.name, b.name)
+            assert router._shed_counter.value == 4  # frozen post-recovery
+        finally:
+            cleanup()
+
     def test_unreachable_replica_fails_over_and_counts_strike(self):
         # replica 0 is a dead port; replica 1 answers
         hb, url_b, hits_b = _canned_server([(200, dict(_OK_BODY), {})])

@@ -1,0 +1,476 @@
+"""Step-deadline watchdog tests (train/watchdog.py): unit behavior with
+injected clock/exit, trainer integration (compile-count pin with
+watchdog + heartbeat enabled), supervisor hang classification, and —
+slow tier — THE chaos acceptance test: a supervised run wedged by
+``train_hang`` is detected, restarted as class ``hang``, elastically
+resumed on half the devices, and ends bit-identical to an uninterrupted
+run at that mesh.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from differential_transformer_replication_tpu.train.watchdog import (
+    HANG_EXIT_CODE,
+    StepWatchdog,
+    dump_hang_report,
+    thread_stacks,
+)
+from differential_transformer_replication_tpu.utils import faults
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+SUPERVISOR = os.path.join(TOOLS, "train_supervisor.py")
+TRAIN_PY = os.path.join(os.path.dirname(__file__), "..", "train.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self, amount=1.0):
+        self.n += amount
+
+
+def _watchdog(tmp_path, budget=10.0, **kw):
+    """A watchdog with no monitor thread (poll driven by check()), a
+    fake clock, and a recording exit_fn — every fire path observable
+    without killing pytest."""
+    clock = kw.pop("clock", FakeClock())
+    exits = []
+    rows = []
+    wd = StepWatchdog(
+        budget,
+        report_path=str(tmp_path / "hang_report.json"),
+        sink=rows.append,
+        fires_counter=kw.pop("fires_counter", _Counter()),
+        clock=clock,
+        exit_fn=exits.append,
+        # huge poll so the monitor thread never races the fake clock;
+        # tests drive expiry synchronously via check()
+        poll_s=3600.0,
+        **kw,
+    )
+    return wd, clock, exits, rows
+
+
+class TestStepWatchdogUnit:
+    def test_fires_on_expired_armed_deadline(self, tmp_path):
+        wd, clock, exits, rows = _watchdog(tmp_path, budget=5.0)
+        wd.arm(7)
+        clock.t = 4.0
+        wd.check()
+        assert not wd.fired and exits == []
+        clock.t = 6.0
+        wd.check()
+        assert wd.fired
+        assert exits == [HANG_EXIT_CODE]
+        report = json.load(open(tmp_path / "hang_report.json"))
+        assert report["iter"] == 7
+        assert report["record"] == "hang"
+        assert "deadline" in report["reason"]
+        # every live thread's stack is in the post-mortem, and the
+        # metrics row carries the summary without the stacks
+        assert any("MainThread" in k for k in report["threads"])
+        assert rows and rows[0]["iter"] == 7
+        assert "threads" not in rows[0]
+        wd.close()
+
+    def test_disarm_prevents_fire(self, tmp_path):
+        wd, clock, exits, _ = _watchdog(tmp_path, budget=5.0)
+        wd.arm(3)
+        wd.disarm()
+        clock.t = 100.0
+        wd.check()
+        assert not wd.fired and exits == []
+        wd.close()
+
+    def test_rearm_refreshes_deadline(self, tmp_path):
+        wd, clock, exits, _ = _watchdog(tmp_path, budget=5.0)
+        wd.arm(1)
+        clock.t = 4.0
+        wd.arm(2)  # next iteration: deadline moves to 9.0
+        clock.t = 8.0
+        wd.check()
+        assert not wd.fired
+        clock.t = 9.5
+        wd.check()
+        assert wd.fired and exits == [HANG_EXIT_CODE]
+        wd.close()
+
+    def test_trip_fires_even_disarmed(self, tmp_path):
+        """The heartbeat mesh's coordinated abort: a dead peer trips
+        the watchdog whatever the arming state (waiting for the local
+        deadline inside a wedged collective only burns time)."""
+        counter = _Counter()
+        wd, clock, exits, rows = _watchdog(
+            tmp_path, budget=0.0, fires_counter=counter
+        )
+        assert wd._thread is None  # budget 0: no monitor thread at all
+        wd.trip("peer process 3 heartbeat silent for 11.0s")
+        assert wd.fired and exits == [HANG_EXIT_CODE]
+        assert counter.n == 1
+        assert "peer process 3" in rows[0]["reason"]
+        wd.close()
+
+    def test_fires_at_most_once(self, tmp_path):
+        wd, clock, exits, _ = _watchdog(tmp_path, budget=1.0)
+        wd.arm(1)
+        clock.t = 2.0
+        wd.check()
+        wd.trip("again")
+        wd.check()
+        assert exits == [HANG_EXIT_CODE]
+        wd.close()
+
+    def test_context_callables_land_in_report_and_errors_contained(
+        self, tmp_path
+    ):
+        wd, clock, exits, _ = _watchdog(tmp_path, budget=1.0)
+        wd.add_context(
+            compile_events=lambda: 1,
+            broken=lambda: 1 / 0,
+        )
+        wd.arm(4)
+        clock.t = 5.0
+        wd.check()
+        report = json.load(open(tmp_path / "hang_report.json"))
+        assert report["compile_events"] == 1
+        assert "context error" in report["broken"]
+        wd.close()
+
+    def test_monitor_thread_fires_with_real_clock(self, tmp_path):
+        """End-to-end on the real monitor thread: a tiny budget armed
+        and never disarmed fires within a fraction of a second."""
+        exits = []
+        fired = threading.Event()
+
+        def exit_fn(code):
+            exits.append(code)
+            fired.set()
+
+        wd = StepWatchdog(
+            0.05, report_path=str(tmp_path / "r.json"), exit_fn=exit_fn
+        )
+        wd.arm(1)
+        assert fired.wait(timeout=5.0)
+        assert exits == [HANG_EXIT_CODE]
+        wd.close()
+
+    def test_stuck_diagnostics_do_not_block_exit(self, tmp_path):
+        """The likeliest pod hang IS stuck shared storage — which is
+        where the report usually goes. A diagnostics path that blocks
+        forever (simulated by a wedged context callable) must not
+        wedge the fire: the exit lands within report_timeout_s."""
+        wd, clock, exits, _ = _watchdog(tmp_path, budget=1.0,
+                                        report_timeout_s=0.2)
+        wd.add_context(stuck_mount=lambda: time.sleep(60))
+        wd.arm(1)
+        clock.t = 2.0
+        t0 = time.perf_counter()
+        wd.check()
+        assert time.perf_counter() - t0 < 5.0
+        assert exits == [HANG_EXIT_CODE]
+        wd.close()
+
+    def test_report_write_failure_does_not_block_exit(self, tmp_path):
+        """Diagnostics are best-effort: an unwritable report path must
+        not stop the exit that converts the hang into a restart."""
+        wd, clock, exits, _ = _watchdog(tmp_path, budget=1.0)
+        wd.report_path = "/proc/definitely/not/writable/r.json"
+        wd.arm(1)
+        clock.t = 2.0
+        wd.check()
+        assert exits == [HANG_EXIT_CODE]
+        wd.close()
+
+
+def test_thread_stacks_names_this_thread():
+    stacks = thread_stacks()
+    me = threading.current_thread().name
+    assert me in stacks
+    assert "test_thread_stacks_names_this_thread" in stacks[me]
+
+
+def test_dump_hang_report_atomic_and_parseable(tmp_path):
+    path = str(tmp_path / "sub" / "hang.json")
+    report = dump_hang_report(path, 42, "test reason", 1.5,
+                              context={"k": lambda: "v"})
+    on_disk = json.load(open(path))
+    assert on_disk["iter"] == 42 and on_disk["k"] == "v"
+    assert report["reason"] == "test reason"
+    assert not [f for f in os.listdir(tmp_path / "sub")
+                if f.endswith(".tmp")]
+
+
+class TestTrainStallFault:
+    def test_train_hang_sleeps_and_disarms(self, monkeypatch):
+        monkeypatch.setenv(faults.TRAIN_HANG_ENV_VAR, "0.12")
+        faults.arm("train_hang@5")
+        t0 = time.perf_counter()
+        faults.train_stall(4)  # wrong iter: no stall
+        assert time.perf_counter() - t0 < 0.05
+        t0 = time.perf_counter()
+        faults.train_stall(5)
+        assert 0.1 <= time.perf_counter() - t0 < 1.0
+        t0 = time.perf_counter()
+        faults.train_stall(5)  # one-shot: disarmed
+        assert time.perf_counter() - t0 < 0.05
+
+    def test_collective_skew_uses_its_own_env(self, monkeypatch):
+        monkeypatch.setenv(faults.SKEW_ENV_VAR, "0.1")
+        monkeypatch.setenv(faults.TRAIN_HANG_ENV_VAR, "9.0")  # must NOT apply
+        faults.arm("collective_skew@2")
+        t0 = time.perf_counter()
+        faults.train_stall(2)
+        dt = time.perf_counter() - t0
+        assert 0.08 <= dt < 1.0
+
+
+class TestSupervisorHang:
+    def _sup(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("ts", SUPERVISOR)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_classify_hang_exit(self):
+        sup = self._sup()
+        assert sup.HANG_EXIT_CODE == HANG_EXIT_CODE
+        assert sup.classify_exit(HANG_EXIT_CODE) == "hang"
+        assert sup.classify_exit(1) == "crash"
+        assert sup.classify_exit(-signal.SIGKILL) == "sigkill"
+
+    def test_elastic_mesh_rewrite(self):
+        sup = self._sup()
+        cmd = ["python", "train.py", "--data-parallel", "8", "--seed", "1"]
+        out = sup.with_elastic_mesh(cmd, 4)
+        assert out == ["python", "train.py", "--seed", "1",
+                       "--data-parallel", "4"]
+        # non-data axes survive and scale the data axis down
+        cmd2 = ["t", "--data-parallel=4", "--tensor-parallel", "2"]
+        assert sup.with_elastic_mesh(cmd2, 4) == [
+            "t", "--tensor-parallel", "2", "--data-parallel", "2"
+        ]
+        # non-data axes alone exceeding the devices: untouched (the
+        # child fails loudly rather than silently retopologizing)
+        cmd3 = ["t", "--tensor-parallel", "8"]
+        assert sup.with_elastic_mesh(cmd3, 4) == cmd3
+        # already right-sized: untouched
+        cmd4 = ["t", "--data-parallel", "4"]
+        assert sup.with_elastic_mesh(cmd4, 4) == cmd4
+        # shrink-only: a deliberately under-subscribed mesh (dp 4 on 8
+        # surviving devices) is NEVER upsized by a restart
+        cmd5 = ["t", "--data-parallel", "4"]
+        assert sup.with_elastic_mesh(cmd5, 8) == cmd5
+
+    def test_probe_device_count_runs_command(self):
+        sup = self._sup()
+        n = sup.probe_device_count([sys.executable, "-c", "print(4)"])
+        assert n == 4
+        assert sup.probe_device_count(
+            [sys.executable, "-c", "print('nope')"]
+        ) is None
+
+    def test_hang_budget_separate_from_crash_budget(self, tmp_path):
+        """A child that hangs (exit 113) twice then succeeds restarts
+        under --max-hang-restarts even with --max-restarts 0: the two
+        budgets are independent."""
+        script = tmp_path / "hangy.py"
+        script.write_text(
+            "import os, sys\n"
+            f"p = {str(tmp_path / 'count')!r}\n"
+            "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+            "open(p, 'w').write(str(n + 1))\n"
+            f"sys.exit(0 if n >= 2 else {HANG_EXIT_CODE})\n"
+        )
+        log = tmp_path / "restarts.json"
+        proc = subprocess.run(
+            [sys.executable, SUPERVISOR, "--backoff-base", "0.01",
+             "--restart-log", str(log), "--max-restarts", "0",
+             "--max-hang-restarts", "3", "--",
+             sys.executable, str(script)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        records = [json.loads(l) for l in open(log)]
+        assert [r["outcome"] for r in records] == ["hang", "hang", "clean"]
+
+    def test_hang_budget_exhausts_independently(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, SUPERVISOR, "--backoff-base", "0.01",
+             "--max-restarts", "5", "--max-hang-restarts", "1", "--",
+             sys.executable, "-c", f"import sys; sys.exit({HANG_EXIT_CODE})"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == HANG_EXIT_CODE
+        assert "hang restart budget exhausted" in proc.stderr
+
+
+class TestTrainerIntegration:
+    def test_watchdog_and_heartbeat_add_no_recompiles(self, tmp_path):
+        """Acceptance pin: watchdog + heartbeat are pure host-side
+        threads — a run with both enabled (and a generous deadline)
+        completes, compiles exactly once, never fires, and leaves its
+        heartbeat record behind."""
+        import json as _json
+
+        from differential_transformer_replication_tpu.config import (
+            ModelConfig,
+            TrainConfig,
+        )
+        from differential_transformer_replication_tpu.train import train
+
+        cfg = TrainConfig(
+            model=ModelConfig(model="diff", vocab_size=256, n_embd=32,
+                              n_head=2, n_layer=2, block_size=16,
+                              dropout=0.0, compute_dtype="float32"),
+            vocab_size=256, dataset="synthetic", num_train_samples=200,
+            micro_batch_size=4, grad_acc_steps=1, max_iters=12,
+            eval_interval=6, eval_iters=2, log_interval=2,
+            warmup_iters=5, control_head_multiplier=1,
+            tokenizer_dir=str(tmp_path / "tok"),
+            checkpoint_path=str(tmp_path / "best"),
+            last_checkpoint_path=str(tmp_path / "last"),
+            metrics_path=str(tmp_path / "m.jsonl"),
+            seed=7,
+            step_deadline_s=120.0,
+            heartbeat_dir=str(tmp_path / "hb"),
+            heartbeat_interval_s=0.05, heartbeat_timeout_s=5.0,
+        )
+        state = train(cfg)
+        assert int(state["step"]) == 12
+        recs = [_json.loads(l) for l in open(cfg.metrics_path)]
+        pins = [r["compile_events"] for r in recs if "compile_events" in r]
+        assert pins and set(pins) == {1}
+        assert not [r for r in recs if r.get("record") == "hang"]
+        hb = _json.load(open(tmp_path / "hb" / "hb-0.json"))
+        assert hb["process_index"] == 0 and hb["seq"] >= 1
+        assert not os.path.exists(str(tmp_path / "best.hang_report.json"))
+
+
+# -- chaos (slow tier) --------------------------------------------------
+
+
+def _train_env(extra_faults=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop(faults.ENV_VAR, None)
+    if extra_faults:
+        env[faults.ENV_VAR] = extra_faults
+    return env
+
+
+def _train_cmd(tmp_path, name, *extra):
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    return d, [
+        sys.executable, TRAIN_PY, "--model", "diff",
+        "--dataset", "synthetic", "--num-train-samples", "200",
+        "--vocab-size", "256", "--n-embd", "32", "--n-head", "2",
+        "--n-layer", "2", "--block-size", "16",
+        "--compute-dtype", "float32", "--micro-batch-size", "8",
+        "--max-iters", "24", "--eval-interval", "100", "--eval-iters", "2",
+        "--learning-rate", "3e-3", "--warmup-iters", "5", "--seed", "7",
+        "--tokenizer-dir", str(tmp_path / "tokenizer"),
+        "--checkpoint-path", str(d / "best.ckpt"),
+        "--last-checkpoint-path", str(d / "last.ckpt"),
+        "--metrics-path", str(d / "metrics.jsonl"),
+        *extra,
+    ]
+
+
+@pytest.mark.slow
+def test_chaos_train_hang_watchdog_elastic_resume(tmp_path):
+    """THE resilience acceptance test, end to end: a supervised dp=8
+    run wedges mid-step (train_hang) -> the step-deadline watchdog
+    fires (no infinite hang), dumps hang_report.json and exits with the
+    hang code -> the supervisor classifies ``hang``, probes the
+    surviving device count (halved to 4 via --elastic-probe), rewrites
+    --data-parallel, and relaunches with --resume-from auto -> the
+    relaunch elastically reshards the dp-8 step checkpoint onto the
+    dp-4 mesh and finishes cleanly. The final state is bit-identical to
+    an uninterrupted dp-4 run resumed from the same checkpoint, and
+    compile_events stays 1 with watchdog + heartbeat enabled."""
+    chaos_dir, cmd = _train_cmd(
+        tmp_path, "chaos",
+        "--data-parallel", "8",
+        "--ckpt-interval", "8", "--ckpt-keep-last", "8",
+        "--step-deadline-s", "2.0",
+        "--heartbeat-dir", str(tmp_path / "chaos" / "hb"),
+        "--resume-from", "auto",
+    )
+    env = _train_env("train_hang@16")
+    env[faults.TRAIN_HANG_ENV_VAR] = "120"  # far beyond the deadline
+    log = chaos_dir / "restarts.json"
+    proc = subprocess.run(
+        [sys.executable, SUPERVISOR, "--backoff-base", "0.05",
+         "--max-restarts", "0", "--max-hang-restarts", "2",
+         "--restart-log", str(log),
+         "--elastic", "--elastic-probe", f"{sys.executable} -c print(4)",
+         "--"] + cmd,
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    records = [json.loads(l) for l in open(log)]
+    # wedged once, classified hang (not crash), restarted elastically
+    assert [r["outcome"] for r in records] == ["hang", "clean"]
+    assert records[0]["rc"] == HANG_EXIT_CODE
+    assert records[1]["elastic_devices"] == 4
+    assert "--data-parallel 4" in " ".join(records[1]["argv"])
+    # the watchdog's post-mortem names the wedged iteration and has
+    # the main thread's stack
+    report = json.load(open(chaos_dir / "best.hang_report.json"))
+    assert report["iter"] == 16
+    assert report["threads"]
+    # the relaunch resumed from the certified step-16 checkpoint
+    assert "Resumed from" in proc.stdout
+    assert "[elastic] resuming" in proc.stdout
+
+    # control: an uninterrupted dp-4 run resumed from the SAME step-16
+    # checkpoint must end bit-identical (elastic reshard is lossless
+    # and the consumed-window fast-forward is exact)
+    step_ckpt = str(chaos_dir / "best.steps" / "step-00000016")
+    assert os.path.isdir(step_ckpt)
+    _, control_cmd = _train_cmd(
+        tmp_path, "control",
+        "--data-parallel", "4",
+        "--resume-from", step_ckpt,
+    )
+    proc_c = subprocess.run(control_cmd, capture_output=True, text=True,
+                            timeout=600, env=_train_env())
+    assert proc_c.returncode == 0, proc_c.stderr[-2000:]
+    sa = open(chaos_dir / "last.ckpt" / "state.msgpack", "rb").read()
+    sb = open(tmp_path / "control" / "last.ckpt" / "state.msgpack",
+              "rb").read()
+    assert sa == sb
+
+    # compile pin: watchdog + heartbeat are pure host threads — the
+    # relaunched (watchdog-enabled) incarnation still compiles once
+    lines = [json.loads(l) for l in open(chaos_dir / "metrics.jsonl")]
+    compile_counts = [l["compile_events"] for l in lines
+                      if "compile_events" in l]
+    assert compile_counts and set(compile_counts) == {1}

@@ -67,9 +67,15 @@ def _round_of(path: str) -> int:
     return int(m.group(1)) if m else 0
 
 
-def load_round(path: str) -> dict:
-    with open(path, encoding="utf-8") as fh:
-        doc = json.load(fh)
+def load_round(path: str) -> Optional[dict]:
+    """One round archive -> its summary row, or None for an absent,
+    empty, or torn file (a killed bench run's half-written archive
+    must degrade to 'that round is missing', never a traceback)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
     parsed = doc.get("parsed") if isinstance(doc, dict) else None
     out = {
         "round": _round_of(path),
@@ -116,14 +122,28 @@ def main() -> int:
                    help="also draw per-series sparklines on stderr")
     args = p.parse_args()
 
+    def _insufficient(detail):
+        # bootstrap state (absent/empty/torn history): one JSON line +
+        # exit 2, the same contract as tools/perf_gate.py — never a
+        # traceback, distinguishable from a real trend failure
+        print(json.dumps({
+            "metric": "bench_trend",
+            "status": "insufficient_history",
+            "detail": detail,
+            "hint": "insufficient history, run a bench round "
+                    "(bench.py) to bootstrap the trajectory",
+            "ok": False,
+        }))
+        print("CHECK FAILED: insufficient history, run a bench round",
+              file=sys.stderr)
+        return 2
+
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     bench_files = args.files or sorted(
         glob.glob(os.path.join(root, "BENCH_r*.json")), key=_round_of
     )
     if not bench_files:
-        print(json.dumps({"metric": "bench_trend",
-                          "error": "no BENCH_r*.json rounds found"}))
-        return 2
+        return _insufficient("no BENCH_r*.json rounds found")
     bench_files = sorted(bench_files, key=_round_of)
     multichip_files = sorted(
         args.multichip if args.multichip is not None else
@@ -134,7 +154,13 @@ def main() -> int:
         key=_round_of,
     )
 
-    rounds = [load_round(p_) for p_ in bench_files]
+    rounds = [r for r in (load_round(p_) for p_ in bench_files)
+              if r is not None]
+    if not rounds:
+        return _insufficient(
+            f"{len(bench_files)} BENCH file(s) named but none "
+            "readable (absent, empty, or torn)"
+        )
     series = {
         key: _series(rounds, key)
         for key in ("value", "vs_baseline", "mfu_6nd", "loss")

@@ -157,24 +157,44 @@ def _docs_from_text(text: str, path: str) -> List[dict]:
 
 
 def load_samples(paths: List[str], record: Optional[str] = None,
-                 from_jsonl: Optional[str] = None) -> List[dict]:
+                 from_jsonl: Optional[str] = None,
+                 missing: Optional[List[str]] = None) -> List[dict]:
+    """``missing`` (when given) collects paths that do not exist yet —
+    an ABSENT history file is the bootstrap state (no bench round has
+    appended to it), not a usage error: the caller reports it as
+    insufficient history (exit 2), never a traceback."""
     docs: List[dict] = []
     for p in paths:
-        with open(p, encoding="utf-8") as fh:
-            docs.extend(_docs_from_text(fh.read(), p))
+        try:
+            with open(p, encoding="utf-8") as fh:
+                text = fh.read()
+        except FileNotFoundError:
+            if missing is None:
+                raise
+            missing.append(p)
+            continue
+        docs.extend(_docs_from_text(text, p))
     if from_jsonl:
         want = record or "device_profile"
-        with open(from_jsonl, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    d = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if isinstance(d, dict) and d.get("record") == want:
-                    docs.append(d)
+        try:
+            fh = open(from_jsonl, encoding="utf-8")
+        except FileNotFoundError:
+            if missing is None:
+                raise
+            missing.append(from_jsonl)
+            fh = None
+        if fh is not None:
+            with fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        d = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(d, dict) and d.get("record") == want:
+                        docs.append(d)
     elif record:
         docs = [d for d in docs if d.get("record") == record]
     return docs
@@ -255,12 +275,36 @@ def main() -> int:
 
     if not args.files and not args.from_jsonl:
         p.error("give perf JSON files and/or --from-metrics-jsonl")
+    missing: List[str] = []
     try:
         samples = load_samples(args.files, record=args.record,
-                               from_jsonl=args.from_jsonl)
+                               from_jsonl=args.from_jsonl,
+                               missing=missing)
     except (OSError, ValueError) as e:
         print(json.dumps({"metric": "perf_gate", "error": str(e)}))
         print(f"CHECK FAILED: {e}", file=sys.stderr)
+        return 2
+    if not samples or missing:
+        # the bootstrap state: named history files absent, or every
+        # input empty (no bench round has appended yet). One JSON line
+        # + exit 2 — never a traceback, distinguishable from a
+        # regression (exit 1) so CI treats it as "go run the bootstrap
+        # round". A MISSING file fails even when other files yielded
+        # samples: silently gating a partial trajectory would pass the
+        # very series the absent file was supposed to gate.
+        print(json.dumps({
+            "metric": "perf_gate",
+            "status": "insufficient_history",
+            "samples": len(samples),
+            "missing_files": missing,
+            "hint": "insufficient history, run a bench round "
+                    "(bench.py / serve_bench.py --out) to bootstrap "
+                    "the trajectory",
+            "ok": False,
+        }))
+        print("CHECK FAILED: insufficient history, run a bench round"
+              + (f" (missing: {', '.join(missing)})" if missing else ""),
+              file=sys.stderr)
         return 2
     specs = args.key or ["value"]
     try:

@@ -29,10 +29,21 @@ Behavior:
     checkpoint.
   - Exit classification: rc 0 is a CLEAN exit (done — this includes the
     trainer's SIGTERM graceful stop, which exits 0 after its rescue
-    save); death BY SIGTERM without the graceful handler is a
-    preemption — the supervisor stops by default (the scheduler is
-    taking the host; ``--restart-on-sigterm`` opts into relaunching);
-    anything else is a CRASH and is restarted.
+    save); rc ``HANG_EXIT_CODE`` (113) is a step-deadline watchdog
+    fire (train/watchdog.py) — a HANG, restartable like a crash but
+    against its own ``--max-hang-restarts`` budget; death BY SIGTERM
+    without the graceful handler is a preemption — the supervisor
+    stops by default (the scheduler is taking the host;
+    ``--restart-on-sigterm`` opts into relaunching); anything else is
+    a CRASH and is restarted.
+  - Elastic relaunch (``--elastic``): before each relaunch the
+    surviving accelerator count is probed (a jax subprocess, or the
+    ``--elastic-probe`` command) and the child's ``--data-parallel``
+    is resized so the mesh fits it — the Cloud-TPU preemption that
+    returns a smaller slice resumes on what came back instead of
+    waiting forever. Pair with the child's ``--resume-from auto``:
+    checkpoints are host-canonical, so the resume reshards exactly
+    (train/checkpoint.py:elastic_resume_info).
   - SIGTERM/SIGINT to the supervisor are forwarded to the child and end
     the loop after the child exits (no restart).
   - Every launch appends one JSON record to ``--restart-log``
@@ -59,6 +70,16 @@ import time
 from typing import List, Optional
 
 FAULTS_ENV = "DTX_FAULTS"
+# Exit status of a step-deadline watchdog fire — kept in sync with
+# train/watchdog.py:HANG_EXIT_CODE (not imported: that module lives in
+# the jax-importing package this supervisor must outlive; the value is
+# part of the trainer<->supervisor contract like a signal number).
+HANG_EXIT_CODE = 113
+
+# mesh-axis flags train.py understands; --elastic rewrites the data
+# axis so the product fits the surviving device count
+_MESH_FLAGS = ("--data-parallel", "--fsdp", "--tensor-parallel",
+               "--sequence-parallel", "--pipeline-parallel")
 
 
 def _ckpt_tools():
@@ -129,11 +150,17 @@ def resolve_resume_ckpt(path: Optional[str], ckpt=None) -> Optional[str]:
 
 
 def classify_exit(rc: int) -> str:
-    """clean / sigterm / sigkill / crash from a subprocess returncode
-    (negative rc = death by that signal; 128+N covers shells that
-    re-report signal deaths as exit codes)."""
+    """clean / hang / sigterm / sigkill / crash from a subprocess
+    returncode (negative rc = death by that signal; 128+N covers
+    shells that re-report signal deaths as exit codes). ``hang`` is
+    the step-deadline watchdog's distinct exit (train/watchdog.py): a
+    wedged step, restartable like a crash but budgeted separately —
+    a flaky host that hangs repeatedly must not eat the crash budget
+    a genuinely flaky run needs (and vice versa)."""
     if rc == 0:
         return "clean"
+    if rc == HANG_EXIT_CODE:
+        return "hang"
     sig = -rc if rc < 0 else (rc - 128 if 128 < rc < 160 else None)
     if sig == signal.SIGTERM:
         return "sigterm"
@@ -165,6 +192,74 @@ def with_resume(cmd: List[str], ckpt: str) -> List[str]:
     return _strip_flag(cmd, "--resume-from") + ["--resume-from", ckpt]
 
 
+def _flag_value(cmd: List[str], flag: str, default: int = 1) -> int:
+    """Last value of an integer ``flag X`` / ``flag=X`` in an argv list
+    (train.py semantics: argparse keeps the last occurrence)."""
+    val = default
+    for i, a in enumerate(cmd):
+        if a == flag and i + 1 < len(cmd):
+            try:
+                val = int(cmd[i + 1])
+            except ValueError:
+                pass
+        elif a.startswith(flag + "="):
+            try:
+                val = int(a.split("=", 1)[1])
+            except ValueError:
+                pass
+    return val
+
+
+def probe_device_count(probe_cmd: Optional[List[str]] = None,
+                       env: Optional[dict] = None,
+                       timeout: float = 300.0) -> Optional[int]:
+    """The accelerator count a relaunched child would see, probed in a
+    SUBPROCESS (this supervisor never imports jax itself — the runtime
+    it babysits is the thing that crashes). The default probe asks jax
+    in the child's environment; ``--elastic-probe`` overrides it (and
+    makes chaos tests deterministic). None on any failure — the caller
+    then relaunches with the mesh flags untouched."""
+    cmd = probe_cmd or [
+        sys.executable, "-c", "import jax; print(jax.device_count())"
+    ]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout, env=env)
+        return int(out.stdout.strip().splitlines()[-1])
+    except (OSError, ValueError, IndexError,
+            subprocess.TimeoutExpired):
+        return None
+
+
+def with_elastic_mesh(cmd: List[str], n_devices: int) -> List[str]:
+    """SHRINK the child's ``--data-parallel`` so the mesh-axis product
+    fits ``n_devices`` — the elastic relaunch after a preemption
+    returned a smaller slice. Only the data axis is resized (it is the
+    one axis whose extent never changes parameter shapes, so the
+    host-canonical checkpoint reshards exactly; shrinking fsdp/tensor/
+    sequence/pipeline re-partitions math the operator chose
+    deliberately). A mesh that ALREADY fits is returned unchanged —
+    elastic means "run on what survived", never "grab every device":
+    an operator who under-subscribed on purpose (batch divisibility,
+    devices reserved for something else) must not be silently
+    retopologized by a restart. When the non-data axes alone exceed
+    the surviving devices the argv is also unchanged — the child
+    fails loudly with create_mesh's clear error rather than silently
+    training a different topology than asked."""
+    other = 1
+    for flag in _MESH_FLAGS:
+        if flag != "--data-parallel":
+            other *= _flag_value(cmd, flag)
+    if other > n_devices:
+        return cmd
+    if _flag_value(cmd, "--data-parallel") * other <= n_devices:
+        return cmd  # already fits: never upsize
+    new_data = max(1, n_devices // other)
+    return _strip_flag(cmd, "--data-parallel") + [
+        "--data-parallel", str(new_data)
+    ]
+
+
 def backoff_s(restart: int, base: float, cap: float) -> float:
     return min(base * (2 ** restart), cap)
 
@@ -180,8 +275,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "dir); only a checkpoint passing integrity "
                         "verification is injected, newest first")
     p.add_argument("--max-restarts", type=int, default=5,
-                   help="restart budget; exhausted -> exit with the "
-                        "child's last returncode")
+                   help="restart budget for crash-class exits; "
+                        "exhausted -> exit with the child's last "
+                        "returncode")
+    p.add_argument("--max-hang-restarts", type=int, default=None,
+                   help="separate restart budget for watchdog hang "
+                        f"exits (rc {HANG_EXIT_CODE}, "
+                        "train/watchdog.py); default: same value as "
+                        "--max-restarts, counted independently")
+    p.add_argument("--elastic", action="store_true",
+                   help="before each relaunch, probe the surviving "
+                        "accelerator count and rewrite the child's "
+                        "--data-parallel so the mesh fits it — the "
+                        "preemption-returned-a-smaller-slice case; "
+                        "pair with the child's --resume-from auto "
+                        "(checkpoints are host-canonical, so the "
+                        "resume reshards exactly)")
+    p.add_argument("--elastic-probe", default=None, metavar="CMD",
+                   help="override the device-count probe command "
+                        "(default: ask jax in a subprocess with the "
+                        "child's env); the command's last stdout line "
+                        "must be an integer")
     p.add_argument("--backoff-base", type=float, default=2.0,
                    help="first-restart backoff seconds (doubles per "
                         "restart)")
@@ -230,10 +344,19 @@ def supervise(args: argparse.Namespace) -> int:
         signal.signal(s, forward)
 
     restarts = 0
+    # hang (watchdog) restarts are budgeted separately from crash-class
+    # ones: a host that keeps wedging and a run that keeps crashing are
+    # different pathologies with different budgets
+    class_restarts = {"hang": 0, "crash": 0}
+    hang_budget = (
+        args.max_hang_restarts if args.max_hang_restarts is not None
+        else args.max_restarts
+    )
     rc = 1
     while True:
         launch_cmd = cmd
         resumed_from = None
+        elastic_devices = None
         env = None  # inherit
         if restarts > 0:
             ckpt = resolve_resume_ckpt(args.resume_ckpt)
@@ -249,6 +372,32 @@ def supervise(args: argparse.Namespace) -> int:
                 if FAULTS_ENV in os.environ:
                     env = dict(os.environ)
                     del env[FAULTS_ENV]
+            if args.elastic:
+                # elastic relaunch: the slice that comes back after a
+                # preemption may be smaller — resize the data axis to
+                # the surviving device count so the relaunch runs
+                # instead of waiting for hardware that will not return
+                import shlex
+
+                probe = (
+                    shlex.split(args.elastic_probe)
+                    if args.elastic_probe else None
+                )
+                elastic_devices = probe_device_count(probe, env=env)
+                if elastic_devices:
+                    resized = with_elastic_mesh(launch_cmd,
+                                                elastic_devices)
+                    if resized != launch_cmd:
+                        print(f"train_supervisor: elastic relaunch on "
+                              f"{elastic_devices} device(s): "
+                              f"--data-parallel -> "
+                              f"{_flag_value(resized, '--data-parallel')}",
+                              file=sys.stderr)
+                    launch_cmd = resized
+                else:
+                    print("train_supervisor: elastic device probe "
+                          "failed; relaunching with the original mesh",
+                          file=sys.stderr)
         t0 = time.time()
         child["proc"] = subprocess.Popen(launch_cmd, env=env)
         rc = child["proc"].wait()
@@ -262,6 +411,7 @@ def supervise(args: argparse.Namespace) -> int:
             "outcome": outcome,
             "duration_s": round(time.time() - t0, 3),
             "resumed_from": resumed_from,
+            "elastic_devices": elastic_devices,
         })
         if outcome == "clean":
             return 0
@@ -274,14 +424,18 @@ def supervise(args: argparse.Namespace) -> int:
                   "not restarting (use --restart-on-sigterm to override)",
                   file=sys.stderr)
             return 128 + signal.SIGTERM
-        if restarts >= args.max_restarts:
-            print(f"train_supervisor: restart budget exhausted "
-                  f"({args.max_restarts}); last outcome {outcome} (rc {rc})",
+        restart_class = "hang" if outcome == "hang" else "crash"
+        budget = hang_budget if restart_class == "hang" else args.max_restarts
+        if class_restarts[restart_class] >= budget:
+            print(f"train_supervisor: {restart_class} restart budget "
+                  f"exhausted ({budget}); last outcome {outcome} (rc {rc})",
                   file=sys.stderr)
             return rc if rc > 0 else 128 + (-rc)
+        class_restarts[restart_class] += 1
         delay = backoff_s(restarts, args.backoff_base, args.backoff_max)
-        print(f"train_supervisor: child {outcome} (rc {rc}); restart "
-              f"{restarts + 1}/{args.max_restarts} in {delay:.1f}s",
+        print(f"train_supervisor: child {outcome} (rc {rc}); "
+              f"{restart_class} restart "
+              f"{class_restarts[restart_class]}/{budget} in {delay:.1f}s",
               file=sys.stderr)
         # interruptible backoff: a SIGTERM/SIGINT arriving here (child
         # gone, nothing to forward to) must stop the supervisor, not be

@@ -141,6 +141,35 @@ def build_parser() -> argparse.ArgumentParser:
                    default=t.anomaly_check_interval,
                    help="iterations between host polls of the guard streak "
                         "(each poll syncs on the step result)")
+    p.add_argument("--step-deadline-s", type=float, default=t.step_deadline_s,
+                   help="step-deadline watchdog (train/watchdog.py): a "
+                        "training iteration hung past this many seconds "
+                        "dumps hang_report.json and exits with the "
+                        "distinct hang code the supervisor restarts "
+                        "under its own budget; 0 = off")
+    p.add_argument("--hang-report-path", default=t.hang_report_path,
+                   help="watchdog post-mortem destination ('auto' = "
+                        "<checkpoint-path stem>.hang_report.json)")
+    p.add_argument("--heartbeat-dir", default=t.heartbeat_dir,
+                   help="multi-host liveness mesh (parallel/heartbeat"
+                        ".py): shared-filesystem directory for per-"
+                        "process heartbeat files; a peer silent past "
+                        "--heartbeat-timeout-s trips the watchdog "
+                        "immediately (coordinated abort) instead of "
+                        "wedging in a collective; unset = off")
+    p.add_argument("--heartbeat-interval-s", type=float,
+                   default=t.heartbeat_interval_s,
+                   help="seconds between heartbeat publications")
+    p.add_argument("--heartbeat-timeout-s", type=float,
+                   default=t.heartbeat_timeout_s,
+                   help="peer silence past this = dead (coordinated "
+                        "abort); must exceed the interval")
+    p.add_argument("--allow-inexact-resume", action="store_true",
+                   help="accept an elastic resume whose epoch-sampler "
+                        "position cannot be reproduced exactly under "
+                        "the new batch math (mid-accumulation boundary "
+                        "or legacy checkpoint) instead of raising "
+                        "ElasticResumeError")
     p.add_argument("--faults", default=None,
                    help="fault-injection spec for chaos testing, e.g. "
                         "'sigkill@120,nan@50-52' (utils/faults.py; also "
@@ -241,6 +270,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         anomaly_max_rollbacks=args.anomaly_max_rollbacks,
         anomaly_snapshot_interval=args.anomaly_snapshot_interval,
         anomaly_check_interval=args.anomaly_check_interval,
+        step_deadline_s=args.step_deadline_s,
+        hang_report_path=args.hang_report_path,
+        heartbeat_dir=args.heartbeat_dir,
+        heartbeat_interval_s=args.heartbeat_interval_s,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        allow_inexact_resume=args.allow_inexact_resume,
         faults=args.faults,
         metrics_path=args.metrics_path,
         metrics_port=args.metrics_port,
