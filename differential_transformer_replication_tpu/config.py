@@ -259,6 +259,33 @@ class ServingConfig:
     # ModelConfig value.
     decode_attention_impl: str = ""
     kv_cache_dtype: str = ""
+    # Paged KV cache (serving/pages.py). 0 = the legacy contiguous
+    # per-slot rings. > 0 = the slot pool stores KV in fixed pages of
+    # this many tokens (must divide block_size), mapped through
+    # per-slot page tables that ride the ONE jitted decode step as
+    # runtime arrays — zero recompiles as pages churn. Admission then
+    # keys on FREE PAGES, not slots: short requests reserve only the
+    # pages they can ever write, so capacity stops scaling with
+    # worst-case context.
+    kv_page_size: int = 0
+    # Total physical pages in the pool (one reserved trash page is
+    # added on top). 0 = auto: num_slots * (block_size / kv_page_size)
+    # + prefix_cache_pages — the contiguous-equivalent footprint.
+    # Sizing BELOW auto is the capacity lever: 2x num_slots over the
+    # same pages serves 2x concurrent short-context requests at equal
+    # HBM (admission sheds to the queue when pages run out).
+    kv_pool_pages: int = 0
+    # Radix-tree shared-prefix reuse (serving/pages.py): retired
+    # prompts donate their KV pages to a refcounted radix tree;
+    # requests sharing a cached prefix skip its prefill (near-zero
+    # TTFT) and fork copy-on-write at partial-page boundaries.
+    # Unreferenced prefixes are LRU-evicted under page pressure.
+    # Only meaningful with kv_page_size > 0.
+    prefix_cache: bool = True
+    # Extra pool pages added on top of the auto sizing as cached-
+    # prefix headroom, so a fully-loaded slot pool still keeps hot
+    # system prompts resident instead of thrashing them.
+    prefix_cache_pages: int = 0
 
     def __post_init__(self):
         if self.decode_attention_impl not in ("", "xla", "pallas"):
@@ -305,6 +332,30 @@ class ServingConfig:
             )
         if self.max_seq_len < 0:
             raise ValueError(f"max_seq_len must be >= 0, got {self.max_seq_len}")
+        for name in ("kv_page_size", "kv_pool_pages", "prefix_cache_pages"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0, got {getattr(self, name)}"
+                )
+
+    def paged(self) -> bool:
+        """Whether the engine runs the paged KV-cache subsystem."""
+        return self.kv_page_size > 0
+
+    def resolved_pool_pages(self, model: "ModelConfig") -> int:
+        """Total physical pages (EXCLUDING the reserved trash page) for
+        this model: explicit ``kv_pool_pages`` or the contiguous-
+        equivalent auto sizing, plus the prefix-cache headroom."""
+        if not self.paged():
+            return 0
+        if model.block_size % self.kv_page_size:
+            raise ValueError(
+                f"kv_page_size ({self.kv_page_size}) must divide "
+                f"block_size ({model.block_size})"
+            )
+        per_slot = model.block_size // self.kv_page_size
+        base = self.kv_pool_pages or self.num_slots * per_slot
+        return base + self.prefix_cache_pages
 
     def resolved_max_seq_len(self, model: "ModelConfig") -> int:
         """Hard cap on prompt + generated length for this model family."""

@@ -61,6 +61,7 @@ from differential_transformer_replication_tpu.ops import (
 )
 from differential_transformer_replication_tpu.ops.decode_attention import (
     decode_attention,
+    decode_attention_paged,
     decode_attention_reference,
     dequantize_kv,
     quantize_kv,
@@ -512,6 +513,252 @@ def merge_cache_update(active: jnp.ndarray, new_cache: list,
             layer[key] = jnp.where(active.reshape(shape), nc[key], oc[key])
         merged.append(layer)
     return merged
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache (serving/pages.py): the pool's batch axis indexes
+# PHYSICAL PAGES of page_size tokens instead of whole slots. A slot's
+# logical block_size ring maps onto pages through a per-slot page-table
+# row (runtime int32 arrays — allocation/free/sharing never recompiles).
+# Physical page 0 is the reserved trash page: unallocated logical pages
+# and inactive rows' decode writes land there, so the jitted step needs
+# no masking. KV_CACHE_BATCH_AXIS doubles as the page-axis table: the
+# page axis sits exactly where the slot axis sat.
+# ---------------------------------------------------------------------------
+
+
+def init_cache_paged(cfg: ModelConfig, num_pages: int,
+                     page_size: int) -> list:
+    """Per-layer paged K/V pools: the :func:`init_cache` layout with
+    ``(num_pages, page_size)`` replacing ``(batch, block_size)`` on
+    each leaf — K (S, P, H, ps, d), V (P, H, ps, dv), plus the fp32
+    scale planes on the int8 path. ``num_pages`` INCLUDES the reserved
+    trash page 0 (serving/pages.py:PagePool)."""
+    if cfg.block_size % page_size:
+        raise ValueError(
+            f"page_size ({page_size}) must divide block_size "
+            f"({cfg.block_size}): the ring mask assumes whole pages"
+        )
+    return init_cache(cfg.replace(block_size=page_size), num_pages)
+
+
+def _gather_row(leaf: jnp.ndarray, page_row: jnp.ndarray, axis: int):
+    """One slot's contiguous ring view from its page-table row: gather
+    the row's pages on the page axis, fold (pages, page_size) into one
+    token axis, and re-add the batch-1 axis forward_chunk expects."""
+    g = jnp.take(leaf, page_row, axis=axis)
+    g = jnp.moveaxis(g, axis, axis + 1)  # page axis next to tokens
+    shape = (
+        g.shape[:axis + 1]
+        + (g.shape[axis + 1] * g.shape[axis + 2],)
+        + g.shape[axis + 3:]
+    )
+    return jnp.expand_dims(g.reshape(shape), axis)
+
+
+def _scatter_row(leaf: jnp.ndarray, new_row: jnp.ndarray,
+                 page_row: jnp.ndarray, axis: int):
+    """Inverse of :func:`_gather_row`: split the ring view back into
+    pages and scatter them to the row's physical pages. Duplicate trash
+    entries in the row collide harmlessly (page 0 is write-only
+    garbage); shared prefix pages receive their own unchanged values
+    (the engine guarantees written positions live on private pages)."""
+    r = jnp.squeeze(new_row, axis)
+    pp = page_row.shape[0]
+    shape = (
+        r.shape[:axis + 1]
+        + (pp, r.shape[axis + 1] // pp)
+        + r.shape[axis + 2:]
+    )
+    r = jnp.moveaxis(r.reshape(shape), axis + 1, axis)
+    idx = (slice(None),) * axis + (page_row,)
+    return leaf.at[idx].set(r)
+
+
+def gather_slot_cache(cache: list, page_row: jnp.ndarray) -> list:
+    """A slot's per-layer batch-1 ring view through its page table —
+    what the prefill chunk path (forward_chunk) runs against."""
+    return [
+        {key: _gather_row(c[key], page_row, KV_CACHE_BATCH_AXIS[key])
+         for key in c}
+        for c in cache
+    ]
+
+
+def scatter_slot_cache(cache: list, new_row: list,
+                       page_row: jnp.ndarray) -> list:
+    """Write an updated ring view back through the page table."""
+    return [
+        {key: _scatter_row(c[key], nr[key], page_row,
+                           KV_CACHE_BATCH_AXIS[key])
+         for key in c}
+        for c, nr in zip(cache, new_row)
+    ]
+
+
+def copy_cache_pages(cache: list, src, dst) -> list:
+    """Copy one physical page onto another across every layer/leaf —
+    the device half of a copy-on-write fork (serving/pages.py): the
+    shared page's prefix K/V lands in a private page the forking slot
+    may write. ``src``/``dst`` are runtime int32 scalars, so forks
+    never recompile."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    out = []
+    for c in cache:
+        layer = {}
+        for key in c:
+            axis = KV_CACHE_BATCH_AXIS[key]
+            page = jnp.take(c[key], src, axis=axis)
+            idx = (slice(None),) * axis + (dst,)
+            layer[key] = c[key].at[idx].set(page)
+        out.append(layer)
+    return out
+
+
+def _gather_pool_view(leaf: jnp.ndarray, page_tables: jnp.ndarray,
+                      axis: int):
+    """Every slot's ring view at once: (…, B, H, M, …) gathered from
+    the paged leaf through the full (B, pages_per_slot) table — the
+    XLA decode path's read (the Pallas kernel instead loads pages
+    directly through the table, ops/decode_attention.py)."""
+    B, pp = page_tables.shape
+    g = jnp.take(leaf, page_tables.reshape(-1), axis=axis)
+    g = g.reshape(
+        leaf.shape[:axis] + (B, pp) + leaf.shape[axis + 1:]
+    )
+    g = jnp.moveaxis(g, axis + 1, axis + 2)  # pages next to tokens
+    shape = (
+        g.shape[:axis + 2]
+        + (g.shape[axis + 2] * g.shape[axis + 3],)
+        + g.shape[axis + 4:]
+    )
+    return g.reshape(shape)
+
+
+def _update_pages_rows(layer_cache: dict, ks: jnp.ndarray,
+                       v: jnp.ndarray, pos: jnp.ndarray,
+                       write_pages: jnp.ndarray, M: int) -> dict:
+    """Scatter each row's new K/V — ks (S, B, H, d), v (B, H, dv) —
+    into physical page ``write_pages[b]`` at in-page offset
+    ``(pos[b] % M) % page_size``. The engine redirects inactive rows
+    to the trash page, which replaces the contiguous path's masked
+    merge (models/decode.py:merge_cache_update)."""
+    ps = layer_cache["v"].shape[-2]
+    off = jax.lax.rem(
+        jax.lax.rem(jnp.asarray(pos, jnp.int32), M), ps
+    )
+    wp = jnp.asarray(write_pages, jnp.int32)
+    out = dict(layer_cache)
+    if "k_scale" in layer_cache:
+        kq, ksc = quantize_kv(ks)
+        vq, vsc = quantize_kv(v)
+        out["k"] = layer_cache["k"].at[:, wp, :, off].set(
+            kq.transpose(1, 0, 2, 3)
+        )
+        out["k_scale"] = layer_cache["k_scale"].at[:, wp, :, off].set(
+            ksc.transpose(1, 0, 2)
+        )
+        out["v"] = layer_cache["v"].at[wp, :, off].set(vq)
+        out["v_scale"] = layer_cache["v_scale"].at[wp, :, off].set(vsc)
+    else:
+        dt = layer_cache["k"].dtype
+        out["k"] = layer_cache["k"].at[:, wp, :, off].set(
+            ks.astype(dt).transpose(1, 0, 2, 3)
+        )
+        out["v"] = layer_cache["v"].at[wp, :, off].set(v.astype(dt))
+    return out
+
+
+def _pool_attn_paged(
+    x: jnp.ndarray,  # (B, E) normed single-token inputs, one per slot
+    p_attn: dict,
+    layer_cache: dict,  # paged leaves (page axis where the slot axis was)
+    pos: jnp.ndarray,  # (B,) int32 absolute positions
+    page_tables: jnp.ndarray,  # (B, pages_per_slot) int32
+    write_pages: jnp.ndarray,  # (B,) int32 physical page per row's write
+    layer_idx: int,
+    cfg: ModelConfig,
+    cos,
+    sin,
+):
+    """The paged twin of :func:`_pool_attn`: write each row's K/V into
+    its physical page (update-then-attend), then attend through the
+    page table — the fused kernel loads pages directly; the XLA path
+    gathers the contiguous view first."""
+    B = x.shape[0]
+    M = cfg.block_size
+    wq, wk = _stacked_wq(p_attn)
+    qs = jnp.einsum("be,sehd->sbhd", x, wq.astype(x.dtype))
+    ks = jnp.einsum("be,sehd->sbhd", x, wk.astype(x.dtype))
+    v = jnp.einsum("be,ehd->bhd", x, p_attn["wv"].astype(x.dtype))
+    if _uses_rope(cfg):
+        qs = _rope_rows(qs, cos, sin)
+        ks = _rope_rows(ks, cos, sin)
+    new_cache = _update_pages_rows(layer_cache, ks, v, pos, write_pages, M)
+    coeffs = _layer_coeffs(cfg, p_attn, layer_idx)
+    if cfg.decode_attention_impl == "pallas":
+        out = decode_attention_paged(
+            qs, new_cache["k"], new_cache["v"], page_tables, pos, coeffs,
+            k_scale=new_cache.get("k_scale"),
+            v_scale=new_cache.get("v_scale"),
+        )
+    else:
+        view = {
+            key: _gather_pool_view(new_cache[key], page_tables,
+                                   KV_CACHE_BATCH_AXIS[key])
+            for key in new_cache
+        }
+        k_eff, v_eff = _dequant_layer(view, x.dtype)
+        out = decode_attention_reference(qs, k_eff, v_eff, pos, coeffs)
+    out = out.reshape(B, -1)  # concat heads
+    if cfg.model in ("diff", "ndiff"):
+        out = common.apply_group_norm(out, p_attn["gn"], cfg)
+        out = out * OUTPUT_SCALE
+    return common.linear(out, p_attn["out"]), new_cache
+
+
+def forward_decode_pool_paged(
+    params: dict,
+    tokens: jnp.ndarray,  # (B,) current token per slot row
+    pos,  # (B,) int32 absolute position per row
+    cache: list,  # paged cache (init_cache_paged)
+    page_tables: jnp.ndarray,  # (B, pages_per_slot) int32
+    write_pages: jnp.ndarray,  # (B,) int32; trash page for inactive rows
+    cfg: ModelConfig,
+    rope_len: int = 0,
+) -> Tuple[jnp.ndarray, list]:
+    """Advance the whole slot pool by one token THROUGH the page
+    tables: the paged counterpart of :func:`forward_decode_pool`, same
+    ring semantics and update-then-attend order, with the physical
+    placement of every KV row resolved from runtime int32 tables — so
+    pages can be allocated, freed, shared and forked between calls
+    with ZERO recompiles (pinned by tests/test_pages.py)."""
+    B = tokens.shape[0]
+    M = cfg.block_size
+    compute = jnp.dtype(cfg.compute_dtype)
+    pos = jnp.asarray(pos, jnp.int32)
+    x = params["tok_emb"][tokens].astype(compute)  # (B, E)
+    cos = sin = None
+    if cfg.model == "diff":
+        x = x + params["pos_emb"][pos].astype(compute)
+    else:
+        cos_full, sin_full = rope_cos_sin(
+            cfg.head_size, max(int(rope_len), M)
+        )
+        cos = cos_full[pos]
+        sin = sin_full[pos]
+    new_cache = []
+    for li, blk in enumerate(params["blocks"], 1):  # 1-based schedule
+        a, layer_cache = _pool_attn_paged(
+            common.apply_pre_norm(x, blk["ln1"], cfg), blk["attn"],
+            cache[li - 1], pos, page_tables, write_pages, li, cfg,
+            cos, sin,
+        )
+        x = common.apply_block_ffn(x, a, blk, cfg)
+        new_cache.append(layer_cache)
+    x = common.apply_pre_norm(x, params["ln_f"], cfg)
+    return common.linear(x, params["lm_head"]), new_cache
 
 
 @partial(

@@ -265,6 +265,130 @@ def decode_attention(
     return out.reshape(B, H, dv)
 
 
+def _dattn_paged_kernel(pt_ref, *args, n_heads: int, quantized: bool):
+    """Paged twin of :func:`_dattn_fwd_kernel`: identical math — the
+    page table did its work in the BlockSpec index maps (scalar
+    prefetch resolved which physical page each grid step streams), so
+    the kernel body sees the same (S, 1, page_size, d) tiles in
+    LOGICAL ring order and delegates wholesale. Keeping the ``_dattn_``
+    needle in the name preserves tools/profile_step.py's bucketing."""
+    del pt_ref  # consumed by the index maps
+    _dattn_fwd_kernel(*args, n_heads=n_heads, quantized=quantized)
+
+
+def decode_attention_paged(
+    qs: jnp.ndarray,  # (S, B, H, d) current-token queries (post-RoPE)
+    k_pages: jnp.ndarray,  # (S, P, H, ps, d) stored dtype or int8
+    v_pages: jnp.ndarray,  # (P, H, ps, dv)
+    page_tables: jnp.ndarray,  # (B, pages_per_slot) int32
+    pos,  # (B,) int32 absolute position of each row's current token
+    coeffs: jnp.ndarray,  # (S, H) float32 combine coefficients
+    *,
+    k_scale: Optional[jnp.ndarray] = None,  # (S, P, H, ps) fp32
+    v_scale: Optional[jnp.ndarray] = None,  # (P, H, ps) fp32
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused single-query decode attention THROUGH a page table.
+
+    Same online-softmax multi-stream kernel as :func:`decode_attention`
+    with one change: the KV tiles are loaded page-indexed. The page
+    table rides as a SCALAR-PREFETCH operand
+    (``pltpu.PrefetchScalarGridSpec``), so each K/V BlockSpec index map
+    resolves grid step ``(bh, j)`` — row ``b = bh // H``, logical page
+    ``j`` — to physical tile ``page_tables[b, j] * H + h`` of the
+    head-major page pool (models/decode.py:``init_cache_paged``; the
+    per-(page, head) ``(ps, d)`` tile is contiguous, so the reshape to
+    ``(S, P*H, ps, d)`` is zero-copy). The tile length IS the page
+    size: one grid step streams one page, int8 dequantization stays
+    fused in the load. Because the table is a runtime int32 array,
+    allocating/freeing/sharing/forking pages between calls compiles
+    NOTHING new — the zero-recompile pin the serving engine keeps.
+
+    Hardware note: Mosaic wants the (ps, d) tile at or above the dtype
+    tiling floor — page sizes of 128+ (bf16) / 256+ (int8) keep the
+    loads aligned on real TPUs; CPU interpret mode (tests) takes any
+    divisor of block_size.
+    """
+    S, P, H, ps, d = k_pages.shape
+    dv = v_pages.shape[-1]
+    B, pp = page_tables.shape
+    BH = B * H
+    if interpret is None:
+        interpret = auto_interpret()
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
+
+    q = qs.transpose(1, 2, 0, 3).reshape(BH, S, d)
+    k = k_pages.reshape(S, P * H, ps, d)  # zero-copy: head-major pages
+    v = v_pages.reshape(P * H, ps, dv)
+    pos_bh = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32)[:, None], (B, H)
+    ).reshape(1, BH)
+    pt = jnp.asarray(page_tables, jnp.int32)
+
+    def _k_map(bh, j, pt_ref):
+        return (0, pt_ref[bh // H, j] * H + bh % H, 0, 0)
+
+    def _v_map(bh, j, pt_ref):
+        return (pt_ref[bh // H, j] * H + bh % H, 0, 0)
+
+    inputs = [q, k, v, pos_bh, coeffs.astype(jnp.float32)]
+    in_specs = [
+        pl.BlockSpec((1, S, d), lambda bh, j, pt_ref: (bh, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((S, 1, ps, d), _k_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, ps, dv), _v_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, BH), lambda bh, j, pt_ref: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((S, H), lambda bh, j, pt_ref: (0, 0),
+                     memory_space=pltpu.SMEM),
+    ]
+    if quantized:
+        inputs += [
+            k_scale.reshape(S, P * H, ps).astype(jnp.float32),
+            v_scale.reshape(P * H, ps).astype(jnp.float32),
+        ]
+        in_specs += [
+            pl.BlockSpec(
+                (S, 1, ps),
+                lambda bh, j, pt_ref: (0, pt_ref[bh // H, j] * H
+                                       + bh % H, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, ps),
+                lambda bh, j, pt_ref: (pt_ref[bh // H, j] * H
+                                       + bh % H, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(BH, pp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, dv), lambda bh, j, pt_ref: (bh, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((S, 1), jnp.float32),
+            pltpu.VMEM((S, 1), jnp.float32),
+            pltpu.VMEM((S, dv), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _dattn_paged_kernel, n_heads=H, quantized=quantized
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((BH, dv), qs.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(pt, *inputs)
+    return out.reshape(B, H, dv)
+
+
 def decode_attention_reference(
     qs: jnp.ndarray,  # (S, B, H, d)
     k_cache: jnp.ndarray,  # (S, B, H, M, d) FLOAT (dequantize first)

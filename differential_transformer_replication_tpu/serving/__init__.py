@@ -20,6 +20,8 @@ _EXPORTS = {
     "RequestOutput": "request",
     "SamplingParams": "request",
     "Scheduler": "scheduler",
+    "PagePool": "pages",
+    "PagePoolExhaustedError": "pages",
     "QueueFullError": "scheduler",
     "DeadlineExceededError": "scheduler",
     "ShuttingDownError": "server",
@@ -40,6 +42,10 @@ if TYPE_CHECKING:  # static analyzers see the eager imports
     from differential_transformer_replication_tpu.serving.engine import (
         EngineCrashError,
         ServingEngine,
+    )
+    from differential_transformer_replication_tpu.serving.pages import (
+        PagePool,
+        PagePoolExhaustedError,
     )
     from differential_transformer_replication_tpu.serving.request import (
         Request,

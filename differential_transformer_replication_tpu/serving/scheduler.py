@@ -69,6 +69,11 @@ class Slot:
     request: Optional[Request] = None
     prompt: Optional[np.ndarray] = None  # cropped prompt actually run
     filled: int = 0  # prompt tokens already prefilled
+    # prompt tokens whose KV the radix prefix cache already held at
+    # admission (serving/pages.py): prefill starts here, and the
+    # engine's queue-wait/TTFT instrumentation keys the first RUN
+    # chunk on it. Always 0 on the contiguous path.
+    cached_len: int = 0
     generated: List[int] = field(default_factory=list)
     admit_seq: int = -1  # admission order, for FCFS prefill within a step
     submit_time: float = 0.0
@@ -91,6 +96,7 @@ class Slot:
         self.request = None
         self.prompt = None
         self.filled = 0
+        self.cached_len = 0
         self.generated = []
         self.admit_seq = -1
         self.submit_time = 0.0
@@ -109,8 +115,13 @@ def _pow2_chunk(n: int, cap: int) -> int:
 class Scheduler:
     """FCFS queue + slot pool bookkeeping (see module docstring)."""
 
-    def __init__(self, serving: ServingConfig):
+    def __init__(self, serving: ServingConfig, on_retire=None):
         self.serving = serving
+        # retirement hook: called with the slot BEFORE it resets, on
+        # EVERY retire path (finish, deadline, cancel) — how the paged
+        # engine returns KV pages / inserts prompts into the radix
+        # cache (serving/engine.py:_release_slot_pages). None = no-op.
+        self.on_retire = on_retire
         self.slots = [Slot(index=i) for i in range(serving.num_slots)]
         # (request, cropped prompt, submit_time, deadline, trace) —
         # deadline is an absolute perf_counter() timestamp, 0.0 = none;
@@ -205,25 +216,43 @@ class Scheduler:
 
     # -- the per-iteration decision -----------------------------------
 
-    def plan(self) -> List[Tuple[Slot, int, int]]:
+    def plan(self, admit=None) -> List[Tuple[Slot, int, int]]:
         """Admit + plan this iteration's prefill work.
 
         Returns ``[(slot, start, length), ...]`` chunks (FCFS by
         admission order, budget-capped); the engine executes them in
         order and flips a slot to ACTIVE when its prompt completes.
+
+        ``admit`` is the paged engine's admission gate: called with
+        ``(slot, queue_entry)`` for the head-of-line request BEFORE it
+        is committed, it returns the cached prefix length to skip
+        (>= 0, prefill starts there), None to keep the request queued
+        (free pages exhausted — admission keys on pages, not slots, so
+        head-of-line blocking preserves FCFS), or -1 when the gate
+        consumed the entry itself (typed shed). None gate = admit
+        unconditionally (the contiguous path).
         """
-        for slot in self.slots:
-            if not self.queue:
-                break
-            if slot.state != FREE:
-                continue
-            request, prompt, t_submit, deadline, trace = (
-                self.queue.popleft()
-            )
+        free = [s for s in self.slots if s.state == FREE]
+        fi = 0
+        while fi < len(free) and self.queue:
+            slot = free[fi]
+            entry = self.queue[0]
+            cached = 0
+            if admit is not None:
+                verdict = admit(slot, entry)
+                if verdict is None:
+                    break
+                if verdict < 0:
+                    self.queue.popleft()
+                    continue
+                cached = verdict
+            self.queue.popleft()
+            request, prompt, t_submit, deadline, trace = entry
             slot.state = PREFILL
             slot.request = request
             slot.prompt = prompt
-            slot.filled = 0
+            slot.filled = cached
+            slot.cached_len = cached
             slot.generated = []
             slot.token_times = []
             slot.submit_time = t_submit
@@ -231,6 +260,7 @@ class Scheduler:
             slot.trace = trace
             slot.admit_seq = self._admit_seq
             self._admit_seq += 1
+            fi += 1
         self.max_concurrent = max(self.max_concurrent, self.occupied())
 
         budget = self.serving.prefill_budget
@@ -259,5 +289,9 @@ class Scheduler:
         """Return a slot to the FREE pool. The KV rows need no clearing:
         the ring mask derives visibility purely from position arithmetic
         (models/decode.py:_attn_chunk), so a fresh prefill at pos=0
-        masks every stale key the previous occupant left behind."""
+        masks every stale key the previous occupant left behind. The
+        ``on_retire`` hook (paged engine) sees the slot first — every
+        retire path (finish, deadline, cancel) releases its pages."""
+        if self.on_retire is not None and slot.state != FREE:
+            self.on_retire(slot)
         slot.reset()

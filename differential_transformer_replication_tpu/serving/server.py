@@ -53,6 +53,9 @@ from differential_transformer_replication_tpu.serving.engine import (
     EngineCrashError,
     ServingEngine,
 )
+from differential_transformer_replication_tpu.serving.pages import (
+    PagePoolExhaustedError,
+)
 from differential_transformer_replication_tpu.serving.request import (
     RequestOutput,
     SamplingParams,
@@ -356,6 +359,13 @@ class EngineRunner:
                     f"server-side deadline after {len(out.tokens)} "
                     "generated tokens", output=out,
                 ))
+            elif out.finish_reason == "page_exhausted":
+                err = PagePoolExhaustedError(
+                    f"request {out.request_id} shed at admission: KV "
+                    "page pool exhausted; retry later"
+                )
+                err.output = out
+                self._settle(pending, error=err)
             else:
                 self._settle(pending, result=out)
 
@@ -671,6 +681,17 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                 )
                 if compile_stats is not None:
                     payload["compiles"] = compile_stats()
+                # paged-KV pool snapshot (serving/pages.py): page
+                # counts + prefix-cache hit/miss/eviction counters, so
+                # operators and fleet chaos tests see capacity and
+                # cache behavior without scraping /metrics
+                page_stats = getattr(
+                    client.runner.engine, "page_stats", None
+                )
+                if page_stats is not None:
+                    pages = page_stats()
+                    if pages is not None:
+                        payload["kv_pages"] = pages
                 self._reply(200, payload)
             elif self.path == "/ready":
                 if client.runner.accepting():
@@ -762,6 +783,20 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                      "code": "queue_full"},
                     headers=self._retry_after(),
                 )
+                return
+            except PagePoolExhaustedError as e:
+                # the paged-KV shed path: same retryable 503 contract
+                # as queue_full (the pool drains as requests retire and
+                # cached prefixes evict); a never-fits request carries
+                # retriable=False — no Retry-After, clients must not
+                # burn their budget re-sending it here
+                if getattr(e, "retriable", True):
+                    _fail(503, {"error": str(e),
+                                "code": "page_pool_exhausted"},
+                          headers=self._retry_after())
+                else:
+                    _fail(503, {"error": str(e),
+                                "code": "page_pool_unfit"})
                 return
             except ShuttingDownError as e:
                 _fail(503, {"error": str(e),
@@ -877,6 +912,26 @@ def main() -> None:
                         "scale quantized K/V — about half the bf16 HBM "
                         "bytes per slot, so ~2x slot capacity at equal "
                         "memory; '' keeps the model config")
+    p.add_argument("--kv-page-size", type=int, default=0,
+                   help="paged KV cache (serving/pages.py): tokens per "
+                        "page (must divide block_size); admission then "
+                        "keys on free pages, not slots, so short "
+                        "requests stop paying worst-case-context HBM. "
+                        "0 = contiguous per-slot rings")
+    p.add_argument("--kv-pool-pages", type=int, default=0,
+                   help="total physical pages in the paged pool; 0 = "
+                        "auto (num_slots * block_size / page_size). "
+                        "Sizing below auto converts short-context "
+                        "traffic into more concurrent slots at equal "
+                        "HBM")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable the radix-tree shared-prefix cache "
+                        "(on by default when --kv-page-size > 0): "
+                        "retired prompts donate KV pages so requests "
+                        "sharing a system prompt skip its prefill")
+    p.add_argument("--prefix-cache-pages", type=int, default=0,
+                   help="extra pool pages reserved as cached-prefix "
+                        "headroom on top of the auto sizing")
     p.add_argument("--quantize-weights", default=None,
                    choices=("int8",),
                    help="per-channel int8 quantize + dequant of every "
@@ -995,6 +1050,10 @@ def main() -> None:
         prefill_budget=args.prefill_budget, max_seq_len=args.max_seq_len,
         decode_attention_impl=args.decode_attention_impl,
         kv_cache_dtype=args.kv_cache_dtype,
+        kv_page_size=args.kv_page_size,
+        kv_pool_pages=args.kv_pool_pages,
+        prefix_cache=not args.no_prefix_cache,
+        prefix_cache_pages=args.prefix_cache_pages,
         max_queue_len=args.max_queue_len,
         default_deadline_s=args.default_deadline,
         drain_timeout_s=args.drain_timeout,

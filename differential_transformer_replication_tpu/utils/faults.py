@@ -80,6 +80,17 @@ serving/server.py):
                         iteration N's decode; the engine's finite-logits
                         guard turns this into a typed EngineCrashError
                         that the supervised restart recovers from
+  ``page_exhaust@N``    make the paged KV pool (serving/pages.py)
+                        refuse its next admission plan with a typed
+                        PagePoolExhaustedError at engine iteration N —
+                        the request is shed through the 503 queue-shed
+                        path instead of waiting or crashing
+  ``prefix_corrupt@N``  NaN-poison one radix-CACHED prefix page before
+                        iteration N's decode (preferring one shared
+                        with an occupied slot): the finite-logits
+                        guard fires, the supervised restart rebuilds
+                        pool + radix tree, and the poisoned prefix is
+                        evicted instead of ever serving garbage tokens
 
 Router fault points (call-point style like ``ckpt_*`` — ``@N`` counts
 CALLS until the fault fires, default 1; exercised by
@@ -131,6 +142,9 @@ _STEP_KINDS = (
     "train_hang", "collective_skew", "heartbeat_silence",
     # serving kinds: steps are ENGINE iterations, not training steps
     "serve_raise", "serve_hang", "serve_corrupt",
+    # paged-KV kinds (serving/pages.py): typed pool exhaustion and
+    # cached-prefix poisoning, same engine-iteration counting
+    "page_exhaust", "prefix_corrupt",
 )
 _POINT_KINDS = (
     "ckpt_write", "ckpt_fsync", "ckpt_manifest", "ckpt_gc",
@@ -247,6 +261,29 @@ def serve_corrupt_at(iteration: int) -> bool:
     p = _get()
     if iteration in p["serve_corrupt"]:
         p["serve_corrupt"].discard(iteration)
+        return True
+    return False
+
+
+def page_exhaust_at(iteration: int) -> bool:
+    """One-shot paged-pool exhaustion fault: when armed for this engine
+    iteration, the engine forces the page pool's next admission plan to
+    raise the typed :class:`~serving.pages.PagePoolExhaustedError`
+    (surfaced as the 503 shed path)."""
+    p = _get()
+    if iteration in p["page_exhaust"]:
+        p["page_exhaust"].discard(iteration)
+        return True
+    return False
+
+
+def prefix_corrupt_at(iteration: int) -> bool:
+    """One-shot cached-prefix poison fault: when armed for this engine
+    iteration, the engine NaN-poisons one radix-cached prefix page —
+    the finite-logits guard (not garbage tokens) must catch it."""
+    p = _get()
+    if iteration in p["prefix_corrupt"]:
+        p["prefix_corrupt"].discard(iteration)
         return True
     return False
 

@@ -56,6 +56,19 @@ def main() -> None:
                    help="per-channel int8 quantize + dequant of every "
                         "matmul weight on load (tolerance-gated "
                         "accuracy; embeddings/norms stay exact)")
+    p.add_argument("--kv-page-size", type=int, default=0,
+                   help="route sampling through the serving engine's "
+                        "PAGED KV cache (serving/pages.py): tokens per "
+                        "page, must divide block_size. The --n samples "
+                        "share the prompt's prefill pages through the "
+                        "radix prefix cache instead of each re-running "
+                        "it. 0 = the direct generate_cached path")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="with --kv-page-size: disable the radix "
+                        "shared-prefix cache (each sample re-prefills)")
+    p.add_argument("--prefix-cache-pages", type=int, default=0,
+                   help="with --kv-page-size: extra pool pages kept as "
+                        "cached-prefix headroom")
     args = p.parse_args()
 
     from differential_transformer_replication_tpu.data.tokenizer import (
@@ -107,6 +120,59 @@ def main() -> None:
 
     rng = jax.random.PRNGKey(args.seed)
     in_window = len(ids) + args.max_new_tokens <= model_cfg.block_size
+    if args.kv_page_size > 0 and (
+        in_window or model_cfg.model != "diff"
+    ):
+        # paged route: one tiny serving engine; the FIRST sample
+        # prefills the prompt alone, then its retirement donates the
+        # prompt pages to the radix cache so the remaining --n - 1
+        # samples (submitted as one batch) skip the prefill. Sampling
+        # keys follow the engine's per-request fold_in chain, so draws
+        # differ from the direct generate_cached path by design. The
+        # diff family past its window falls through to the windowed
+        # generate below exactly like the default path.
+        from differential_transformer_replication_tpu.config import (
+            ServingConfig,
+        )
+        from differential_transformer_replication_tpu.serving import (
+            SamplingParams,
+            ServingEngine,
+        )
+
+        serving = ServingConfig(
+            num_slots=max(1, min(args.n, 8)),
+            kv_page_size=args.kv_page_size,
+            prefix_cache=not args.no_prefix_cache,
+            prefix_cache_pages=args.prefix_cache_pages,
+            max_seq_len=(
+                0 if model_cfg.model == "diff"
+                else len(ids) + args.max_new_tokens
+            ),
+        )
+        engine = ServingEngine(params, model_cfg, serving)
+
+        def _params(i):
+            return SamplingParams(
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+                top_k=args.top_k, seed=args.seed + i,
+            )
+
+        outs = engine.generate([ids], params=[_params(0)])
+        if args.n > 1:
+            outs += engine.generate(
+                [ids] * (args.n - 1),
+                params=[_params(i) for i in range(1, args.n)],
+            )
+        st = engine.page_stats()
+        print(f"[sample] paged KV: page_size={st['page_size']} "
+              f"prefix hits={st['hits_total']} "
+              f"misses={st['misses_total']}")
+        for i, o in enumerate(outs):
+            print(f"--- sample {i} ---")
+            print(tokenizer.decode(o.prompt + o.tokens))
+        return
+
     if in_window or model_cfg.model != "diff":
         # the ring cache keeps O(T)/token past block_size for the RoPE
         # families (models/decode.py); only diff's learned absolute

@@ -2053,6 +2053,72 @@ class TestChangedMode:
         assert r.returncode == 2
 
 
+class TestGL301CoversPagePool:
+    """Mutation test for the paged-KV pool's lock discipline
+    (serving/pages.py): PagePool is a lock-owning class shared between
+    the engine thread and /health readers, so GL301 is the machine
+    check that its refcount/accounting writes stay under
+    ``self._lock``. Planting exactly that bug — an admission-side
+    counter write hoisted OUT of the lock — in the real module source
+    MUST fire; the unmutated module must stay clean."""
+
+    PAGES = (
+        REPO / "differential_transformer_replication_tpu" / "serving"
+        / "pages.py"
+    )
+    ANCHOR = (
+        "        with self._lock:\n"
+        "            self._clock += 1\n"
+        "            for n in self._slot_nodes[slot]:"
+    )
+
+    def _copy(self, tmp_path, src):
+        # keep the serving/ path component: GL301 is a serving-dir rule
+        path = tmp_path / "serving" / "pages.py"
+        path.parent.mkdir(parents=True)
+        path.write_text(src)
+        return path
+
+    def test_unmutated_pages_is_lock_clean(self, tmp_path):
+        path = self._copy(tmp_path, self.PAGES.read_text())
+        result = lint_paths([str(path)],
+                            rules=["GL301", "GL601", "GL602"])
+        assert active_ids(result) == []
+
+    def test_planted_off_lock_refcount_write_fires(self, tmp_path):
+        src = self.PAGES.read_text()
+        assert self.ANCHOR in src, (
+            "mutation anchor vanished — PagePool.release's lock block "
+            "moved; update the anchor so this mutation test keeps "
+            "guarding it"
+        )
+        mutated = src.replace(
+            self.ANCHOR,
+            "        self._hits += 1  # planted: off-lock write\n"
+            + self.ANCHOR,
+        )
+        path = self._copy(tmp_path, mutated)
+        result = lint_paths([str(path)], rules=["GL301"])
+        assert active_ids(result) == ["GL301"]
+        (finding,) = result.active
+        assert "_hits" in finding.message
+
+    def test_planted_write_under_lock_stays_clean(self, tmp_path):
+        # negative control: the same write INSIDE the lock block is the
+        # correct idiom and must not fire
+        src = self.PAGES.read_text()
+        mutated = src.replace(
+            self.ANCHOR,
+            "        with self._lock:\n"
+            "            self._hits += 0  # inside the lock: fine\n"
+            "            self._clock += 1\n"
+            "            for n in self._slot_nodes[slot]:",
+        )
+        path = self._copy(tmp_path, mutated)
+        result = lint_paths([str(path)], rules=["GL301"])
+        assert active_ids(result) == []
+
+
 class TestGL602CoversResilienceThreads:
     """Mutation test for the heartbeat/watchdog threads' lock usage:
     GL602 is the machine check that those daemon threads never block

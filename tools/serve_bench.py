@@ -278,6 +278,163 @@ def _run_against_targets(args, targets, post) -> None:
         "some requests neither completed nor failed"
 
 
+def _run_shared_prefix(args, client, engine, serving, model_cfg,
+                       tracer) -> None:
+    """``--shared-prefix N:M`` workload: N sessions sharing one M-token
+    system prompt, against the paged engine's radix prefix cache
+    (serving/pages.py). Two sequential measured phases — "miss" (N
+    requests with UNIQUE M-token prefixes, every prefill cold) and
+    "hit" (N requests sharing the primed M-token prefix, prefill skips
+    the cached pages) — report TTFT split by cache-hit/miss plus the
+    pool's measured ``prefix_cache_hit_rate`` in the one JSON line.
+    Requests run one at a time so TTFT is pure prefill+first-token
+    work, not queue wait; the whole measured window rides under the
+    RecompileSentinel (page churn and COW forks must compile NOTHING).
+    """
+    import numpy as _np
+
+    from differential_transformer_replication_tpu.analysis.sanitizers import (
+        RecompileSentinel,
+    )
+    from differential_transformer_replication_tpu.models.decode import (
+        kv_store_dtype,
+    )
+
+    n_sessions, m_prefix = (int(x) for x in args.shared_prefix.split(":"))
+    if n_sessions < 1 or m_prefix < 1:
+        raise SystemExit("--shared-prefix wants N:M with N,M >= 1")
+    V = model_cfg.vocab_size
+    rng = np.random.default_rng(args.seed)
+    tail_lo = max(1, args.min_prompt)
+    tail_hi = max(tail_lo, args.max_prompt)
+    limit = model_cfg.block_size - args.new_tokens - tail_hi
+    if m_prefix > limit:
+        raise SystemExit(
+            f"--shared-prefix prefix ({m_prefix}) + max tail "
+            f"({tail_hi}) + new tokens ({args.new_tokens}) exceeds "
+            f"block_size ({model_cfg.block_size}); shrink M"
+        )
+
+    def _tail():
+        return rng.integers(
+            0, V, size=int(rng.integers(tail_lo, tail_hi + 1))
+        ).tolist()
+
+    shared = rng.integers(0, V, size=m_prefix).tolist()
+    miss_prompts = [
+        rng.integers(0, V, size=m_prefix).tolist() + _tail()
+        for _ in range(n_sessions)
+    ]
+    hit_prompts = [shared + _tail() for _ in range(n_sessions)]
+
+    # warmup: the prefill pow-2 ladder, the decode/sample steps, AND
+    # one COW fork (two warm prompts sharing a non-page-aligned
+    # prefix) so the measured phases compile nothing
+    ladder, size = [], 1
+    # cap at the LONGEST measured prompt: chunks up to prefill_chunk
+    # appear whenever a prompt reaches that length, and a chunk shape
+    # first compiled inside the sentinel window fails the bench
+    while size <= min(serving.prefill_chunk, m_prefix + tail_hi):
+        ladder.append(size)
+        size *= 2
+    for j, n in enumerate(ladder):
+        # DISTINCT first token per ladder size: every radix match
+        # (full page or partial fork) must match position 0 first, so
+        # differing first tokens guarantee each warm prompt misses the
+        # cache and actually compiles its chunk shape — a warm prompt
+        # that hit an earlier entry's cached prefix would skip the
+        # very chunk this ladder exists to compile
+        prompt = [j % V] + rng.integers(0, V, size=n - 1).tolist()
+        client.generate(prompt[:n], max_new_tokens=2,
+                        temperature=args.temperature, seed=0,
+                        timeout=600)
+    warm_pref = (
+        [(len(ladder)) % V]
+        + rng.integers(0, V, size=serving.kv_page_size).tolist()
+    )
+    client.generate(warm_pref + [2], max_new_tokens=2,
+                    temperature=args.temperature, seed=0, timeout=600)
+    client.generate(warm_pref + [3, 4], max_new_tokens=2,
+                    temperature=args.temperature, seed=0, timeout=600)
+
+    def _phase(prompts, base_seed):
+        ttfts, toks = [], 0
+        for i, prompt in enumerate(prompts):
+            out = client.generate(
+                prompt, max_new_tokens=args.new_tokens,
+                temperature=args.temperature, seed=base_seed + i,
+                timeout=600,
+            )
+            ttfts.append(out.ttft * 1e3)
+            toks += len(out.tokens)
+        return ttfts, toks
+
+    sentinel = RecompileSentinel(
+        budget=None if args.allow_recompiles < 0 else args.allow_recompiles,
+        name="serve-bench-shared-prefix-window",
+    )
+    with sentinel:
+        t0 = time.perf_counter()
+        st0 = engine.page_stats()
+        miss_ttfts, miss_tok = _phase(miss_prompts, args.seed)
+        # prime the shared prefix once (a miss, excluded from the hit
+        # phase's stats window)
+        client.generate(shared + _tail(), max_new_tokens=2,
+                        temperature=args.temperature, seed=1,
+                        timeout=600)
+        st1 = engine.page_stats()
+        hit_ttfts, hit_tok = _phase(hit_prompts, args.seed + 10_000)
+        st2 = engine.page_stats()
+        wall = time.perf_counter() - t0
+    client.close()
+    if tracer is not None:
+        tracer.close()
+
+    hit_phase = st2["hits_total"] - st1["hits_total"]
+    hit_rate = hit_phase / max(1, n_sessions)
+    out_tokens = miss_tok + hit_tok
+    med_miss = float(_np.median(miss_ttfts))
+    med_hit = float(_np.median(hit_ttfts))
+    line = {
+        "metric": "serving_output_tokens_per_sec",
+        "value": round(out_tokens / wall, 1),
+        "unit": "tokens/sec",
+        "ttft_ms": _percentiles(miss_ttfts + hit_ttfts),
+        "ttft_ms_miss": _percentiles(miss_ttfts),
+        "ttft_ms_hit": _percentiles(hit_ttfts),
+        "ttft_hit_over_miss": (
+            round(med_hit / med_miss, 3) if med_miss > 0 else None
+        ),
+        "prefix_cache_hit_rate": round(hit_rate, 3),
+        "shared_prefix": {"sessions": n_sessions, "prefix_len": m_prefix},
+        "kv_pages": st2,
+        "kv_page_size": serving.kv_page_size,
+        "kv_pool_pages": st2["total"],
+        "n_requests": 2 * n_sessions,
+        "output_tokens": out_tokens,
+        "wall_s": round(wall, 3),
+        "compiles_in_window": sentinel.count,
+        "model": model_cfg.model,
+        "decode_attention_impl": engine.cfg.decode_attention_impl,
+        "kv_cache_dtype": kv_store_dtype(engine.cfg),
+        "num_slots": serving.num_slots,
+        "new_tokens": args.new_tokens,
+        "smoke": bool(args.smoke),
+    }
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    print(
+        f"[serve_bench] shared-prefix {n_sessions}:{m_prefix} "
+        f"hit_rate={hit_rate:.2f} ttft_miss_p50={med_miss:.1f}ms "
+        f"ttft_hit_p50={med_hit:.1f}ms "
+        f"(hit/miss={line['ttft_hit_over_miss']}) "
+        f"compiles={sentinel.count} pages={st0['total']}",
+        file=sys.stderr,
+    )
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--smoke", action="store_true",
@@ -307,6 +464,29 @@ def main() -> None:
                    help="KV-cache storage dtype override: int8 stores "
                         "per-head-scale quantized K/V (~half the bf16 "
                         "bytes per slot); '' inherits the model config")
+    p.add_argument("--shared-prefix", default=None, metavar="N:M",
+                   help="shared-prefix workload against the paged "
+                        "radix cache: N sessions sharing an M-token "
+                        "system prompt, run as a cold 'miss' phase "
+                        "(unique prefixes) then a primed 'hit' phase "
+                        "(shared prefix); the JSON line reports TTFT "
+                        "split by cache-hit/miss and "
+                        "prefix_cache_hit_rate. In-process only; "
+                        "implies --kv-page-size 16 when unset")
+    p.add_argument("--kv-page-size", type=int, default=0,
+                   help="paged KV cache (serving/pages.py): tokens per "
+                        "page (must divide block size); 0 = contiguous "
+                        "per-slot rings")
+    p.add_argument("--kv-pool-pages", type=int, default=0,
+                   help="total physical pages in the paged pool; 0 = "
+                        "auto (num_slots * block_size / page_size). "
+                        "Size below auto to bench MORE slots at equal "
+                        "HBM (admission keys on free pages)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable the radix shared-prefix cache")
+    p.add_argument("--prefix-cache-pages", type=int, default=0,
+                   help="extra pool pages kept as cached-prefix "
+                        "headroom")
     p.add_argument("--min-prompt", type=int, default=16)
     p.add_argument("--max-prompt", type=int, default=128)
     p.add_argument("--new-tokens", type=int, default=64)
@@ -363,6 +543,21 @@ def main() -> None:
         args.requests, args.clients, args.num_slots = 8, 4, 4
         args.prefill_chunk, args.prefill_budget = 8, 16
         args.min_prompt, args.max_prompt, args.new_tokens = 3, 12, 8
+        if args.shared_prefix:
+            # smoke geometry: page smaller than the shared prefix so
+            # the hit phase actually skips pages (3-token tails leave
+            # room inside block_size=32)
+            args.max_prompt, args.new_tokens = 4, 6
+            if args.kv_page_size == 0:
+                args.kv_page_size = 8
+    if args.shared_prefix:
+        if args.target or args.http:
+            raise SystemExit(
+                "--shared-prefix is an in-process engine bench "
+                "(it reads the page pool's hit counters directly)"
+            )
+        if args.kv_page_size == 0:
+            args.kv_page_size = 16
 
     # retry helpers are stdlib-only (serving/retry.py); the engine
     # stack — and jax — loads only when the load runs in-process
@@ -432,6 +627,10 @@ def main() -> None:
         default_deadline_s=args.deadline,
         decode_attention_impl=args.decode_attention_impl,
         kv_cache_dtype=args.kv_cache_dtype,
+        kv_page_size=args.kv_page_size,
+        kv_pool_pages=args.kv_pool_pages,
+        prefix_cache=not args.no_prefix_cache,
+        prefix_cache_pages=args.prefix_cache_pages,
         profile_every=args.profile_every,
         profile_dir=profile_dir or "device_profiles",
         # let RoPE families roll past block_size so a full-window prompt
@@ -452,6 +651,11 @@ def main() -> None:
         )
     engine = ServingEngine(params, model_cfg, serving, tracer=tracer)
     client = ServingClient(engine)
+
+    if args.shared_prefix:
+        _run_shared_prefix(args, client, engine, serving, model_cfg,
+                           tracer)
+        return
 
     httpd = None
     url = None
@@ -478,15 +682,41 @@ def main() -> None:
     # request can use is a power of two <= min(prefill_chunk, max_prompt),
     # so one warm request PER ladder size (each a single-chunk prefill)
     # plus the shared decode step and samplers covers every shape — no
-    # first-compile lands in a measured TTFT/ITL.
+    # first-compile lands in a measured TTFT/ITL. Warm prompts carry
+    # DISTINCT random content: with the paged radix cache on, repeated-
+    # token ladders would hit the shorter entries' cached prefixes and
+    # skip the longer chunk shapes they exist to compile.
+    # distinct first token per ladder prompt: with the paged radix
+    # cache on, a warm prompt hitting an earlier entry's cached prefix
+    # would skip the chunk shape it exists to compile (every radix
+    # match must match position 0 first, so this cannot collide)
+    warm_rng = np.random.default_rng(args.seed + 77)
+    V = model_cfg.vocab_size
     ladder, size = [], 1
     while size <= min(serving.prefill_chunk, max_prompt):
         ladder.append(size)
         size *= 2
     client.generate_batch(
-        [prompts[0][:1] * n for n in ladder], max_new_tokens=2,
-        temperature=args.temperature, seed=0, timeout=600,
+        [[j % V] + warm_rng.integers(0, V, size=n - 1).tolist()
+         for j, n in enumerate(ladder)],
+        max_new_tokens=2, temperature=args.temperature, seed=0,
+        timeout=600,
     )
+    if serving.paged() and serving.prefix_cache:
+        # warm the COW-fork copy too: random measured prompts can
+        # partially match a cached page (first-token collision) and a
+        # cold page_copy compile would land inside the sentinel window
+        fork_pref = (
+            [len(ladder) % V]
+            + warm_rng.integers(0, V,
+                                size=serving.kv_page_size).tolist()
+        )
+        client.generate(fork_pref + [1], max_new_tokens=2,
+                        temperature=args.temperature, seed=0,
+                        timeout=600)
+        client.generate(fork_pref + [2, 3], max_new_tokens=2,
+                        temperature=args.temperature, seed=0,
+                        timeout=600)
 
     from differential_transformer_replication_tpu.obs import trace as trace_mod
 
@@ -671,6 +901,7 @@ def main() -> None:
         # applied) so the JSON names what actually ran
         "decode_attention_impl": engine.cfg.decode_attention_impl,
         "kv_cache_dtype": kv_store_dtype(engine.cfg),
+        "kv_page_size": serving.kv_page_size,
         "num_slots": serving.num_slots,
         "clients": args.clients,
         "prefill_chunk": serving.prefill_chunk,
