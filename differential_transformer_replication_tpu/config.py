@@ -286,6 +286,41 @@ class ServingConfig:
     # prefix headroom, so a fully-loaded slot pool still keeps hot
     # system prompts resident instead of thrashing them.
     prefix_cache_pages: int = 0
+    # Speculative decoding (serving/spec.py). "" = off. "ngram" = the
+    # drafter-free prompt-lookup fallback (a host-side suffix map over
+    # each request's prompt + emitted tokens proposes continuations);
+    # "model" = a small drafter checkpoint (spec_drafter_ckpt —
+    # typically the control family beside a diff/ndiff target; any
+    # family sharing the tokenizer works) run on its own slot-pool KV
+    # cache. Either way the target verifies k drafted tokens in ONE
+    # fused multi-row pool step (models/decode.py:forward_decode_spec)
+    # with a fused accept/reject: greedy requests accept on argmax
+    # match (bit-identical to non-spec greedy), sampled requests run
+    # the Leviathan et al. 2023 acceptance-ratio test under the
+    # existing fold_in per-request key chains.
+    spec_mode: str = ""
+    # Draft tokens proposed per slot per iteration (the k in the fused
+    # k+1-row verify). k is baked into a fixed ladder {0, spec_draft_len}
+    # of compiled step shapes; PER-REQUEST draft lengths (admission
+    # caps, SamplingParams.draft_len, window clamps) ride as runtime
+    # arrays, so mixed spec/non-spec traffic never recompiles.
+    spec_draft_len: int = 4
+    # Drafter checkpoint dir for spec_mode == "model", loaded beside
+    # the target's params via load_params_for_inference (manifest
+    # verification and int8 weight quantization apply to it too).
+    spec_drafter_ckpt: str = ""
+    # Verify-step formulation (models/decode.py:forward_decode_spec).
+    # "exact" (default): a static unroll of k+1 engine-native L=1
+    # sub-steps in one jitted program — every matmul keeps the plain
+    # decode step's shapes, so greedy spec output is bit-identical to
+    # non-spec decoding at ANY model size. "batched": all rows in one
+    # pass through the fused multi-query decode-attention kernel (each
+    # slot's KV ring/pages streamed ONCE for all k+1 rows — the
+    # bandwidth-optimal TPU formulation); large-contraction XLA
+    # matmuls may reassociate reductions vs the 1-row step, so greedy
+    # ties can resolve differently at scale (bit-identical at the
+    # pinned test sizes; sampled distribution unchanged).
+    spec_verify: str = "exact"
 
     def __post_init__(self):
         if self.decode_attention_impl not in ("", "xla", "pallas"):
@@ -337,10 +372,30 @@ class ServingConfig:
                 raise ValueError(
                     f"{name} must be >= 0, got {getattr(self, name)}"
                 )
+        if self.spec_mode not in ("", "ngram", "model"):
+            raise ValueError(
+                "spec_mode must be ''|'ngram'|'model', got "
+                f"{self.spec_mode!r}"
+            )
+        if self.spec_mode and self.spec_draft_len < 1:
+            raise ValueError(
+                f"spec_draft_len must be >= 1 with spec_mode set, got "
+                f"{self.spec_draft_len}"
+            )
+        if self.spec_verify not in ("exact", "batched"):
+            raise ValueError(
+                "spec_verify must be 'exact'|'batched', got "
+                f"{self.spec_verify!r}"
+            )
 
     def paged(self) -> bool:
         """Whether the engine runs the paged KV-cache subsystem."""
         return self.kv_page_size > 0
+
+    def spec_enabled(self) -> bool:
+        """Whether the engine runs the speculative-decoding subsystem
+        (serving/spec.py)."""
+        return bool(self.spec_mode)
 
     def resolved_pool_pages(self, model: "ModelConfig") -> int:
         """Total physical pages (EXCLUDING the reserved trash page) for

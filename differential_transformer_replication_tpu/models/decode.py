@@ -61,6 +61,9 @@ from differential_transformer_replication_tpu.ops import (
 )
 from differential_transformer_replication_tpu.ops.decode_attention import (
     decode_attention,
+    decode_attention_multi,
+    decode_attention_multi_paged,
+    decode_attention_multi_reference,
     decode_attention_paged,
     decode_attention_reference,
     dequantize_kv,
@@ -753,6 +756,347 @@ def forward_decode_pool_paged(
         a, layer_cache = _pool_attn_paged(
             common.apply_pre_norm(x, blk["ln1"], cfg), blk["attn"],
             cache[li - 1], pos, page_tables, write_pages, li, cfg,
+            cos, sin,
+        )
+        x = common.apply_block_ffn(x, a, blk, cfg)
+        new_cache.append(layer_cache)
+    x = common.apply_pre_norm(x, params["ln_f"], cfg)
+    return common.linear(x, params["lm_head"]), new_cache
+
+
+# ---------------------------------------------------------------------------
+# Speculative multi-row decode (serving/spec.py): the verify step runs
+# L = k + 1 rows per slot through the pool in ONE call — the slot's last
+# emitted token plus its k draft tokens, each row at its own absolute
+# position with row-causal visibility (update-then-attend: all L rows'
+# K/V are written first, then each row's mask ``col <= pos[b, l]`` shows
+# it exactly the rows before it). Rows past a slot's draft length (and
+# every row of an inactive slot) are WRITE-REDIRECTED instead of masked:
+# the contiguous pool carries one extra TRASH ROW at batch index
+# ``num_slots`` (``row_target`` names each row's destination), the paged
+# pool redirects to the trash page through ``write_pages`` — either way
+# the jitted step needs no shape change as per-slot draft lengths vary,
+# so mixed spec/non-spec traffic compiles NOTHING new.
+# ---------------------------------------------------------------------------
+
+
+def _update_cache_rows_spec(layer_cache: dict, ks: jnp.ndarray,
+                            v: jnp.ndarray, slot: jnp.ndarray,
+                            row: jnp.ndarray) -> dict:
+    """Scatter N flattened verify rows' K/V — ks (S, N, H, d),
+    v (N, H, dv) — into cache batch row ``row[n]`` at ring slot
+    ``slot[n]``. The multi-row twin of :func:`_update_cache_rows` with
+    an EXPLICIT batch-row index: valid rows name their own slot row,
+    invalid rows the trash row (collisions inside the trash row are
+    harmless — it is write-only garbage)."""
+    out = dict(layer_cache)
+    if "k_scale" in layer_cache:
+        kq, ksc = quantize_kv(ks)
+        vq, vsc = quantize_kv(v)
+        out["k"] = layer_cache["k"].at[:, row, :, slot].set(
+            kq.transpose(1, 0, 2, 3)
+        )
+        out["k_scale"] = layer_cache["k_scale"].at[:, row, :, slot].set(
+            ksc.transpose(1, 0, 2)
+        )
+        out["v"] = layer_cache["v"].at[row, :, slot].set(vq)
+        out["v_scale"] = layer_cache["v_scale"].at[row, :, slot].set(vsc)
+    else:
+        dt = layer_cache["k"].dtype
+        out["k"] = layer_cache["k"].at[:, row, :, slot].set(
+            ks.astype(dt).transpose(1, 0, 2, 3)
+        )
+        out["v"] = layer_cache["v"].at[row, :, slot].set(v.astype(dt))
+    return out
+
+
+def _pool_attn_spec(
+    x: jnp.ndarray,  # (B, L, E) normed per-row inputs
+    p_attn: dict,
+    layer_cache: dict,  # contiguous (R >= B rows) OR paged leaves
+    pos: jnp.ndarray,  # (B, L) int32 absolute positions
+    targets: jnp.ndarray,  # (B, L) int32: cache row (contiguous) or
+    #                        physical write page (paged) per verify row
+    page_tables,  # (B, pages_per_slot) int32, or None on the
+    #               contiguous path
+    layer_idx: int,
+    cfg: ModelConfig,
+    cos,  # (B, L, d/2) per-row RoPE tables (None for the diff family)
+    sin,
+):
+    """The L-row twin of :func:`_pool_attn` / :func:`_pool_attn_paged`:
+    write all L rows' K/V (flattened, write-redirected), then attend
+    every row with row-causal visibility through
+    ops/decode_attention.py's multi-query kernel (or its XLA twin)."""
+    B, L, E = x.shape
+    M = cfg.block_size
+    wq, wk = _stacked_wq(p_attn)
+    qs = jnp.einsum("ble,sehd->sblhd", x, wq.astype(x.dtype))
+    ks = jnp.einsum("ble,sehd->sblhd", x, wk.astype(x.dtype))
+    v = jnp.einsum("ble,ehd->blhd", x, p_attn["wv"].astype(x.dtype))
+    if _uses_rope(cfg):
+        S = qs.shape[0]
+        d = qs.shape[-1]
+        cos_f = cos.reshape(B * L, -1)
+        sin_f = sin.reshape(B * L, -1)
+        qs = _rope_rows(
+            qs.reshape(S, B * L, cfg.n_head, d), cos_f, sin_f
+        ).reshape(qs.shape)
+        ks = _rope_rows(
+            ks.reshape(S, B * L, cfg.n_head, d), cos_f, sin_f
+        ).reshape(ks.shape)
+    S = qs.shape[0]
+    ks_f = ks.reshape(S, B * L, cfg.n_head, -1)  # B, L adjacent: zero-copy
+    v_f = v.reshape(B * L, cfg.n_head, -1)
+    if page_tables is None:
+        slot = jax.lax.rem(
+            jnp.asarray(pos, jnp.int32).reshape(-1), M
+        )
+        new_cache = _update_cache_rows_spec(
+            layer_cache, ks_f, v_f, slot, targets.reshape(-1)
+        )
+    else:
+        new_cache = _update_pages_rows(
+            layer_cache, ks_f, v_f,
+            jnp.asarray(pos, jnp.int32).reshape(-1),
+            targets.reshape(-1), M,
+        )
+    coeffs = _layer_coeffs(cfg, p_attn, layer_idx)
+    if cfg.decode_attention_impl == "pallas":
+        if page_tables is None:
+            out = decode_attention_multi(
+                qs, new_cache["k"], new_cache["v"], pos, coeffs,
+                k_scale=new_cache.get("k_scale"),
+                v_scale=new_cache.get("v_scale"),
+            )
+        else:
+            out = decode_attention_multi_paged(
+                qs, new_cache["k"], new_cache["v"], page_tables, pos,
+                coeffs,
+                k_scale=new_cache.get("k_scale"),
+                v_scale=new_cache.get("v_scale"),
+            )
+    else:
+        if page_tables is None:
+            # the trash row (batch rows >= B) is never attended
+            view = {
+                key: (c_val[:, :B] if KV_CACHE_BATCH_AXIS[key]
+                      else c_val[:B])
+                for key, c_val in new_cache.items()
+            }
+        else:
+            view = {
+                key: _gather_pool_view(new_cache[key], page_tables,
+                                       KV_CACHE_BATCH_AXIS[key])
+                for key in new_cache
+            }
+        k_eff, v_eff = _dequant_layer(view, x.dtype)
+        out = decode_attention_multi_reference(qs, k_eff, v_eff, pos,
+                                               coeffs)
+    out = out.reshape(B, L, -1)  # concat heads
+    if cfg.model in ("diff", "ndiff"):
+        out = common.apply_group_norm(out, p_attn["gn"], cfg)
+        out = out * OUTPUT_SCALE
+    return common.linear(out, p_attn["out"]), new_cache
+
+
+def _spec_row_axes(cfg: ModelConfig) -> list:
+    """Per-layer cache vmap axes (the engine's ``row_axes`` twin)."""
+    keys = (
+        ("k", "v", "k_scale", "v_scale")
+        if kv_store_dtype(cfg) == "int8" else ("k", "v")
+    )
+    return [
+        {key: KV_CACHE_BATCH_AXIS[key] for key in keys}
+    ] * cfg.n_layer
+
+
+def _one_row_exact(params, token, pos, cache_row, cfg: ModelConfig,
+                   rope_len: int):
+    """One vmap lane of the engine's XLA decode step (serving/engine.py
+    ``_build_step_fns._one_row``, duplicated here so the EXACT verify
+    mode is bit-identical to it by construction): re-add the batch-1
+    axis forward_chunk expects, advance one token, strip it again."""
+    cache_b = [
+        {key: (c[key][:, None] if KV_CACHE_BATCH_AXIS[key]
+               else c[key][None])
+         for key in c}
+        for c in cache_row
+    ]
+    logits, new_cache = forward_chunk(
+        params, token[None, None], pos, cache_b, cfg, rope_len=rope_len
+    )
+    new_row = [
+        {key: (c[key][:, 0] if KV_CACHE_BATCH_AXIS[key] else c[key][0])
+         for key in c}
+        for c in new_cache
+    ]
+    return logits[0, -1].astype(jnp.float32), new_row
+
+
+def _exact_row_step(params, tokens_r, pos_r, valid_r, cache,
+                    cfg: ModelConfig, rope_len: int):
+    """One EXACT verify sub-step over the full contiguous pool: run
+    the engine's own L=1 decode program (vmapped forward_chunk for the
+    XLA impl, the pool-native fused path for pallas) and discard
+    invalid rows' writes with the same masked merge the engine uses.
+    Because every op runs at exactly the L=1 step's shapes, the
+    sub-step is bit-identical to a plain engine iteration — at ANY
+    model size (batched multi-row matmuls reassociate their reductions
+    once the contraction is large enough; per-lane/M-preserving shapes
+    cannot)."""
+    if cfg.decode_attention_impl == "pallas":
+        logits, new_cache = forward_decode_pool(
+            params, tokens_r, pos_r, cache, cfg, rope_len=rope_len
+        )
+        logits = logits.astype(jnp.float32)
+    else:
+        axes = _spec_row_axes(cfg)
+        logits, new_cache = jax.vmap(
+            lambda t, p, c: _one_row_exact(params, t, p, c, cfg,
+                                           rope_len),
+            in_axes=(0, 0, axes), out_axes=(0, axes),
+        )(tokens_r, pos_r, cache)
+    return logits, merge_cache_update(valid_r, new_cache, cache)
+
+
+def forward_decode_spec(
+    params: dict,
+    tokens: jnp.ndarray,  # (B, L) per-row tokens (row 0 = last emitted)
+    pos,  # (B, L) int32 absolute position per row
+    cache: list,  # contiguous cache with R >= B batch rows
+    cfg: ModelConfig,
+    row_target: jnp.ndarray,  # (B, L) int32 cache row per verify row
+    rope_len: int = 0,
+    batched: bool = False,
+) -> Tuple[jnp.ndarray, list]:
+    """Advance the whole slot pool by an L-row verify block: returns
+    ``((B, L, V) logits, updated cache)``. Row (b, 0) reruns the slot's
+    last emitted token exactly like :func:`forward_decode_pool`; rows
+    1..L-1 carry its draft tokens at pos+1.. with row-causal
+    visibility. ``row_target`` redirects rows past a slot's draft
+    length (and inactive slots' rows) to the pool's trash row (batch
+    index B), so the rejected suffix never lands in live cache state —
+    the ring/page cursors "roll back" for free because visibility
+    derives purely from position arithmetic.
+
+    Two verify formulations (``ServingConfig.spec_verify``):
+
+    - ``batched=False`` (EXACT, the serving default): a static unroll
+      of L engine-native L=1 sub-steps inside one jitted program.
+      Every matmul keeps the plain decode step's shapes, so greedy
+      spec output is bit-identical to non-spec decoding at ANY model
+      size — the property the parity pins rely on.
+    - ``batched=True``: all L rows in ONE pass — one fused multi-query
+      attention call per layer (ops/decode_attention.py
+      ``decode_attention_multi``: every row's ring streamed once,
+      row-causal masks, int8 dequant fused) and (B, L)-batched
+      projections/FFN. This is the bandwidth-optimal TPU formulation
+      (the KV stream and weight reads amortize over the L rows);
+      large-contraction XLA matmuls may reassociate their reductions
+      vs the 1-row step, so greedy ties can resolve differently at
+      scale (bit-identical at the pinned test sizes; the sampled
+      distribution is unchanged either way).
+    """
+    B, L = tokens.shape
+    pos = jnp.asarray(pos, jnp.int32)
+    if batched:
+        return _forward_decode_spec_batched(
+            params, tokens, pos, cache, cfg, row_target, rope_len
+        )
+    R = cache[0]["v"].shape[0]
+    padn = R - B
+    valid = jnp.asarray(row_target, jnp.int32) < B
+    rows = []
+    for l in range(L):
+        t_r, p_r, v_r = tokens[:, l], pos[:, l], valid[:, l]
+        if padn:
+            t_r = jnp.concatenate([t_r, jnp.zeros((padn,), t_r.dtype)])
+            p_r = jnp.concatenate([p_r, jnp.zeros((padn,), p_r.dtype)])
+            v_r = jnp.concatenate([v_r, jnp.zeros((padn,), bool)])
+        lg, cache = _exact_row_step(params, t_r, p_r, v_r, cache, cfg,
+                                    rope_len)
+        rows.append(lg[:B])
+    return jnp.stack(rows, axis=1), cache
+
+
+def _forward_decode_spec_batched(params, tokens, pos, cache,
+                                 cfg: ModelConfig, row_target,
+                                 rope_len: int):
+    B, L = tokens.shape
+    M = cfg.block_size
+    compute = jnp.dtype(cfg.compute_dtype)
+    x = params["tok_emb"][tokens].astype(compute)  # (B, L, E)
+    cos = sin = None
+    if cfg.model == "diff":
+        x = x + params["pos_emb"][pos].astype(compute)
+    else:
+        cos_full, sin_full = rope_cos_sin(
+            cfg.head_size, max(int(rope_len), M)
+        )
+        cos = cos_full[pos]  # (B, L, d/2)
+        sin = sin_full[pos]
+    new_cache = []
+    for li, blk in enumerate(params["blocks"], 1):  # 1-based schedule
+        a, layer_cache = _pool_attn_spec(
+            common.apply_pre_norm(x, blk["ln1"], cfg), blk["attn"],
+            cache[li - 1], pos, row_target, None, li, cfg, cos, sin,
+        )
+        x = common.apply_block_ffn(x, a, blk, cfg)
+        new_cache.append(layer_cache)
+    x = common.apply_pre_norm(x, params["ln_f"], cfg)
+    return common.linear(x, params["lm_head"]), new_cache
+
+
+def forward_decode_spec_paged(
+    params: dict,
+    tokens: jnp.ndarray,  # (B, L) per-row tokens
+    pos,  # (B, L) int32 absolute position per row
+    cache: list,  # paged cache (init_cache_paged)
+    page_tables: jnp.ndarray,  # (B, pages_per_slot) int32
+    write_pages: jnp.ndarray,  # (B, L) int32; trash page for invalid rows
+    cfg: ModelConfig,
+    rope_len: int = 0,
+    batched: bool = False,
+) -> Tuple[jnp.ndarray, list]:
+    """Paged twin of :func:`forward_decode_spec`: every verify row's
+    K/V lands in the physical page ``write_pages[b, l]`` names (the
+    trash page for rows past the slot's draft length), and each row
+    attends THROUGH the same runtime page tables as the L=1 step — so
+    draft lengths, page churn and COW forks between calls compile
+    nothing new. EXACT mode unrolls L ``forward_decode_pool_paged``
+    sub-steps (bit-identical to the engine's paged L=1 step at any
+    size); batched mode streams each slot's pages ONCE for all L rows
+    through the scalar-prefetch multi-query kernel
+    (``decode_attention_multi_paged``)."""
+    B, L = tokens.shape
+    pos = jnp.asarray(pos, jnp.int32)
+    if not batched:
+        rows = []
+        for l in range(L):
+            lg, cache = forward_decode_pool_paged(
+                params, tokens[:, l], pos[:, l], cache, page_tables,
+                write_pages[:, l], cfg, rope_len=rope_len,
+            )
+            rows.append(lg.astype(jnp.float32))
+        return jnp.stack(rows, axis=1), cache
+    M = cfg.block_size
+    compute = jnp.dtype(cfg.compute_dtype)
+    x = params["tok_emb"][tokens].astype(compute)  # (B, L, E)
+    cos = sin = None
+    if cfg.model == "diff":
+        x = x + params["pos_emb"][pos].astype(compute)
+    else:
+        cos_full, sin_full = rope_cos_sin(
+            cfg.head_size, max(int(rope_len), M)
+        )
+        cos = cos_full[pos]
+        sin = sin_full[pos]
+    new_cache = []
+    for li, blk in enumerate(params["blocks"], 1):  # 1-based schedule
+        a, layer_cache = _pool_attn_spec(
+            common.apply_pre_norm(x, blk["ln1"], cfg), blk["attn"],
+            cache[li - 1], pos, write_pages, page_tables, li, cfg,
             cos, sin,
         )
         x = common.apply_block_ffn(x, a, blk, cfg)

@@ -413,6 +413,338 @@ def decode_attention_reference(
 
 
 # ---------------------------------------------------------------------------
+# Multi-query (L <= k rows per slot) variant — the speculative-decoding
+# verify kernel (serving/spec.py). Each of a slot's L rows carries its
+# own query token at its own absolute position (the last emitted token
+# plus the k draft tokens); every row streams the SAME ring cache (or
+# the same page-table-resolved pages) with ROW-CAUSAL visibility
+# ``col <= pos[b, l]``, so row l sees the K/V rows 0..l wrote this very
+# step (update-then-attend order, positions pos..pos+l) and nothing a
+# later row wrote. L = 1 reduces to the single-query kernel above; the
+# hot L=1 path keeps its dedicated kernel untouched.
+# ---------------------------------------------------------------------------
+
+
+def _dattn_mq_fwd_kernel(
+    q_ref,  # (1, S * L, d) this slot's per-(stream, row) queries
+    k_ref,  # (S, 1, block_k, d) stored dtype (float) or int8
+    v_ref,  # (1, block_k, dv)
+    pos_ref,  # (BH, L) int32 SMEM: absolute position per (b, h) row
+    c_ref,  # (S, H) float32 SMEM combine coefficients (_layer_coeffs)
+    *refs,  # [k_scale_ref (S, 1, block_k), v_scale_ref (1, block_k) if
+    #          quantized] then out_ref (1, L, dv) and scratch:
+    #          m (S, L), l (S, L), acc (S, L, dv) — all fp32
+    n_heads: int,
+    n_rows: int,
+    quantized: bool,
+):
+    if quantized:
+        ks_ref, vs_ref, out_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        out_ref, m_scr, l_scr, acc_scr = refs
+    L = n_rows
+    S, d = q_ref.shape[1] // L, q_ref.shape[2]
+    block_k = k_ref.shape[2]
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    nk = pl.num_programs(1)
+    # per-row positions; the tile-skip bound is the rows' max (static
+    # unroll over the tiny L to keep SMEM reads scalar-indexed)
+    pos_l = [pos_ref[bh, l] for l in range(L)]
+    pos_max = pos_l[0]
+    for l in range(1, L):
+        pos_max = jnp.maximum(pos_max, pos_l[l])
+    scale = 1.0 / math.sqrt(d)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # a tile entirely past every row's position is skipped outright;
+    # per-row visibility (col <= pos[l]) is masked below. The row loop
+    # is a STATIC unroll (L is tiny) running per row EXACTLY the op
+    # sequence of the single-query kernel above — a batched (S, L,
+    # block_k) dot would reassociate the d-reduction and break the
+    # bit-parity the greedy spec/non-spec pin depends on.
+    @pl.when(j * block_k <= pos_max)
+    def _():
+        q_all = q_ref[0].reshape(S, L, d)
+        k_j = k_ref[:, 0]  # (S, block_k, d)
+        v_j = v_ref[0]  # (block_k, dv)
+        if quantized:
+            k_j = (
+                k_j.astype(jnp.float32) * ks_ref[:, 0][:, :, None]
+            ).astype(q_all.dtype)
+            v_j = (
+                v_j.astype(jnp.float32) * vs_ref[0][:, None]
+            ).astype(q_all.dtype)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        for l in range(L):
+            q = q_all[:, l]  # (S, d)
+            s = jax.lax.dot_general(
+                q, k_j,
+                dimension_numbers=(((1,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale  # (S, block_k)
+            s = jnp.where(cols <= pos_l[l], s, NEG_INF)
+            m_prev = m_scr[:, l:l + 1]  # (S, 1)
+            m_new = jnp.maximum(
+                m_prev, jnp.max(s, axis=-1, keepdims=True)
+            )
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)  # (S, block_k)
+            l_scr[:, l:l + 1] = (
+                l_scr[:, l:l + 1] * alpha
+                + jnp.sum(p, axis=-1, keepdims=True)
+            )
+            pv = jax.lax.dot_general(
+                p.astype(v_j.dtype), v_j,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (S, dv)
+            acc_scr[:, l] = acc_scr[:, l] * alpha + pv
+            m_scr[:, l:l + 1] = m_new
+
+    @pl.when(j == nk - 1)
+    def _():
+        h = jax.lax.rem(bh, jnp.int32(n_heads))
+        for l in range(L):
+            l_safe = jnp.maximum(l_scr[:, l:l + 1], 1e-30)
+            o_s = acc_scr[:, l] / l_safe  # (S, dv) per-stream outputs
+            combined = o_s[0:1] * c_ref[0, h]
+            for s_i in range(1, S):
+                combined += o_s[s_i:s_i + 1] * c_ref[s_i, h]
+            out_ref[0, l] = combined[0].astype(out_ref.dtype)
+
+
+def decode_attention_multi(
+    qs: jnp.ndarray,  # (S, B, L, H, d) per-row queries (post-RoPE)
+    k_cache: jnp.ndarray,  # (S, R, H, M, d) stored dtype or int8; R >= B
+    v_cache: jnp.ndarray,  # (R, H, M, dv)
+    pos,  # (B, L) int32 absolute position of each row's token
+    coeffs: jnp.ndarray,  # (S, H) float32 combine coefficients
+    *,
+    k_scale: Optional[jnp.ndarray] = None,  # (S, R, H, M) fp32 (int8)
+    v_scale: Optional[jnp.ndarray] = None,  # (R, H, M) fp32
+    block_k: int = 0,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused multi-query decode attention over the slot pool: the
+    speculative verify step's kernel. Row (b, l) attends slot b's ring
+    cache with visibility ``col <= pos[b, l]`` — row-causal over the
+    K/V rows this very step wrote (update-then-attend, positions
+    pos..pos+L-1 written before any row attends). The cache may carry
+    MORE batch rows than there are query slots (``R > B``: the spec
+    engine's trash row rides at index B and is never attended).
+    Returns ``(B, L, H, dv)`` in the query dtype."""
+    S, B, L, H, d = qs.shape
+    R, M = k_cache.shape[1], k_cache.shape[3]
+    dv = v_cache.shape[-1]
+    BH = B * H
+    if interpret is None:
+        interpret = auto_interpret()
+    bk = pick_block(block_k or _DEFAULT_BLOCK_K, M)
+    nk = M // bk
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
+
+    # (S, B, L, H, d) -> (B, H, S, L, d) -> (BH, S*L, d): stream-major
+    # row packing, so the kernel's reshape to (S, L, d) is zero-copy
+    q = qs.transpose(1, 3, 0, 2, 4).reshape(BH, S * L, d)
+    k = k_cache.reshape(S, R * H, M, d)  # zero-copy: head-major layout
+    v = v_cache.reshape(R * H, M, dv)
+    pos_bh = jnp.repeat(
+        jnp.asarray(pos, jnp.int32), H, axis=0
+    )  # (B*H, L): row b*H+h carries slot b's positions
+
+    inputs = [q, k, v, pos_bh, coeffs.astype(jnp.float32)]
+    in_specs = [
+        pl.BlockSpec((1, S * L, d), lambda bh, j: (bh, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((S, 1, bk, d), lambda bh, j: (0, bh, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, dv), lambda bh, j: (bh, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((BH, L), lambda bh, j: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((S, H), lambda bh, j: (0, 0),
+                     memory_space=pltpu.SMEM),
+    ]
+    if quantized:
+        inputs += [
+            k_scale.reshape(S, R * H, M).astype(jnp.float32),
+            v_scale.reshape(R * H, M).astype(jnp.float32),
+        ]
+        in_specs += [
+            pl.BlockSpec((S, 1, bk), lambda bh, j: (0, bh, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), lambda bh, j: (bh, j),
+                         memory_space=pltpu.VMEM),
+        ]
+    out = pl.pallas_call(
+        functools.partial(
+            _dattn_mq_fwd_kernel, n_heads=H, n_rows=L,
+            quantized=quantized,
+        ),
+        grid=(BH, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, L, dv), lambda bh, j: (bh, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, L, dv), qs.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((S, L), jnp.float32),
+            pltpu.VMEM((S, L), jnp.float32),
+            pltpu.VMEM((S, L, dv), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*inputs)
+    # (BH, L, dv) -> (B, L, H, dv)
+    return out.reshape(B, H, L, dv).transpose(0, 2, 1, 3)
+
+
+def _dattn_mq_paged_kernel(pt_ref, *args, n_heads: int, n_rows: int,
+                           quantized: bool):
+    """Paged twin of :func:`_dattn_mq_fwd_kernel`: the page table did
+    its work in the scalar-prefetch index maps (same maps as
+    :func:`decode_attention_paged`), so the body sees (S, 1, ps, d)
+    tiles in logical ring order and delegates wholesale. ``_dattn_``
+    needle kept for tools/profile_step.py bucketing."""
+    del pt_ref  # consumed by the index maps
+    _dattn_mq_fwd_kernel(*args, n_heads=n_heads, n_rows=n_rows,
+                         quantized=quantized)
+
+
+def decode_attention_multi_paged(
+    qs: jnp.ndarray,  # (S, B, L, H, d) per-row queries (post-RoPE)
+    k_pages: jnp.ndarray,  # (S, P, H, ps, d) stored dtype or int8
+    v_pages: jnp.ndarray,  # (P, H, ps, dv)
+    page_tables: jnp.ndarray,  # (B, pages_per_slot) int32
+    pos,  # (B, L) int32 absolute position per row
+    coeffs: jnp.ndarray,  # (S, H) float32 combine coefficients
+    *,
+    k_scale: Optional[jnp.ndarray] = None,  # (S, P, H, ps) fp32
+    v_scale: Optional[jnp.ndarray] = None,  # (P, H, ps) fp32
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Multi-query verify attention THROUGH a page table: each of the
+    L rows attends the paged ring through the SAME scalar-prefetch
+    page-table index maps as :func:`decode_attention_paged` (one grid
+    step streams one physical page, int8 dequant fused in the load)
+    with row-causal ``col <= pos[b, l]`` visibility. Runtime int32
+    tables ⇒ page churn between calls compiles nothing new."""
+    S, P, H, ps, d = k_pages.shape
+    dv = v_pages.shape[-1]
+    B, pp = page_tables.shape
+    L = qs.shape[2]
+    BH = B * H
+    if interpret is None:
+        interpret = auto_interpret()
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
+
+    q = qs.transpose(1, 3, 0, 2, 4).reshape(BH, S * L, d)
+    k = k_pages.reshape(S, P * H, ps, d)  # zero-copy: head-major pages
+    v = v_pages.reshape(P * H, ps, dv)
+    pos_bh = jnp.repeat(jnp.asarray(pos, jnp.int32), H, axis=0)
+    pt = jnp.asarray(page_tables, jnp.int32)
+
+    def _k_map(bh, j, pt_ref):
+        return (0, pt_ref[bh // H, j] * H + bh % H, 0, 0)
+
+    def _v_map(bh, j, pt_ref):
+        return (pt_ref[bh // H, j] * H + bh % H, 0, 0)
+
+    inputs = [q, k, v, pos_bh, coeffs.astype(jnp.float32)]
+    in_specs = [
+        pl.BlockSpec((1, S * L, d), lambda bh, j, pt_ref: (bh, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((S, 1, ps, d), _k_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, ps, dv), _v_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((BH, L), lambda bh, j, pt_ref: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((S, H), lambda bh, j, pt_ref: (0, 0),
+                     memory_space=pltpu.SMEM),
+    ]
+    if quantized:
+        inputs += [
+            k_scale.reshape(S, P * H, ps).astype(jnp.float32),
+            v_scale.reshape(P * H, ps).astype(jnp.float32),
+        ]
+        in_specs += [
+            pl.BlockSpec(
+                (S, 1, ps),
+                lambda bh, j, pt_ref: (0, pt_ref[bh // H, j] * H
+                                       + bh % H, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, ps),
+                lambda bh, j, pt_ref: (pt_ref[bh // H, j] * H
+                                       + bh % H, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(BH, pp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, L, dv),
+                               lambda bh, j, pt_ref: (bh, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((S, L), jnp.float32),
+            pltpu.VMEM((S, L), jnp.float32),
+            pltpu.VMEM((S, L, dv), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _dattn_mq_paged_kernel, n_heads=H, n_rows=L,
+            quantized=quantized,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((BH, L, dv), qs.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(pt, *inputs)
+    return out.reshape(B, H, L, dv).transpose(0, 2, 1, 3)
+
+
+def decode_attention_multi_reference(
+    qs: jnp.ndarray,  # (S, B, L, H, d)
+    k_cache: jnp.ndarray,  # (S, B, H, M, d) FLOAT (dequantize first)
+    v_cache: jnp.ndarray,  # (B, H, M, dv)
+    pos,  # (B, L) int32
+    coeffs: jnp.ndarray,  # (S, H) float32
+) -> jnp.ndarray:
+    """Plain-XLA twin of :func:`decode_attention_multi`: a STATIC
+    unroll over the tiny L, each row running EXACTLY
+    :func:`decode_attention_reference`'s op sequence at its own
+    position — a batched ``sbhlm`` einsum would reassociate the
+    contractions and break the bit-parity the greedy spec/non-spec pin
+    depends on. Returns ``(B, L, H, dv)``."""
+    L = qs.shape[2]
+    pos = jnp.asarray(pos, jnp.int32)
+    rows = [
+        decode_attention_reference(qs[:, :, l], k_cache, v_cache,
+                                   pos[:, l], coeffs)
+        for l in range(L)
+    ]
+    return jnp.stack(rows, axis=1)  # (B, L, H, dv)
+
+
+# ---------------------------------------------------------------------------
 # int8 weight quantization (load_params_for_inference satellite)
 # ---------------------------------------------------------------------------
 

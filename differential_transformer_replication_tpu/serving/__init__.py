@@ -22,6 +22,8 @@ _EXPORTS = {
     "Scheduler": "scheduler",
     "PagePool": "pages",
     "PagePoolExhaustedError": "pages",
+    "ModelDrafter": "spec",
+    "NGramDrafter": "spec",
     "QueueFullError": "scheduler",
     "DeadlineExceededError": "scheduler",
     "ShuttingDownError": "server",
@@ -72,6 +74,10 @@ if TYPE_CHECKING:  # static analyzers see the eager imports
         ServingClient,
         ShuttingDownError,
         serve,
+    )
+    from differential_transformer_replication_tpu.serving.spec import (
+        ModelDrafter,
+        NGramDrafter,
     )
 
 

@@ -34,6 +34,13 @@ class SamplingParams:
     # Stop token for THIS request; None defers to ServingConfig's
     # engine-wide default. The matching token is included in the output.
     eos_token_id: Optional[int] = None
+    # Per-request cap on speculative draft length (serving/spec.py):
+    # at most this many drafted tokens are verified per iteration for
+    # this request. None = the engine's ServingConfig.spec_draft_len;
+    # 0 = speculation off for this request. Caps above the engine's
+    # compiled draft ladder clamp to it — per-request draft lengths
+    # ride the jitted verify step as runtime arrays, never recompiling.
+    draft_len: Optional[int] = None
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -55,6 +62,13 @@ class SamplingParams:
         if not isinstance(self.temperature, (int, float)):
             raise ValueError(
                 f"temperature must be a number, got {self.temperature!r}"
+            )
+        if self.draft_len is not None and (
+            not isinstance(self.draft_len, int) or self.draft_len < 0
+        ):
+            raise ValueError(
+                f"draft_len must be a non-negative int or None, got "
+                f"{self.draft_len!r}"
             )
 
 
@@ -104,6 +118,13 @@ class RequestOutput:
     # trace context — echoed in HTTP replies so a slow request can be
     # looked up in the stitched timeline (tools/trace_stitch.py)
     trace_id: Optional[str] = None
+    # speculative-decoding accounting (serving/spec.py): draft tokens
+    # the drafter proposed for this request and how many the target
+    # accepted — the per-request view of the engine-wide
+    # serving_spec_{proposed,accepted}_tokens_total counters. Both 0
+    # when speculation was off (or never engaged) for this request.
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     @property
     def ttft(self) -> float:

@@ -86,6 +86,16 @@ class Slot:
     # (obs/trace.py:TraceContext), or None when it arrived untraced —
     # pure host-side bookkeeping, stamped onto span/instant args only
     trace: Optional[object] = None
+    # speculative-decoding accounting (serving/spec.py): draft tokens
+    # proposed/accepted for this request so far — copied onto the
+    # RequestOutput at retirement
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    # the cropped prompt as a plain int list, built lazily by the
+    # engine's proposal collector — per-element int() conversion of
+    # the numpy prompt every decode iteration was measurable hot-loop
+    # host cost
+    prompt_ids: Optional[list] = None
 
     @property
     def prompt_len(self) -> int:
@@ -104,6 +114,9 @@ class Slot:
         self.first_token_time = 0.0
         self.token_times = []
         self.trace = None
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.prompt_ids = None
 
 
 def _pow2_chunk(n: int, cap: int) -> int:
@@ -255,6 +268,9 @@ class Scheduler:
             slot.cached_len = cached
             slot.generated = []
             slot.token_times = []
+            slot.spec_proposed = 0
+            slot.spec_accepted = 0
+            slot.prompt_ids = None
             slot.submit_time = t_submit
             slot.deadline = deadline
             slot.trace = trace

@@ -692,6 +692,17 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                     pages = page_stats()
                     if pages is not None:
                         payload["kv_pages"] = pages
+                # speculative-decoding snapshot (serving/spec.py):
+                # mode, draft rung, proposed/accepted counters and
+                # acceptance rate — the per-replica view the fleet
+                # aggregation sums from /metrics
+                spec_stats = getattr(
+                    client.runner.engine, "spec_stats", None
+                )
+                if spec_stats is not None:
+                    spec = spec_stats()
+                    if spec is not None:
+                        payload["spec"] = spec
                 self._reply(200, payload)
             elif self.path == "/ready":
                 if client.runner.accepting():
@@ -932,6 +943,33 @@ def main() -> None:
     p.add_argument("--prefix-cache-pages", type=int, default=0,
                    help="extra pool pages reserved as cached-prefix "
                         "headroom on top of the auto sizing")
+    p.add_argument("--spec-mode", default="",
+                   choices=("", "ngram", "model"),
+                   help="speculative decoding (serving/spec.py): "
+                        "'ngram' = drafter-free prompt lookup over "
+                        "each request's own tokens; 'model' = a small "
+                        "drafter checkpoint (--spec-drafter-ckpt) "
+                        "proposing greedily on its own KV pool. The "
+                        "target verifies k drafted tokens per slot in "
+                        "ONE fused multi-row step — greedy output "
+                        "stays bit-identical to non-spec decoding")
+    p.add_argument("--spec-draft-len", type=int, default=4,
+                   help="draft tokens verified per slot per iteration "
+                        "(the compiled k rung; per-request lengths "
+                        "ride as runtime arrays)")
+    p.add_argument("--spec-drafter-ckpt", default="",
+                   help="drafter checkpoint dir for --spec-mode model "
+                        "(loaded like --checkpoint: manifest "
+                        "verification and --quantize-weights apply); "
+                        "must share the target's tokenizer/vocab")
+    p.add_argument("--spec-verify", default="exact",
+                   choices=("exact", "batched"),
+                   help="verify-step formulation: 'exact' (unrolled, "
+                        "greedy bit-identical to non-spec at any "
+                        "size) or 'batched' (each slot's KV streamed "
+                        "once for all k+1 rows through the fused "
+                        "multi-query kernel — the TPU-bandwidth "
+                        "formulation)")
     p.add_argument("--quantize-weights", default=None,
                    choices=("int8",),
                    help="per-channel int8 quantize + dequant of every "
@@ -1063,7 +1101,26 @@ def main() -> None:
         step_time_budget_s=args.step_time_budget,
         profile_every=args.profile_every,
         profile_dir=args.profile_dir,
+        spec_mode=args.spec_mode,
+        spec_draft_len=args.spec_draft_len,
+        spec_drafter_ckpt=args.spec_drafter_ckpt,
+        spec_verify=args.spec_verify,
     )
+    spec_drafter = None
+    if args.spec_mode == "model" and args.spec_drafter_ckpt:
+        # load the drafter through the SAME verified/quantized path as
+        # the target, so --no-verify-checkpoint / --quantize-weights
+        # apply to it too
+        from differential_transformer_replication_tpu.train.checkpoint import (
+            load_params_for_inference as _load_drafter,
+        )
+
+        d_params, d_cfg, _ = _load_drafter(
+            args.spec_drafter_ckpt,
+            verify=not args.no_verify_checkpoint,
+            quantize=args.quantize_weights,
+        )
+        spec_drafter = (d_params, d_cfg)
     tracer = None
     if args.trace_path:
         from differential_transformer_replication_tpu.obs.spans import (
@@ -1078,7 +1135,8 @@ def main() -> None:
         )
 
         events = EventLog(args.event_log, process="replica")
-    engine = ServingEngine(params, model_cfg, serving, tracer=tracer)
+    engine = ServingEngine(params, model_cfg, serving, tracer=tracer,
+                           spec_drafter=spec_drafter)
     client = ServingClient(engine)
 
     # process identity on /metrics: lets the router's aggregated

@@ -91,6 +91,21 @@ serving/server.py):
                         guard fires, the supervised restart rebuilds
                         pool + radix tree, and the poisoned prefix is
                         evicted instead of ever serving garbage tokens
+  ``spec_drafter_crash@N``
+                        NaN-poison the speculative drafter's own KV
+                        pool (serving/spec.py:ModelDrafter) before
+                        engine iteration N's proposals: the drafter's
+                        finite-logits reduction trips, it rebuilds
+                        from params and proposes nothing, and the
+                        engine falls back to the non-spec decode step
+                        — never garbage tokens. One-shot.
+  ``spec_reject_storm@N`` / ``spec_reject_storm@A-B``
+                        force the fused verify step to REJECT every
+                        drafted token at those engine iterations (a
+                        pathological drafter): throughput must
+                        degrade gracefully to ~non-spec — one emitted
+                        token per slot per step, outputs still exact.
+                        NOT one-shot: a range is a storm window.
 
 Router fault points (call-point style like ``ckpt_*`` — ``@N`` counts
 CALLS until the fault fires, default 1; exercised by
@@ -145,6 +160,9 @@ _STEP_KINDS = (
     # paged-KV kinds (serving/pages.py): typed pool exhaustion and
     # cached-prefix poisoning, same engine-iteration counting
     "page_exhaust", "prefix_corrupt",
+    # speculative-decoding kinds (serving/spec.py): drafter-pool
+    # poison (one-shot) and the persistent 0%-acceptance storm
+    "spec_drafter_crash", "spec_reject_storm",
 )
 _POINT_KINDS = (
     "ckpt_write", "ckpt_fsync", "ckpt_manifest", "ckpt_gc",
@@ -286,6 +304,26 @@ def prefix_corrupt_at(iteration: int) -> bool:
         p["prefix_corrupt"].discard(iteration)
         return True
     return False
+
+
+def spec_drafter_crash_at(iteration: int) -> bool:
+    """One-shot drafter-pool poison fault: when armed for this engine
+    iteration, the engine NaN-poisons the speculative drafter's KV
+    pool — the drafter's finite-logits guard (not garbage proposals)
+    must catch it and fall back to non-spec decode."""
+    p = _get()
+    if iteration in p["spec_drafter_crash"]:
+        p["spec_drafter_crash"].discard(iteration)
+        return True
+    return False
+
+
+def spec_reject_storm_at(iteration: int) -> bool:
+    """Whether the fused verify step must reject EVERY drafted token
+    at this engine iteration. Deliberately NOT one-shot — arm a range
+    (``spec_reject_storm@A-B``) for a sustained storm; the throughput
+    floor under it is the non-spec rate."""
+    return iteration in _get()["spec_reject_storm"]
 
 
 def train_stall(step: int) -> None:

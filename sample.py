@@ -69,6 +69,21 @@ def main() -> None:
     p.add_argument("--prefix-cache-pages", type=int, default=0,
                    help="with --kv-page-size: extra pool pages kept as "
                         "cached-prefix headroom")
+    p.add_argument("--spec-draft-ckpt", default=None,
+                   help="speculative decoding (serving/spec.py): a "
+                        "small drafter checkpoint (typically the "
+                        "control family beside a diff/ndiff target, "
+                        "same tokenizer) loaded through the same "
+                        "verified path as --checkpoint "
+                        "(--no-verify-checkpoint / --quantize-weights "
+                        "apply to it too); sampling then routes "
+                        "through a spec-enabled serving engine")
+    p.add_argument("--spec-draft-len", type=int, default=0,
+                   help="draft tokens verified per step; > 0 without "
+                        "--spec-draft-ckpt uses the drafter-free "
+                        "n-gram prompt-lookup fallback. Greedy "
+                        "(--temperature 0) output is bit-identical "
+                        "to the non-spec path")
     args = p.parse_args()
 
     from differential_transformer_replication_tpu.data.tokenizer import (
@@ -120,17 +135,23 @@ def main() -> None:
 
     rng = jax.random.PRNGKey(args.seed)
     in_window = len(ids) + args.max_new_tokens <= model_cfg.block_size
-    if args.kv_page_size > 0 and (
+    spec_requested = bool(args.spec_draft_ckpt) or args.spec_draft_len > 0
+    if (args.kv_page_size > 0 or spec_requested) and (
         in_window or model_cfg.model != "diff"
     ):
-        # paged route: one tiny serving engine; the FIRST sample
-        # prefills the prompt alone, then its retirement donates the
-        # prompt pages to the radix cache so the remaining --n - 1
-        # samples (submitted as one batch) skip the prefill. Sampling
-        # keys follow the engine's per-request fold_in chain, so draws
-        # differ from the direct generate_cached path by design. The
-        # diff family past its window falls through to the windowed
-        # generate below exactly like the default path.
+        # engine route (paged KV and/or speculative decoding): one
+        # tiny serving engine. Paged: the FIRST sample prefills the
+        # prompt alone, then its retirement donates the prompt pages
+        # to the radix cache so the remaining --n - 1 samples
+        # (submitted as one batch) skip the prefill. Spec: a drafter
+        # (checkpoint, or the n-gram fallback) proposes tokens the
+        # target verifies in one fused step — the CLI demo of the
+        # server's --spec-mode without a server. Sampling keys follow
+        # the engine's per-request fold_in chain, so temperature > 0
+        # draws differ from the direct generate_cached path by design
+        # (greedy is bit-identical). The diff family past its window
+        # falls through to the windowed generate below exactly like
+        # the default path.
         from differential_transformer_replication_tpu.config import (
             ServingConfig,
         )
@@ -139,17 +160,35 @@ def main() -> None:
             ServingEngine,
         )
 
+        spec_drafter = None
+        spec_mode = ""
+        if args.spec_draft_ckpt:
+            spec_mode = "model"
+            # same verified/quantized load path as the target — a
+            # corrupt or mismatched drafter fails loudly here, and
+            # int8 weight quantization applies to it too
+            d_params, d_cfg, _ = load_params_for_inference(
+                args.spec_draft_ckpt,
+                verify=not args.no_verify_checkpoint,
+                quantize=args.quantize_weights,
+            )
+            spec_drafter = (d_params, d_cfg)
+        elif args.spec_draft_len > 0:
+            spec_mode = "ngram"
         serving = ServingConfig(
             num_slots=max(1, min(args.n, 8)),
             kv_page_size=args.kv_page_size,
             prefix_cache=not args.no_prefix_cache,
             prefix_cache_pages=args.prefix_cache_pages,
+            spec_mode=spec_mode,
+            spec_draft_len=args.spec_draft_len or 4,
             max_seq_len=(
                 0 if model_cfg.model == "diff"
                 else len(ids) + args.max_new_tokens
             ),
         )
-        engine = ServingEngine(params, model_cfg, serving)
+        engine = ServingEngine(params, model_cfg, serving,
+                               spec_drafter=spec_drafter)
 
         def _params(i):
             return SamplingParams(
@@ -165,9 +204,15 @@ def main() -> None:
                 params=[_params(i) for i in range(1, args.n)],
             )
         st = engine.page_stats()
-        print(f"[sample] paged KV: page_size={st['page_size']} "
-              f"prefix hits={st['hits_total']} "
-              f"misses={st['misses_total']}")
+        if st is not None:
+            print(f"[sample] paged KV: page_size={st['page_size']} "
+                  f"prefix hits={st['hits_total']} "
+                  f"misses={st['misses_total']}")
+        spec = engine.spec_stats()
+        if spec is not None:
+            print(f"[sample] spec ({spec['mode']}): proposed="
+                  f"{spec['proposed']} accepted={spec['accepted']} "
+                  f"rate={spec['acceptance_rate']}")
         for i, o in enumerate(outs):
             print(f"--- sample {i} ---")
             print(tokenizer.decode(o.prompt + o.tokens))

@@ -1,0 +1,671 @@
+"""Speculative decoding subsystem (serving/spec.py + the fused
+multi-row verify step).
+
+The load-bearing contracts:
+
+- **Greedy bit-parity**: a spec-enabled engine's greedy output is
+  IDENTICAL to non-spec ``generate_cached`` for all three target
+  families, both decode impls, both KV dtypes, contiguous and paged
+  pools, both verify formulations — speculation is a scheduler over
+  the same math, never a different model. An arbitrarily bad drafter
+  (random weights, poisoned pool, 0%-acceptance storm) can only cost
+  throughput, never correctness.
+- **The compile ladder**: mixed spec/non-spec traffic and varying
+  per-request draft lengths ride runtime arrays through a FIXED set of
+  compiled step programs — decode stays at 1 entry and the spec rung
+  within its two accept variants, RecompileSentinel-gated.
+- **Lock discipline**: the drafters are lock-owning classes shared
+  between the engine thread and /health readers; the GL301 mutation
+  test proves graftlint actually guards their state.
+"""
+
+import json
+import subprocess
+import sys
+from functools import lru_cache
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.config import (
+    ModelConfig,
+    ServingConfig,
+)
+from differential_transformer_replication_tpu.models import (
+    generate_cached,
+    init_model,
+)
+from differential_transformer_replication_tpu.serving import (
+    ModelDrafter,
+    NGramDrafter,
+    ServingEngine,
+)
+from differential_transformer_replication_tpu.serving.spec import (
+    DraftSlot,
+    build_drafter,
+)
+from differential_transformer_replication_tpu.utils import faults
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _cfg(kind, impl="xla", kvd="auto", vocab=61, n_embd=32, n_layer=2,
+         block=32):
+    return ModelConfig(
+        model=kind, vocab_size=vocab, n_embd=n_embd, n_head=2,
+        n_layer=n_layer, block_size=block, dropout=0.0, n_terms=3,
+        compute_dtype="float32", decode_attention_impl=impl,
+        kv_cache_dtype=kvd,
+    )
+
+
+@lru_cache(maxsize=None)
+def _setup(kind, impl="xla", kvd="auto"):
+    cfg = _cfg(kind, impl, kvd)
+    return cfg, init_model(jax.random.PRNGKey(0), cfg)
+
+
+def _prompts(lens, vocab, seed=1):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=n).tolist() for n in lens]
+
+
+@lru_cache(maxsize=None)
+def _ref_greedy_all(kind, impl, kvd, lens, n, seed=1):
+    cfg, params = _setup(kind, impl, kvd)
+    outs = []
+    for p in _prompts(list(lens), cfg.vocab_size, seed):
+        out = generate_cached(
+            params, jnp.asarray(p, jnp.int32)[None], cfg, n,
+            jax.random.PRNGKey(0), temperature=0.0,
+        )
+        outs.append(np.asarray(out)[0, len(p):].tolist())
+    return outs
+
+
+def _spec_serving(**kw):
+    base = dict(num_slots=2, prefill_chunk=4, prefill_budget=8,
+                spec_mode="ngram", spec_draft_len=4)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+LENS = (3, 9, 14, 6)
+
+
+class TestNGramDrafter:
+    def _slot(self, toks, cap=4, index=0):
+        return DraftSlot(index, toks, len(toks) - 1, cap)
+
+    def test_lookup_proposes_continuation(self):
+        d = NGramDrafter()
+        out = d.propose_all([self._slot([1, 2, 3, 4, 2, 3])])
+        # suffix (2, 3) matched at positions 1..2 -> continuation
+        assert out == {0: [4, 2, 3]}
+
+    def test_tail_self_match_is_excluded(self):
+        # the tail trigram matches ITSELF at end-of-history; only an
+        # EARLIER occurrence may propose
+        d = NGramDrafter()
+        assert d.propose_all([self._slot([5, 6, 7])]) == {}
+        out = d.propose_all([self._slot([5, 6, 7, 5, 6])])
+        assert out == {0: [7, 5, 6]}
+
+    def test_most_recent_occurrence_wins(self):
+        d = NGramDrafter()
+        out = d.propose_all([self._slot([1, 9, 1, 8, 1], cap=1)])
+        # 1-gram (1,): latest non-tail occurrence at index 2 -> 8
+        assert out == {0: [8]}
+
+    def test_cap_and_zero_cap(self):
+        d = NGramDrafter()
+        toks = [1, 2, 1, 2, 1, 2]
+        out = d.propose_all([self._slot(toks, cap=2)])
+        assert len(out[0]) == 2
+        assert d.propose_all([self._slot(toks, cap=0)]) == {}
+
+    def test_incremental_index_and_slot_reuse(self):
+        d = NGramDrafter()
+        d.propose_all([self._slot([1, 2, 3])])
+        out = d.propose_all([self._slot([1, 2, 3, 1, 2])])
+        assert out == {0: [3, 1, 2]}
+        # slot reused by a SHORTER history: the map must rebuild
+        out = d.propose_all([self._slot([7, 8])])
+        assert out == {}
+        d.release(0)
+        assert d.propose_all([self._slot([1, 2, 3, 1, 2])]) == {
+            0: [3, 1, 2]
+        }
+
+    def test_stats_counts_proposed(self):
+        d = NGramDrafter()
+        # tail trigram (1,2,1) matched at positions 0..2 -> the
+        # continuation [2, 1] (history ends before the cap fills)
+        out = d.propose_all([self._slot([1, 2, 1, 2, 1], cap=3)])
+        assert out == {0: [2, 1]}
+        st = d.stats()
+        assert st["kind"] == "ngram"
+        assert st["proposed_total"] == 2
+        assert st["drafter_crashes_total"] == 0
+
+
+# representative combos in the quick tier; the full matrix rides the
+# slow tier (conftest honors explicit slow marks)
+_QUICK_COMBOS = [
+    ("control", "xla", "auto", 0, "exact"),
+    ("control", "xla", "bf16", 8, "batched"),
+    ("control", "pallas", "int8", 0, "batched"),
+    ("control", "pallas", "auto", 8, "exact"),
+    ("diff", "xla", "int8", 8, "exact"),
+    ("ndiff", "pallas", "bf16", 0, "batched"),
+]
+_SLOW_COMBOS = [
+    (kind, impl, kvd, page, verify)
+    for kind in ("control", "diff", "ndiff")
+    for impl in ("xla", "pallas")
+    for kvd in ("auto", "bf16", "int8")
+    for page in (0, 8)
+    for verify in ("exact", "batched")
+    if (kind, impl, kvd, page, verify) not in _QUICK_COMBOS
+]
+
+
+@pytest.mark.parametrize(
+    "kind,impl,kvd,page,verify",
+    _QUICK_COMBOS + [
+        pytest.param(*c, marks=pytest.mark.slow) for c in _SLOW_COMBOS
+    ],
+)
+def test_spec_greedy_bit_identical_to_generate_cached(
+    kind, impl, kvd, page, verify
+):
+    """THE parity battery: ngram-spec greedy output through a 2-slot
+    pool (queueing + slot reuse) equals sequential generate_cached
+    for every family x impl x KV dtype x pool layout x verify mode."""
+    cfg, params = _setup(kind, impl, kvd)
+    prompts = _prompts(LENS, cfg.vocab_size)
+    eng = ServingEngine(
+        params, cfg,
+        _spec_serving(kv_page_size=page, spec_verify=verify),
+    )
+    outs = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+    refs = _ref_greedy_all(kind, impl, kvd, LENS, 8)
+    for o, r in zip(outs, refs):
+        assert o.tokens == r
+    # something actually got drafted (the repetitive greedy outputs
+    # feed the prompt-lookup) and the accounting is consistent
+    st = eng.spec_stats()
+    assert st["proposed"] >= st["accepted"] >= 0
+    assert sum(o.spec_proposed for o in outs) == st["proposed"]
+    assert sum(o.spec_accepted for o in outs) == st["accepted"]
+
+
+def test_model_drafter_self_params_accepts_everything():
+    """A drafter sharing the target's params proposes exactly the
+    target's greedy continuations: acceptance 1.0, output unchanged —
+    the upper bound of the verify machinery."""
+    cfg, params = _setup("control")
+    prompts = _prompts(LENS, cfg.vocab_size)
+    eng = ServingEngine(
+        params, cfg, _spec_serving(spec_mode="model"),
+        spec_drafter=(params, cfg),
+    )
+    outs = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+    refs = _ref_greedy_all("control", "xla", "auto", LENS, 8)
+    for o, r in zip(outs, refs):
+        assert o.tokens == r
+    st = eng.spec_stats()
+    assert st["acceptance_rate"] == 1.0
+    assert st["proposed"] > 0
+    assert st["drafter"]["kind"] == "model"
+
+
+def test_random_control_drafter_beside_diff_target_stays_exact():
+    """The paper's pairing with a RANDOM-INIT drafter: near-zero
+    acceptance, bit-exact output — a bad drafter costs only
+    throughput."""
+    cfg, params = _setup("diff")
+    d_cfg = _cfg("control", n_embd=16, n_layer=1, vocab=61)
+    d_params = init_model(jax.random.PRNGKey(7), d_cfg)
+    prompts = _prompts(LENS, cfg.vocab_size)
+    eng = ServingEngine(
+        params, cfg, _spec_serving(spec_mode="model"),
+        spec_drafter=(d_params, d_cfg),
+    )
+    outs = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+    refs = _ref_greedy_all("diff", "xla", "auto", LENS, 8)
+    for o, r in zip(outs, refs):
+        assert o.tokens == r
+    assert eng.spec_stats()["proposed"] > 0
+
+
+def test_drafter_vocab_mismatch_fails_loudly():
+    cfg, params = _setup("control")
+    d_cfg = _cfg("control", vocab=97)
+    d_params = init_model(jax.random.PRNGKey(1), d_cfg)
+    with pytest.raises(ValueError, match="vocab"):
+        ServingEngine(
+            params, cfg, _spec_serving(spec_mode="model"),
+            spec_drafter=(d_params, d_cfg),
+        )
+
+
+def test_exact_and_batched_verify_agree_at_test_scale():
+    cfg, params = _setup("control")
+    prompts = _prompts(LENS, cfg.vocab_size)
+
+    def run(verify):
+        eng = ServingEngine(
+            params, cfg, _spec_serving(spec_verify=verify)
+        )
+        return [
+            o.tokens
+            for o in eng.generate(prompts, max_new_tokens=8,
+                                  temperature=0.0)
+        ]
+
+    assert run("exact") == run("batched")
+
+
+@pytest.mark.slow
+def test_exact_verify_bit_identical_at_larger_width():
+    """The scale-robustness pin the EXACT mode exists for: at widths
+    where batched multi-row matmuls reassociate their reductions
+    (contraction >= 512), the unrolled verify still bit-matches
+    generate_cached."""
+    cfg = _cfg("diff", vocab=512, n_embd=128, n_layer=3, block=128)
+    params = init_model(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    prompts = []
+    for _ in range(6):
+        n = int(rng.integers(6, 15))
+        period = int(rng.integers(2, 5))
+        cyc = rng.integers(0, 512, size=period).tolist()
+        prompts.append((cyc * (n // period + 1))[:n])
+    eng = ServingEngine(
+        params, cfg,
+        _spec_serving(num_slots=4, spec_verify="exact",
+                      spec_draft_len=6),
+    )
+    outs = eng.generate(prompts, max_new_tokens=32, temperature=0.0)
+    for p, o in zip(prompts, outs):
+        ref = generate_cached(
+            params, jnp.asarray(p, jnp.int32)[None], cfg, 32,
+            jax.random.PRNGKey(0), temperature=0.0,
+        )
+        assert o.tokens == np.asarray(ref)[0, len(p):].tolist()
+
+
+def test_sampled_determinism_across_batch_compositions():
+    """Spec-on sampled output stays a pure function of (params,
+    prompt, sampling params): the fold_in key chains see neither slot
+    assignment nor pool size nor admission order."""
+    cfg, params = _setup("control")
+    prompts = _prompts((4, 9, 6), cfg.vocab_size, seed=3)
+
+    def run(num_slots, order):
+        eng = ServingEngine(
+            params, cfg, _spec_serving(num_slots=num_slots)
+        )
+        ids = {}
+        for i in order:
+            ids[eng.submit(prompts[i], temperature=1.0, top_k=5,
+                           seed=7 + i, max_new_tokens=6)] = i
+        return {ids[o.request_id]: o.tokens for o in eng.run()}
+
+    assert run(2, [0, 1, 2]) == run(3, [2, 0, 1])
+
+
+def test_per_request_draft_len_caps_and_disables():
+    """SamplingParams.draft_len rides as a runtime cap: 0 disables
+    speculation for that request alone; mixed traffic shares the one
+    compiled rung."""
+    cfg, params = _setup("control")
+    prompts = _prompts((5, 5), cfg.vocab_size, seed=2)
+    eng = ServingEngine(params, cfg, _spec_serving(num_slots=2))
+    r0 = eng.submit(prompts[0], max_new_tokens=8, temperature=0.0,
+                    draft_len=0)
+    r1 = eng.submit(prompts[1], max_new_tokens=8, temperature=0.0)
+    by_id = {o.request_id: o for o in eng.run()}
+    assert by_id[r0].spec_proposed == 0
+    refs = {
+        rid: np.asarray(generate_cached(
+            params, jnp.asarray(p, jnp.int32)[None], cfg, 8,
+            jax.random.PRNGKey(0), temperature=0.0,
+        ))[0, len(p):].tolist()
+        for rid, p in ((r0, prompts[0]), (r1, prompts[1]))
+    }
+    assert by_id[r0].tokens == refs[r0]
+    assert by_id[r1].tokens == refs[r1]
+
+
+class TestCompileLadder:
+    def test_decode_compiles_stay_within_the_ladder(self):
+        """THE compile pin: spec/non-spec mixes, greedy and sampled
+        requests, and per-request draft lengths varying 0..k must add
+        NOTHING beyond the fixed ladder — decode 1 entry, the spec
+        rung at most its two accept variants — and a second wave of
+        different mixes compiles ZERO new programs."""
+        from differential_transformer_replication_tpu.analysis.sanitizers import (
+            RecompileSentinel,
+        )
+
+        # a PRIVATE config: the jitted closures are module-cached per
+        # (cfg, shapes), so sharing _setup's cfg with other tests
+        # would count their pool sizes as extra cache entries
+        cfg = _cfg("control", vocab=67)
+        params = init_model(jax.random.PRNGKey(0), cfg)
+        prompts = _prompts((3, 7, 5, 9, 4, 6), cfg.vocab_size, seed=5)
+        eng = ServingEngine(params, cfg, _spec_serving(num_slots=3))
+        # first wave: greedy spec + sampled spec + per-request caps
+        eng.generate(prompts[:2], max_new_tokens=8, temperature=0.0)
+        eng.generate(prompts[2:4], max_new_tokens=6, temperature=1.0,
+                     seed=3)
+        eng.generate([prompts[4]], max_new_tokens=6, temperature=0.0,
+                     draft_len=2)
+        stats = eng.compile_stats()
+        assert stats["decode"] == 1
+        assert stats["spec_decode"] <= 2  # greedy + sampled variants
+        # second wave, different mixes: zero new compiles
+        with RecompileSentinel(budget=0, name="spec-ladder-window"):
+            eng.generate([prompts[5]], max_new_tokens=5,
+                         temperature=0.0, draft_len=1)
+            eng.generate([prompts[0]], max_new_tokens=5,
+                         temperature=1.0, seed=9)
+        stats2 = eng.compile_stats()
+        assert stats2["decode"] == 1
+        assert stats2["spec_decode"] == stats["spec_decode"]
+
+    def test_restart_adds_zero_recompiles(self):
+        """A supervised crash-rebuild with spec on reuses every
+        module-cached closure — drafter pool included."""
+        from differential_transformer_replication_tpu.analysis.sanitizers import (
+            RecompileSentinel,
+        )
+
+        cfg, params = _setup("control")
+        prompts = _prompts((5, 8), cfg.vocab_size, seed=6)
+        eng = ServingEngine(
+            params, cfg, _spec_serving(spec_mode="model"),
+            spec_drafter=(params, cfg),
+        )
+        eng.generate(prompts, max_new_tokens=6, temperature=0.0)
+        with RecompileSentinel(budget=0, name="spec-restart-window"):
+            lost = eng.reset_after_crash()
+            assert lost == []
+            outs = eng.generate(prompts, max_new_tokens=6,
+                                temperature=0.0)
+        refs = [
+            np.asarray(generate_cached(
+                params, jnp.asarray(p, jnp.int32)[None], cfg, 6,
+                jax.random.PRNGKey(0), temperature=0.0,
+            ))[0, len(p):].tolist()
+            for p in prompts
+        ]
+        assert [o.tokens for o in outs] == refs
+
+
+class TestFaults:
+    def setup_method(self):
+        faults.reset()
+
+    def teardown_method(self):
+        faults.reset()
+
+    def test_drafter_crash_falls_back_never_garbage(self):
+        """spec_drafter_crash@N poisons the drafter pool: its
+        finite-logits guard trips, the pool rebuilds from params, the
+        engine decodes non-spec that iteration — output stays
+        bit-exact and the crash is counted."""
+        cfg, params = _setup("control")
+        prompts = _prompts(LENS, cfg.vocab_size)
+        faults.arm("spec_drafter_crash@2")
+        eng = ServingEngine(
+            params, cfg, _spec_serving(spec_mode="model"),
+            spec_drafter=(params, cfg),
+        )
+        outs = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+        refs = _ref_greedy_all("control", "xla", "auto", LENS, 8)
+        for o, r in zip(outs, refs):
+            assert o.tokens == r
+        st = eng.spec_stats()
+        assert st["drafter_crashes"] == 1
+        assert st["drafter"]["drafter_crashes_total"] == 1
+        # the drafter recovered: proposals resumed after the rebuild
+        assert st["proposed"] > 0
+
+    def test_reject_storm_degrades_to_non_spec(self):
+        """spec_reject_storm@A-B forces 0% acceptance through the
+        window: one token per slot per step (the non-spec floor),
+        outputs still bit-exact, proposals counted but none accepted."""
+        cfg, params = _setup("control")
+        prompts = _prompts(LENS, cfg.vocab_size)
+        faults.arm("spec_reject_storm@0-1000")
+        eng = ServingEngine(params, cfg, _spec_serving())
+        outs = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+        refs = _ref_greedy_all("control", "xla", "auto", LENS, 8)
+        for o, r in zip(outs, refs):
+            assert o.tokens == r
+        st = eng.spec_stats()
+        assert st["proposed"] > 0
+        assert st["accepted"] == 0
+
+    def test_storm_throughput_floor_is_one_token_per_step(self):
+        cfg, params = _setup("control")
+        faults.arm("spec_reject_storm@0-1000")
+        eng = ServingEngine(params, cfg, _spec_serving(num_slots=1))
+        eng.submit(_prompts((4,), cfg.vocab_size)[0], max_new_tokens=6,
+                   temperature=0.0)
+        it0 = eng.stats["iterations"]
+        eng.run()
+        # the first token rides the prefill chunk; the remaining 5 take
+        # >= 5 decode iterations — nothing speculative survived the storm
+        assert eng.stats["iterations"] - it0 >= 5
+
+
+class TestObservability:
+    def test_health_spec_snapshot_and_metrics(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _spec_serving())
+        eng.generate(_prompts((5, 7), cfg.vocab_size),
+                     max_new_tokens=8, temperature=0.0)
+        st = eng.spec_stats()
+        for key in ("mode", "verify", "draft_len", "proposed",
+                    "accepted", "acceptance_rate", "drafter_crashes",
+                    "drafter"):
+            assert key in st
+        body = eng.registry.render()
+        for needle in (
+            "serving_spec_proposed_tokens_total",
+            "serving_spec_accepted_tokens_total",
+            "serving_spec_acceptance_rate",
+            "serving_spec_draft_len",
+            'serving_spec_mode{mode="ngram"}',
+        ):
+            assert needle in body, needle
+
+    def test_non_spec_engine_reports_none(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, ServingConfig(num_slots=2))
+        assert eng.spec_stats() is None
+
+    def test_model_drafter_bytes_gauge(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(
+            params, cfg, _spec_serving(spec_mode="model"),
+            spec_drafter=(params, cfg),
+        )
+        body = eng.registry.render()
+        assert "serving_spec_drafter_kv_bytes" in body
+        assert eng._drafter.bytes_total() > 0
+
+
+class TestModelDrafterState:
+    def test_commit_rewinds_past_rejections(self):
+        cfg, params = _setup("control")
+        d = ModelDrafter(params, cfg, num_slots=1, rope_len=32)
+        toks = _prompts((6,), cfg.vocab_size)[0] + [1]
+        d.propose_all([DraftSlot(0, toks, 6, 3)])
+        assert d._next[0] == 9  # fed positions 6..8
+        d.commit(0, 7)  # only the first draft token accepted
+        assert d._next[0] == 7
+        d.release(0)
+        assert d._next[0] == 0
+
+    def test_poison_then_propose_rebuilds(self):
+        cfg, params = _setup("control")
+        d = ModelDrafter(params, cfg, num_slots=1, rope_len=32)
+        toks = _prompts((6,), cfg.vocab_size)[0] + [1]
+        d.poison()
+        assert d.propose_all([DraftSlot(0, toks, 6, 3)]) == {}
+        assert d.stats()["drafter_crashes_total"] == 1
+        # rebuilt: the very next round proposes again
+        out = d.propose_all([DraftSlot(0, toks, 6, 3)])
+        assert len(out.get(0, [])) == 3
+
+    def test_build_drafter_modes(self):
+        cfg, params = _setup("control")
+        assert build_drafter(ServingConfig(num_slots=2), cfg, 32) is None
+        ng = build_drafter(
+            ServingConfig(num_slots=2, spec_mode="ngram"), cfg, 32
+        )
+        assert isinstance(ng, NGramDrafter)
+        with pytest.raises(ValueError, match="spec_drafter_ckpt"):
+            build_drafter(
+                ServingConfig(num_slots=2, spec_mode="model"), cfg, 32
+            )
+
+
+class TestGL301CoversSpecDrafters:
+    """Mutation test for the drafters' lock discipline
+    (serving/spec.py): both drafters are lock-owning classes shared
+    between the engine thread and /health readers, so GL301 is the
+    machine check that their cursor/suffix-map/counter writes stay
+    under ``self._lock``. Planting exactly that bug — the commit-path
+    cursor write hoisted OUT of the lock — in the real module source
+    MUST fire; the unmutated module must stay clean."""
+
+    SPEC = (
+        REPO / "differential_transformer_replication_tpu" / "serving"
+        / "spec.py"
+    )
+    ANCHOR = (
+        "        with self._lock:\n"
+        "            self._next[index] = min(self._next[index], new_pos)"
+    )
+
+    def _copy(self, tmp_path, src):
+        # keep the serving/ path component: GL301 is a serving-dir rule
+        path = tmp_path / "serving" / "spec.py"
+        path.parent.mkdir(parents=True)
+        path.write_text(src)
+        return path
+
+    def _lint(self, path, rules):
+        sys.path.insert(0, str(REPO))
+        from differential_transformer_replication_tpu.analysis.lint import (
+            lint_paths,
+        )
+
+        return lint_paths([str(path)], rules=rules)
+
+    def test_unmutated_spec_is_lock_clean(self, tmp_path):
+        path = self._copy(tmp_path, self.SPEC.read_text())
+        result = self._lint(path, ["GL301", "GL601", "GL602"])
+        assert [f.rule for f in result.active] == []
+
+    def test_planted_off_lock_cursor_write_fires(self, tmp_path):
+        src = self.SPEC.read_text()
+        assert self.ANCHOR in src, (
+            "mutation anchor vanished — ModelDrafter.commit's lock "
+            "block moved; update the anchor so this mutation test "
+            "keeps guarding it"
+        )
+        mutated = src.replace(
+            self.ANCHOR,
+            "        self._crashes += 1  # planted: off-lock write\n"
+            + self.ANCHOR,
+        )
+        path = self._copy(tmp_path, mutated)
+        result = self._lint(path, ["GL301"])
+        assert [f.rule for f in result.active] == ["GL301"]
+        (finding,) = result.active
+        assert "_crashes" in finding.message
+
+    def test_planted_write_under_lock_stays_clean(self, tmp_path):
+        src = self.SPEC.read_text()
+        mutated = src.replace(
+            self.ANCHOR,
+            "        with self._lock:\n"
+            "            self._crashes += 0  # inside the lock: fine\n"
+            "            self._next[index] = min(self._next[index], "
+            "new_pos)",
+        )
+        path = self._copy(tmp_path, mutated)
+        result = self._lint(path, ["GL301"])
+        assert [f.rule for f in result.active] == []
+
+
+class TestTools:
+    def test_spec_sweep_smoke(self):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "spec_sweep.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=600,
+            env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+                 "HOME": "/tmp"},
+            cwd=str(REPO),
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln]
+        assert len(lines) >= 3
+        for ln in lines:
+            assert ln["metric"] == "spec_sweep_case"
+            if ln["spec_verify"] == "exact":
+                assert ln["greedy_token_match_rate"] == 1.0
+        assert any(ln["drafter"] == "self"
+                   and ln["acceptance_rate"] == 1.0 for ln in lines)
+
+    @pytest.mark.slow
+    def test_serve_bench_spec_smoke(self):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "serve_bench.py"),
+             "--smoke", "--spec", "ngram"],
+            capture_output=True, text=True, timeout=600,
+            env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+                 "HOME": "/tmp"},
+            cwd=str(REPO),
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        line = json.loads(r.stdout.strip().splitlines()[-1])
+        assert line["metric"] == "serving_spec_output_tokens_per_sec"
+        assert line["compiles_in_window"] == 0
+        assert line["greedy_token_match_rate"] == 1.0
+        assert line["spec_acceptance_rate"] > 0
+        assert line["spec_tok_per_s"] > 0
+        assert line["baseline_tok_per_s"] > 0
+
+
+class TestPagedSpecInterplay:
+    def test_paged_spec_releases_pages_and_caches_prefixes(self):
+        """Spec on the paged pool: retirement still donates prompt
+        pages to the radix cache, and a second request sharing the
+        prefix both hits the cache AND speculates — all pages
+        accounted."""
+        cfg, params = _setup("control")
+        serving = _spec_serving(kv_page_size=8, num_slots=2)
+        eng = ServingEngine(params, cfg, serving)
+        prompt = _prompts((12,), cfg.vocab_size)[0]
+        eng.generate([prompt], max_new_tokens=6, temperature=0.0)
+        st1 = eng.page_stats()
+        assert st1["cached"] > 0  # prompt pages donated
+        outs = eng.generate([prompt + [3]], max_new_tokens=6,
+                            temperature=0.0)
+        st2 = eng.page_stats()
+        assert st2["hits_total"] >= 1
+        assert outs[0].finish_reason == "length"
+        # pool fully released after retirement
+        assert st2["free"] + st2["cached"] == st2["total"]

@@ -435,6 +435,216 @@ def _run_shared_prefix(args, client, engine, serving, model_cfg,
     )
 
 
+def spec_workload(client, prompts, new_tokens, clients, seed,
+                  temperature):
+    """Closed-loop greedy/sampled workload driver shared by the spec
+    A/B (below) and tools/spec_sweep.py: N worker threads drain the
+    prompt list through ``client.generate``. Returns ``(wall seconds,
+    total output tokens, {prompt index: tokens})``."""
+    completed = {}
+    lock = threading.Lock()
+    next_idx = [0]
+
+    def worker():
+        while True:
+            with lock:
+                i = next_idx[0]
+                if i >= len(prompts):
+                    return
+                next_idx[0] += 1
+            out = client.generate(
+                prompts[i], max_new_tokens=new_tokens,
+                temperature=temperature, seed=seed + i, timeout=600,
+            )
+            with lock:
+                completed[i] = out.tokens
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    assert len(completed) == len(prompts), "requests went missing"
+    return wall, sum(len(t) for t in completed.values()), completed
+
+
+def _run_spec_ab(args, params, model_cfg, serving) -> None:
+    """``--spec MODE`` workload: the SAME closed-loop load run twice —
+    a non-spec baseline, then with speculative decoding — against
+    fresh engines at otherwise identical config, reported as one JSON
+    line (``spec_tok_per_s`` vs ``baseline_tok_per_s``, measured
+    ``spec_acceptance_rate``, ``spec_speedup``). Each arm runs the
+    workload ONCE unmeasured (compiling every shape the load can
+    produce — the jitted closures are module-cached, so they survive
+    the fresh measured engine) and then ONCE measured under the
+    RecompileSentinel: ``compiles_in_window`` is the spec arm's pin.
+    Greedy traffic (--temperature 0) keeps the spec arm bit-identical
+    to the baseline; the bench asserts that token-for-token."""
+    import jax  # noqa: F401  (engine stack below pulls it in anyway)
+
+    from differential_transformer_replication_tpu.analysis.sanitizers import (
+        RecompileSentinel,
+    )
+    from differential_transformer_replication_tpu.models.decode import (
+        kv_store_dtype,
+    )
+    from differential_transformer_replication_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    # keep the whole request in-window so drafts stay eligible
+    # (the verify block must not roll the ring)
+    max_prompt = min(args.max_prompt,
+                     model_cfg.block_size - args.new_tokens - 1)
+    min_prompt = max(1, min(args.min_prompt, max_prompt))
+    # templated-traffic profile: each prompt repeats a short random
+    # cycle — the repetitive structure (system prompts, code, JSON
+    # scaffolding) that speculative decoding exists to exploit and
+    # that greedy decoding then perpetuates. Both arms see the SAME
+    # prompts, so the A/B isolates the spec machinery.
+    prompts = []
+    for _ in range(args.requests):
+        n = int(rng.integers(min_prompt, max_prompt + 1))
+        period = int(rng.integers(2, min(5, n + 1)))
+        cyc = rng.integers(0, model_cfg.vocab_size, size=period).tolist()
+        prompts.append((cyc * (n // period + 1))[:n])
+
+    def _drafter():
+        if args.spec != "model":
+            return None
+        if not args.spec_drafter_ckpt:
+            raise SystemExit(
+                "--spec model needs --spec-drafter-ckpt (a checkpoint "
+                "dir, or the literal 'self')"
+            )
+        if args.spec_drafter_ckpt == "self":
+            return (params, model_cfg)
+        from differential_transformer_replication_tpu.train.checkpoint import (
+            load_params_for_inference,
+        )
+
+        d_params, d_cfg, _ = load_params_for_inference(
+            args.spec_drafter_ckpt
+        )
+        return (d_params, d_cfg)
+
+    def _workload(client):
+        return spec_workload(client, prompts, args.new_tokens,
+                             args.clients, args.seed, args.temperature)
+
+    def _arm(spec_on):
+        cfg_arm = serving.replace(
+            spec_mode=args.spec if spec_on else "",
+            spec_draft_len=args.spec_draft_len,
+            spec_verify=args.spec_verify,
+        )
+        drafter = _drafter() if spec_on else None
+        # unmeasured warm pass: compiles every shape this exact load
+        # produces (prefill ladder, both decode rungs, samplers);
+        # module-cached closures carry them to the measured engine
+        warm = ServingClient(ServingEngine(
+            params, model_cfg, cfg_arm, spec_drafter=drafter,
+        ))
+        _workload(warm)
+        warm.close()
+        engine = ServingEngine(
+            params, model_cfg, cfg_arm, spec_drafter=drafter,
+        )
+        client = ServingClient(engine)
+        sentinel = RecompileSentinel(
+            budget=(None if args.allow_recompiles < 0
+                    else args.allow_recompiles),
+            name=f"serve-bench-spec-{'on' if spec_on else 'off'}-window",
+        )
+        with sentinel:
+            wall, out_tokens, toks = _workload(client)
+        stats = engine.spec_stats() if spec_on else None
+        client.close()
+        return wall, out_tokens, toks, sentinel.count, stats
+
+    base_wall, base_tokens, base_toks, base_compiles, _ = _arm(False)
+    spec_wall, spec_tokens, spec_toks, spec_compiles, spec_stats = (
+        _arm(True)
+    )
+    match_rate = None
+    if args.temperature <= 0:
+        total = sum(len(t) for t in base_toks.values())
+        agree = sum(
+            1
+            for i, t in base_toks.items()
+            for a, b in zip(t, spec_toks.get(i, []))
+            if a == b
+        )
+        match_rate = agree / max(1, total)
+        if args.spec_verify == "exact":
+            # the exact verify mode is bit-identical BY CONSTRUCTION;
+            # batched mode only reports the rate (greedy near-ties may
+            # resolve differently at large contractions)
+            assert base_toks == spec_toks, (
+                "greedy spec output diverged from the non-spec "
+                "baseline under spec_verify=exact"
+            )
+    base_tps = base_tokens / base_wall
+    spec_tps = spec_tokens / spec_wall
+    line = {
+        "metric": "serving_spec_output_tokens_per_sec",
+        "value": round(spec_tps, 1),
+        "unit": "tokens/sec",
+        "spec_tok_per_s": round(spec_tps, 1),
+        "baseline_tok_per_s": round(base_tps, 1),
+        "spec_speedup": round(spec_tps / base_tps, 3) if base_tps else None,
+        "spec_acceptance_rate": (
+            spec_stats["acceptance_rate"] if spec_stats else None
+        ),
+        "spec_proposed": spec_stats["proposed"] if spec_stats else 0,
+        "spec_accepted": spec_stats["accepted"] if spec_stats else 0,
+        "spec_mode": args.spec,
+        "spec_verify": args.spec_verify,
+        "spec_draft_len": args.spec_draft_len,
+        "spec_drafter_ckpt": args.spec_drafter_ckpt,
+        "compiles_in_window": spec_compiles,
+        "baseline_compiles_in_window": base_compiles,
+        "greedy_token_match_rate": (
+            None if match_rate is None else round(match_rate, 5)
+        ),
+        "n_requests": len(prompts),
+        "output_tokens": spec_tokens,
+        "wall_s": round(spec_wall, 3),
+        "model": model_cfg.model,
+        "decode_attention_impl": (
+            serving.decode_attention_impl
+            or model_cfg.decode_attention_impl
+        ),
+        "kv_cache_dtype": kv_store_dtype(
+            model_cfg if not serving.kv_cache_dtype
+            else model_cfg.replace(kv_cache_dtype=serving.kv_cache_dtype)
+        ),
+        "kv_page_size": serving.kv_page_size,
+        "num_slots": serving.num_slots,
+        "clients": args.clients,
+        "new_tokens": args.new_tokens,
+        "temperature": args.temperature,
+        "prompt_len_range": [min_prompt, max_prompt],
+        "smoke": bool(args.smoke),
+    }
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    print(
+        f"[serve_bench] spec A/B ({args.spec}, k={args.spec_draft_len}) "
+        f"baseline={base_tps:.1f} tok/s spec={spec_tps:.1f} tok/s "
+        f"speedup={line['spec_speedup']}x "
+        f"acceptance={line['spec_acceptance_rate']} "
+        f"compiles={spec_compiles}",
+        file=sys.stderr,
+    )
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--smoke", action="store_true",
@@ -487,6 +697,38 @@ def main() -> None:
     p.add_argument("--prefix-cache-pages", type=int, default=0,
                    help="extra pool pages kept as cached-prefix "
                         "headroom")
+    p.add_argument("--spec", default=None, choices=("ngram", "model"),
+                   help="speculative-decoding A/B (serving/spec.py): "
+                        "run the SAME workload twice — non-spec "
+                        "baseline, then with this drafter — and "
+                        "report spec_acceptance_rate, spec_tok_per_s "
+                        "vs baseline_tok_per_s, spec_speedup, and "
+                        "compiles_in_window for the spec path, all in "
+                        "the one JSON line. In-process only. The "
+                        "n-gram drafter pays off on repetitive "
+                        "decoding (greedy --temperature 0); the model "
+                        "drafter wants a trained --spec-drafter-ckpt "
+                        "sharing the target's tokenizer")
+    p.add_argument("--spec-draft-len", type=int, default=4,
+                   help="draft tokens verified per slot per iteration "
+                        "(the compiled k rung)")
+    p.add_argument("--spec-drafter-ckpt", default=None,
+                   help="drafter checkpoint for --spec model, or the "
+                        "literal 'self' to draft with the target's "
+                        "own params (the acceptance~1 upper bound of "
+                        "the verify machinery)")
+    p.add_argument("--spec-verify", default="exact",
+                   choices=("exact", "batched"),
+                   help="verify-step formulation: 'exact' unrolls k+1 "
+                        "engine-native sub-steps (greedy bit-identical "
+                        "to non-spec at any size — asserted); "
+                        "'batched' streams each slot's KV once for "
+                        "all rows through the fused multi-query "
+                        "kernel (the TPU-bandwidth formulation; "
+                        "greedy ties may resolve differently at "
+                        "large sizes, so the A/B reports "
+                        "greedy_token_match_rate instead of "
+                        "asserting)")
     p.add_argument("--min-prompt", type=int, default=16)
     p.add_argument("--max-prompt", type=int, default=128)
     p.add_argument("--new-tokens", type=int, default=64)
@@ -550,6 +792,19 @@ def main() -> None:
             args.max_prompt, args.new_tokens = 4, 6
             if args.kv_page_size == 0:
                 args.kv_page_size = 8
+        if args.spec:
+            # spec smoke: greedy + a longer tail so the repetitive
+            # stretches the n-gram drafter feeds on actually develop,
+            # and short prompts so drafts stay in-window
+            args.block_size = 64
+            args.requests, args.clients = 8, 4
+            args.max_prompt, args.new_tokens = 10, 24
+            args.temperature = 0.0
+    if args.spec and (args.target or args.http):
+        raise SystemExit(
+            "--spec is an in-process A/B bench (it builds both engines "
+            "and reads the acceptance counters directly)"
+        )
     if args.shared_prefix:
         if args.target or args.http:
             raise SystemExit(
@@ -649,6 +904,10 @@ def main() -> None:
             os.path.join(args.trace_dir, "serve_bench.engine.trace.json"),
             process_name="serve-bench-engine",
         )
+    if args.spec:
+        _run_spec_ab(args, params, model_cfg, serving)
+        return
+
     engine = ServingEngine(params, model_cfg, serving, tracer=tracer)
     client = ServingClient(engine)
 
