@@ -321,6 +321,17 @@ class ServingConfig:
     # ties can resolve differently at scale (bit-identical at the
     # pinned test sizes; sampled distribution unchanged).
     spec_verify: str = "exact"
+    # Structured decoding (serving/constrain.py). Cap on the top-N
+    # alternatives a request may ask to echo per token
+    # (SamplingParams.logprobs) — N is baked into the jitted sampler's
+    # output packing, so the cap is the compile-time K and per-request
+    # values <= K ride as runtime truncation.
+    max_logprobs: int = 5
+    # Compiled-constraint cache capacity (distinct FSMs held,
+    # refcounted like radix prefixes; refcount-0 entries LRU-evict
+    # past this bound). Entries are host numpy tables — bytes show on
+    # /metrics as serving_constraint_cache_bytes.
+    constraint_cache_entries: int = 32
 
     def __post_init__(self):
         if self.decode_attention_impl not in ("", "xla", "pallas"):
@@ -386,6 +397,15 @@ class ServingConfig:
             raise ValueError(
                 "spec_verify must be 'exact'|'batched', got "
                 f"{self.spec_verify!r}"
+            )
+        if self.max_logprobs < 1:
+            raise ValueError(
+                f"max_logprobs must be >= 1, got {self.max_logprobs}"
+            )
+        if self.constraint_cache_entries < 1:
+            raise ValueError(
+                "constraint_cache_entries must be >= 1, got "
+                f"{self.constraint_cache_entries}"
             )
 
     def paged(self) -> bool:

@@ -55,6 +55,23 @@ def load_tokenizer(save_dir: str):
     )
 
 
+def vocab_strings(tok, vocab_size: int) -> List[str]:
+    """The id -> decoded-text table the constraint FSM compiler walks
+    (serving/constrain.py:build_token_fsm): entry i is exactly the
+    text token i contributes to decoded output (byte-level markers
+    resolved through the tokenizer's own decoder). Empty string — the
+    compiler's "never allowed" marker — for ids outside the
+    tokenizer's range (a padded model vocab) and for special tokens:
+    an FSM must never advance through EOT/PAD, and a constrained
+    request's EOS is compiled in separately on accepting states."""
+    n = tok.get_vocab_size()
+    specials = {tok.token_to_id(EOT), tok.token_to_id(PAD)}
+    return [
+        "" if (i >= n or i in specials) else tok.decode([i])
+        for i in range(vocab_size)
+    ]
+
+
 def encode_corpus(tokenizer, texts: Sequence[str]) -> np.ndarray:
     """Encode all texts, appending one EOT id after each document
     (train.py:167-170). Returns a flat int32 token array."""

@@ -103,6 +103,51 @@ def kv_store_dtype(cfg: ModelConfig) -> str:
     return cfg.compute_dtype
 
 
+def apply_logit_pipeline(logits: jnp.ndarray, allowed: jnp.ndarray,
+                         counts: jnp.ndarray, rep: jnp.ndarray,
+                         pres: jnp.ndarray,
+                         freq: jnp.ndarray) -> jnp.ndarray:
+    """The per-row logit-processor pipeline of the serving engine's
+    structured-decoding subsystem (serving/constrain.py): repetition /
+    presence / frequency penalties over the request's generated-token
+    histogram, then the constraint mask. ONE definition shared by the
+    L=1 pool sampler and the fused spec-verify accept step
+    (serving/engine.py) — the Leviathan accept/reject test preserves
+    the target distribution only if drafter proposals and verify rows
+    see IDENTICAL logit processing, and greedy constrained+spec
+    bit-parity needs the same argmax surface in both formulations.
+
+    ``logits`` (B, V) float; ``allowed`` (B, V) bool constraint mask
+    (all-ones for unconstrained rows); ``counts`` (B, V) int32
+    occurrence histogram of the row's generated tokens; ``rep`` /
+    ``pres`` / ``freq`` (B,) float penalties (1.0 / 0.0 / 0.0 = off).
+    Rows with every penalty off and an all-ones mask pass through
+    BIT-IDENTICAL (a ``where`` selects the raw logits), so the
+    pre-pipeline sampler's outputs — and every pinned bit-repro test —
+    are unchanged for unconstrained traffic. Applied BEFORE top-k and
+    temperature: the threshold and the draw both see the processed
+    surface.
+    """
+    seen = counts > 0
+    cf = counts.astype(logits.dtype)
+    # GPT-style repetition penalty: shrink positive logits, push
+    # negative ones further down, for every already-generated token
+    r = rep[:, None]
+    penalized = jnp.where(
+        seen,
+        jnp.where(logits > 0, logits / r, logits * r),
+        logits,
+    )
+    penalized = (
+        penalized
+        - pres[:, None] * seen.astype(logits.dtype)
+        - freq[:, None] * cf
+    )
+    inactive = (rep == 1.0) & (pres == 0.0) & (freq == 0.0)
+    x = jnp.where(inactive[:, None], logits, penalized)
+    return jnp.where(allowed, x, -jnp.inf)
+
+
 def init_cache(cfg: ModelConfig, batch_size: int) -> list:
     """Per-layer K/V buffers sized to ``block_size``, HEAD-MAJOR so the
     per-(slot, head) ring is contiguous — the fused decode kernel's

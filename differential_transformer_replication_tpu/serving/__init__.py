@@ -16,6 +16,10 @@ from typing import TYPE_CHECKING
 _EXPORTS = {
     "ServingEngine": "engine",
     "EngineCrashError": "engine",
+    "ConstraintCache": "constrain",
+    "ConstraintCompileError": "constrain",
+    "ConstraintDeadEndError": "constrain",
+    "TokenFsm": "constrain",
     "Request": "request",
     "RequestOutput": "request",
     "SamplingParams": "request",
@@ -41,6 +45,12 @@ _EXPORTS = {
 __all__ = sorted(_EXPORTS)
 
 if TYPE_CHECKING:  # static analyzers see the eager imports
+    from differential_transformer_replication_tpu.serving.constrain import (
+        ConstraintCache,
+        ConstraintCompileError,
+        ConstraintDeadEndError,
+        TokenFsm,
+    )
     from differential_transformer_replication_tpu.serving.engine import (
         EngineCrashError,
         ServingEngine,

@@ -70,6 +70,7 @@ from differential_transformer_replication_tpu.config import (
 )
 from differential_transformer_replication_tpu.models.decode import (
     KV_CACHE_BATCH_AXIS,
+    apply_logit_pipeline,
     copy_cache_pages,
     forward_chunk,
     forward_decode_pool,
@@ -92,6 +93,11 @@ from differential_transformer_replication_tpu.obs.trace import (
     TraceContext,
     child_span_args,
     instant_args,
+)
+from differential_transformer_replication_tpu.serving.constrain import (
+    ConstraintCache,
+    ConstraintCompileError,
+    spec_key,
 )
 from differential_transformer_replication_tpu.serving.pages import (
     PagePool,
@@ -186,9 +192,10 @@ class EngineCrashError(RuntimeError):
 
 @lru_cache(maxsize=None)
 def _build_step_fns(cfg: ModelConfig, rope_len: int,
-                    page_size: int = 0, num_pages: int = 0):
+                    page_size: int = 0, num_pages: int = 0,
+                    lp_k: int = 5):
     """Jitted (prefill, decode, sample, page_copy) closures for
-    (cfg, rope_len[, page geometry]).
+    (cfg, rope_len[, page geometry], logprob echo width).
 
     Cached at module level so engines with the same model/config share
     compile caches (and tests can count compiles across engine
@@ -327,38 +334,73 @@ def _build_step_fns(cfg: ModelConfig, rope_len: int,
         ]
         return logits[0, -1].astype(jnp.float32), new_cache
 
-    def _sample(bases, counts, logits, temperature, top_k):
-        """Batched per-request sampling over (B, V) fp32 logits.
+    def _sample(ints, logits, allowed, counts_v):
+        """Batched per-request sampling over (B, V) fp32 logits,
+        through the structured-decoding logit pipeline
+        (models/decode.py:``apply_logit_pipeline``).
 
-        bases (B, 2) uint32 + counts (B,): the t-th token's key is
-        fold_in(base, t). temperature/top_k are PER-ROW arrays;
-        semantics match sample_token row-for-row (<=0 temp = greedy,
-        top_k <= 0 = off, mask-below-kth-logit otherwise).
+        Every per-row scalar rides ONE packed (B, 8) int32 operand
+        (one host->device conversion per call): token count | top_k |
+        PRNG base (2 cols, bitcast uint32) | temperature | repetition
+        | presence | frequency penalties (bitcast f32). ``allowed``
+        (B, V) bool is the per-row constraint-FSM mask row and
+        ``counts_v`` (B, V) int32 the generated-token histogram — both
+        runtime arrays (the engine passes cached all-ones/zeros
+        constants when no active row needs the pipeline), so mixed
+        constrained/unconstrained traffic never recompiles. The t-th
+        token's key is fold_in(base, t); temperature/top-k semantics
+        match sample_token row-for-row (<=0 temp = greedy, top_k <= 0
+        = off, mask-below-kth-PROCESSED-logit otherwise). Rows with
+        the pipeline inert are BIT-IDENTICAL to the pre-pipeline
+        sampler (the pipeline's ``where`` passes raw logits through).
 
-        Also returns a per-row finiteness flag over the RAW logits
-        (before the intentional top-k -inf masking): a corrupt KV slot
-        or numerically diverged model yields NaN logits, and serving a
-        garbage argmax over them would be a silent wrong answer — the
-        engine turns a non-finite ACTIVE row into a typed
-        :class:`EngineCrashError` instead (inactive rows compute
-        garbage by design and are ignored host-side). The reduction
-        fuses into the sampling kernel; the extra transfer is (B,) bools.
+        Output is ONE packed (B, 3 + 2*lp_k) int32 array: token |
+        finite-ok | chosen-token logprob (bitcast f32) | top-lp_k ids
+        | top-lp_k logprobs (bitcast f32). Logprobs are over the
+        distribution actually sampled from — processed logits after
+        top-k, divided by the greedy-safe temperature. The finiteness
+        flag is over the RAW logits (before the intentional -inf
+        masking): a corrupt KV slot or numerically diverged model
+        yields NaN logits, and serving a garbage argmax over them
+        would be a silent wrong answer — the engine turns a non-finite
+        ACTIVE row into a typed :class:`EngineCrashError` instead
+        (inactive rows compute garbage by design and are ignored
+        host-side).
         """
+        counts = ints[:, 0]
+        top_k = ints[:, 1]
+        bases = jax.lax.bitcast_convert_type(ints[:, 2:4], jnp.uint32)
+        f = jax.lax.bitcast_convert_type(ints[:, 4:8], jnp.float32)
+        temperature = f[:, 0]
         keys = jax.vmap(jax.random.fold_in)(bases, counts)
+        proc = apply_logit_pipeline(
+            logits, allowed, counts_v, f[:, 1], f[:, 2], f[:, 3]
+        )
         V = logits.shape[-1]
         kth = jnp.clip(top_k - 1, 0, V - 1)
-        sorted_desc = -jnp.sort(-logits, axis=-1)
+        sorted_desc = -jnp.sort(-proc, axis=-1)
         thresh = jnp.take_along_axis(sorted_desc, kth[:, None], axis=-1)
         masked = jnp.where(
-            (top_k > 0)[:, None] & (logits < thresh), -jnp.inf, logits
+            (top_k > 0)[:, None] & (proc < thresh), -jnp.inf, proc
         )
         greedy = jnp.argmax(masked, axis=-1)
         safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+        scaled = masked / safe_t
         drawn = jax.vmap(lambda k, lg: jax.random.categorical(k, lg))(
-            keys, masked / safe_t
+            keys, scaled
         )
         tokens = jnp.where(temperature <= 0, greedy, drawn).astype(jnp.int32)
-        return tokens, jnp.isfinite(logits).all(axis=-1)
+        lp = jax.nn.log_softmax(scaled, axis=-1)
+        chosen = jnp.take_along_axis(lp, tokens[:, None], axis=-1)
+        top_lp, top_ids = jax.lax.top_k(lp, lp_k)
+        ok = jnp.isfinite(logits).all(axis=-1)
+        return jnp.concatenate([
+            tokens[:, None],
+            ok.astype(jnp.int32)[:, None],
+            jax.lax.bitcast_convert_type(chosen, jnp.int32),
+            top_ids.astype(jnp.int32),
+            jax.lax.bitcast_convert_type(top_lp, jnp.int32),
+        ], axis=1)
 
     # Donate the cache pool so XLA updates it in place instead of
     # allocating + copying a second full pool per chunk/step (the engine
@@ -390,7 +432,8 @@ _SPEC_ACCEPT_SALT = np.uint32(0x9E3779B9)
 @lru_cache(maxsize=None)
 def _build_spec_step_fns(cfg: ModelConfig, rope_len: int, draft_len: int,
                          sampled: bool = False, batched: bool = False,
-                         page_size: int = 0, num_pages: int = 0):
+                         page_size: int = 0, num_pages: int = 0,
+                         lp_k: int = 5):
     """ONE fused jitted verify step for (cfg, rope_len, k rung): the
     L = k+1-row pool forward (models/decode.py:``forward_decode_spec``
     or its paged twin), the per-row sampling transforms, and the
@@ -433,22 +476,48 @@ def _build_spec_step_fns(cfg: ModelConfig, rope_len: int, draft_len: int,
     L = k + 1
 
     def _accept(logits, draft, dlen, force_reject, bases, counts,
-                temps, topks):
+                temps, topks, rep, pres, freq, allowed, pcounts):
         B, _, V = logits.shape
+        # The logit pipeline (models/decode.py:apply_logit_pipeline),
+        # applied to EVERY verify row exactly as the L=1 sampler
+        # applies it to its one row — the parity that keeps
+        # constrained+spec distribution-preserving (Leviathan's test
+        # needs identical target processing) and greedy constrained
+        # spec bit-identical to non-spec. Row j's histogram counts the
+        # draft tokens before it (a cumsum of one-hots, in-kernel);
+        # row j's constraint mask is the FSM row for the state reached
+        # through drafts 0..j-1, built host-side (all-ones when
+        # unconstrained — the pipeline's where passes raw logits
+        # through bit-identically).
+        if k > 0:
+            oh = jax.nn.one_hot(draft, V, dtype=jnp.int32)
+            prefix = jnp.concatenate(
+                [jnp.zeros((B, 1, V), jnp.int32),
+                 jnp.cumsum(oh, axis=1)], axis=1,
+            )
+        else:
+            prefix = jnp.zeros((B, L, V), jnp.int32)
+        counts3 = pcounts[:, None] + prefix
+        proc = apply_logit_pipeline(
+            logits.reshape(B * L, V), allowed.reshape(B * L, V),
+            counts3.reshape(B * L, V),
+            jnp.repeat(rep, L), jnp.repeat(pres, L),
+            jnp.repeat(freq, L),
+        ).reshape(B, L, V)
         if sampled:
             kth = jnp.clip(topks - 1, 0, V - 1)
-            sorted_desc = -jnp.sort(-logits, axis=-1)
+            sorted_desc = -jnp.sort(-proc, axis=-1)
             thresh = jnp.take_along_axis(
                 sorted_desc,
                 jnp.broadcast_to(kth[:, None, None], (B, L, 1)),
                 axis=-1,
             )
             masked = jnp.where(
-                (topks > 0)[:, None, None] & (logits < thresh),
-                -jnp.inf, logits,
+                (topks > 0)[:, None, None] & (proc < thresh),
+                -jnp.inf, proc,
             )
         else:
-            masked = logits  # greedy: the mask cannot move an argmax
+            masked = proc  # greedy: the mask cannot move an argmax
         safe_t = jnp.where(temps > 0, temps, 1.0)
         if k > 0:
             j_idx = jnp.arange(k)[None, :]
@@ -521,18 +590,35 @@ def _build_spec_step_fns(cfg: ModelConfig, rope_len: int, draft_len: int,
         # draft length computed garbage by design)
         finite_rows = jnp.isfinite(logits).all(axis=-1)
         ok = (finite_rows | (jL > dlen[:, None])).all(axis=1)
-        return tokens_out, (a + 1).astype(jnp.int32), ok
+        # per-row logprob echo over the distribution each row's token
+        # came from (processed + top-k'd + temperature-scaled, same
+        # surface as the L=1 sampler). The greedy rung skips the top-k
+        # masking (it cannot move an argmax), so its echo ignores
+        # top_k — logprob comparisons on the greedy rung hold with
+        # top_k off (documented in the README runbook).
+        scaled = masked / safe_t[:, None, None]
+        lp = jax.nn.log_softmax(scaled, axis=-1)
+        chosen_lp = jnp.take_along_axis(
+            lp, tokens_out[..., None], axis=-1
+        )[..., 0]  # (B, L)
+        top_lp, top_ids = jax.lax.top_k(lp, lp_k)  # (B, L, lp_k)
+        return (tokens_out, (a + 1).astype(jnp.int32), ok,
+                chosen_lp, top_ids, top_lp)
 
-    # Every per-slot operand rides ONE packed (B, 3L+k+7) int32 array
-    # and every host-consumed result ONE stacked (B, L+2) int32 array:
-    # ten separate host->device conversions plus three device->host
-    # fetches per iteration were the dominant slice of the spec step's
-    # host overhead on CPU (measured ~1.4 ms/iteration — more than the
-    # whole fused device program). Column layout (static slices
-    # below): tokens | positions | write targets (cache row or
-    # physical page) | draft | dlen | counts | topks | PRNG base
-    # (2 cols, bitcast uint32) | temperature (bitcast f32) |
-    # force-reject flag.
+    # Every per-slot scalar operand rides ONE packed (B, 3L+k+10)
+    # int32 array and every host-consumed result ONE stacked
+    # (B, 2+2L+2L*lp_k) int32 array: ten separate host->device
+    # conversions plus three device->host fetches per iteration were
+    # the dominant slice of the spec step's host overhead on CPU
+    # (measured ~1.4 ms/iteration — more than the whole fused device
+    # program). Column layout (static slices below): tokens |
+    # positions | write targets (cache row or physical page) | draft |
+    # dlen | counts | topks | PRNG base (2 cols, bitcast uint32) |
+    # temperature (bitcast f32) | force-reject flag | repetition |
+    # presence | frequency penalties (bitcast f32). The constraint
+    # masks (B, L, V) and penalty histograms (B, V) are their own
+    # runtime-array operands (cached inert constants when no active
+    # slot needs the pipeline).
     def _unpack(ints):
         c = 3 * L + k
         tokens = ints[:, 0:L]
@@ -549,49 +635,71 @@ def _build_spec_step_fns(cfg: ModelConfig, rope_len: int, draft_len: int,
             ints[:, c + 5], jnp.float32
         )
         force_reject = ints[0, c + 6] > 0
+        pens = jax.lax.bitcast_convert_type(
+            ints[:, c + 7:c + 10], jnp.float32
+        )
         return (tokens, pos, targets, draft, dlen, counts, topks,
-                bases, temps, force_reject)
+                bases, temps, force_reject, pens)
 
-    def _pack_out(toks, n_emit, ok):
+    def _pack_out(toks, n_emit, ok, chosen_lp, top_ids, top_lp):
+        B = toks.shape[0]
         return jnp.concatenate(
-            [toks, n_emit[:, None], ok.astype(jnp.int32)[:, None]],
+            [toks, n_emit[:, None], ok.astype(jnp.int32)[:, None],
+             jax.lax.bitcast_convert_type(chosen_lp, jnp.int32),
+             top_ids.astype(jnp.int32).reshape(B, L * lp_k),
+             jax.lax.bitcast_convert_type(
+                 top_lp, jnp.int32
+             ).reshape(B, L * lp_k)],
             axis=1,
         )
 
     donate = jax.default_backend() != "cpu"
     if page_size > 0:
 
-        def _spec_step(params, ints, cache, page_tables):
+        def _spec_step(params, ints, cache, page_tables, allowed,
+                       pcounts):
             (tokens, pos, write_pages, draft, dlen, counts, topks,
-             bases, temps, force_reject) = _unpack(ints)
+             bases, temps, force_reject, pens) = _unpack(ints)
             logits, new_cache = forward_decode_spec_paged(
                 params, tokens, pos, cache, page_tables, write_pages,
                 cfg, rope_len=rope_len, batched=batched,
             )
-            toks, n_emit, ok = _accept(
+            out = _accept(
                 logits.astype(jnp.float32), draft, dlen, force_reject,
                 bases, counts, temps, topks,
+                pens[:, 0], pens[:, 1], pens[:, 2], allowed, pcounts,
             )
-            return _pack_out(toks, n_emit, ok), new_cache
+            return _pack_out(*out), new_cache
 
         return jax.jit(
             _spec_step, donate_argnums=(2,) if donate else ()
         )
 
-    def _spec_step(params, ints, cache):
+    def _spec_step(params, ints, cache, allowed, pcounts):
         (tokens, pos, row_target, draft, dlen, counts, topks,
-         bases, temps, force_reject) = _unpack(ints)
+         bases, temps, force_reject, pens) = _unpack(ints)
         logits, new_cache = forward_decode_spec(
             params, tokens, pos, cache, cfg, row_target,
             rope_len=rope_len, batched=batched,
         )
-        toks, n_emit, ok = _accept(
+        out = _accept(
             logits.astype(jnp.float32), draft, dlen, force_reject,
             bases, counts, temps, topks,
+            pens[:, 0], pens[:, 1], pens[:, 2], allowed, pcounts,
         )
-        return _pack_out(toks, n_emit, ok), new_cache
+        return _pack_out(*out), new_cache
 
     return jax.jit(_spec_step, donate_argnums=(2,) if donate else ())
+
+
+def _penalties_on(p) -> bool:
+    """Whether a request's SamplingParams engage the histogram side of
+    the logit pipeline (repetition/presence/frequency)."""
+    return (
+        p.repetition_penalty != 1.0
+        or p.presence_penalty != 0.0
+        or p.frequency_penalty != 0.0
+    )
 
 
 class ServingEngine:
@@ -606,7 +714,8 @@ class ServingEngine:
     def __init__(self, params: dict, cfg: ModelConfig,
                  serving: Optional[ServingConfig] = None,
                  registry: Optional[Registry] = None,
-                 tracer=None, spec_drafter=None):
+                 tracer=None, spec_drafter=None,
+                 vocab: Optional[Sequence[str]] = None):
         self.params = params
         self.serving = serving or ServingConfig()
         # serving-side overrides: serve a checkpoint with the fused
@@ -662,6 +771,23 @@ class ServingEngine:
             dw = getattr(self._drafter, "window", None)
             if dw is not None:
                 self._spec_window = min(self._spec_window, dw())
+        # structured decoding (serving/constrain.py): the engine-level
+        # compiled-constraint cache, the request_id -> (cache key,
+        # token FSM) map of in-flight constrained requests, and the
+        # id -> string vocabulary the compiler walks. lp_k is the
+        # compile-time logprob echo width — per-request logprobs <= lp_k
+        # ride as host-side truncation, never a new trace.
+        self._vocab = tuple(vocab) if vocab is not None else None
+        self._lp_k = min(self.serving.max_logprobs, cfg.vocab_size)
+        self._constraint_cache = ConstraintCache(
+            self.serving.constraint_cache_entries
+        )
+        self._constraints: dict = {}
+        # inert pipeline operands (all-ones masks / zero histograms) by
+        # batch shape, held as device constants so unconstrained
+        # traffic pays no per-step (B, V) host build or transfer
+        self._inert: dict = {}
+        if self._spec_k:
             # both accept variants of the k rung (greedy-specialized /
             # full sampled) — the step picks per iteration from the
             # active slots' temperatures; together with the L=1 step
@@ -676,6 +802,7 @@ class ServingEngine:
                     num_pages=(
                         self._pages.total_pages if self._paged else 0
                     ),
+                    lp_k=self._lp_k,
                 )
                 for s in (False, True)
             }
@@ -685,6 +812,7 @@ class ServingEngine:
             cfg, self.max_total,
             page_size=self.serving.kv_page_size if self._paged else 0,
             num_pages=self._pages.total_pages if self._paged else 0,
+            lp_k=self._lp_k,
         )
         self.cache = (
             init_cache_paged(cfg, self._pages.total_pages,
@@ -843,6 +971,31 @@ class ServingEngine:
                     "serving_spec_drafter_kv_bytes",
                     "HBM bytes held by the drafter's own KV slot pool.",
                 ).set(drafter_bytes())
+        # structured-decoding telemetry: in-flight constrained requests
+        # plus the compile cache's locked counters, mirrored into the
+        # registry on every gauge refresh (the page-pool pattern) —
+        # scraped at /metrics, aggregated fleet-wide, snapshotted into
+        # /health as "constraints"
+        self._constrained_gauge = self.registry.gauge(
+            "serving_constrained_requests_active",
+            "In-flight requests decoding under a compiled constraint.",
+        )
+        self._ccache_entries_gauge = self.registry.gauge(
+            "serving_constraint_cache_entries",
+            "Compiled constraint FSMs currently cached.",
+        )
+        self._ccache_bytes_gauge = self.registry.gauge(
+            "serving_constraint_cache_bytes",
+            "Host bytes held by cached constraint FSM tables.",
+        )
+        self._ccache_hits_counter = self.registry.counter(
+            "serving_constraint_cache_hits_total",
+            "Constraint compiles avoided by the FSM cache.",
+        )
+        self._ccache_misses_counter = self.registry.counter(
+            "serving_constraint_cache_misses_total",
+            "Constraint specs compiled from scratch.",
+        )
         # Continuous on-device profiling (obs/device_profile.py): every
         # profile_every engine iterations, wrap ONE iteration in a
         # jax.profiler capture, parse it off-loop, and publish device_*
@@ -925,18 +1078,48 @@ class ServingEngine:
                 )
                 err.retriable = False
                 raise err
+        # structured decoding: compile (or cache-hit) the constraint
+        # BEFORE the scheduler sees the request — a malformed spec
+        # fails typed (ConstraintCompileError -> HTTP 400) with the
+        # engine untouched: no queue entry, no key chain, no slot
+        ckey = None
+        cfsm = None
+        if req.params.constrained:
+            eos = (
+                req.params.eos_token_id
+                if req.params.eos_token_id is not None
+                else self.serving.eos_token_id
+            )
+            ckey = spec_key(req.params, eos)
+            if self._vocab is None:
+                self.stats.inc("rejected")
+                raise ConstraintCompileError(
+                    "constrained request but the engine was built "
+                    "without a vocabulary (pass vocab= — the id->string "
+                    "table the FSM compiler walks)"
+                )
+            try:
+                cfsm = self._constraint_cache.acquire(ckey, self._vocab)
+            except ConstraintCompileError:
+                self.stats.inc("rejected")
+                raise
         now = time.perf_counter()
         if deadline is None and self.serving.default_deadline_s > 0:
             deadline = now + self.serving.default_deadline_s
-        # admission bound first (scheduler.submit raises QueueFullError
+        # admission bound next (scheduler.submit raises QueueFullError
         # when the wait queue is at ServingConfig.max_queue_len) — a
-        # rejected request must leave no key-chain entry behind
+        # rejected request must leave no key-chain or constraint
+        # reference behind
         try:
             self.scheduler.submit(req, p, now, deadline or 0.0,
                                   trace=trace)
         except Exception:
+            if ckey is not None:
+                self._constraint_cache.release(ckey)
             self.stats.inc("rejected")
             raise
+        if ckey is not None:
+            self._constraints[rid] = (ckey, cfsm)
         self._base_keys[rid] = np.asarray(
             jax.random.PRNGKey(req.params.seed), np.uint32
         )
@@ -953,9 +1136,18 @@ class ServingEngine:
             return False
         self.scheduler.cancel(request_id)
         del self._base_keys[request_id]
+        self._drop_constraint(request_id)
         self.stats.inc("cancelled")
         self._finished_counter.inc(reason="cancelled")
         return True
+
+    def _drop_constraint(self, request_id: int) -> None:
+        """Release a request's compiled-FSM reference on EVERY path
+        that forgets its key chain (finish, cancel, shed, expire,
+        crash) — a leaked reference would pin the cache entry forever."""
+        ent = self._constraints.pop(request_id, None)
+        if ent is not None:
+            self._constraint_cache.release(ent[0])
 
     # -- one engine iteration -----------------------------------------
 
@@ -1032,6 +1224,41 @@ class ServingEngine:
             self._corrupt_cached_prefix()
 
         active = self.scheduler.active_slots()
+        if self._constraints:
+            if faults.constrain_dead_end_at(iteration):
+                # chaos hook: poison the first constrained ACTIVE
+                # slot's FSM cursor with the dead-end sentinel — the
+                # sweep below must retire it typed, never hang or emit
+                # a garbage token (the sweep runs BEFORE decode ever
+                # consumes the zeroed mask)
+                for s in active:
+                    if self._slot_fsm(s) is not None:
+                        s.fsm_state = -1
+                        break
+            swept = False
+            for s in active:
+                fsm = self._slot_fsm(s)
+                if fsm is None:
+                    continue
+                if s.fsm_state >= 0 and fsm.masks[s.fsm_state].any():
+                    continue
+                # all-zero mask row: nothing this slot could emit.
+                # Accepting state = the structure is complete and no
+                # EOS was configured — a normal typed completion.
+                # Non-accepting = a true dead end (compiled FSMs prune
+                # dead states, so only the fault sentinel reaches
+                # here) — typed retriable failure, partial output
+                # delivered, slot + pages reclaimed through the
+                # standard retire path.
+                swept = True
+                finished.append(self._finish(
+                    s,
+                    "constraint_complete"
+                    if fsm.is_accepting(s.fsm_state)
+                    else "constraint_dead_end",
+                ))
+            if swept:
+                active = self.scheduler.active_slots()
         proposals = {}
         if active and self._spec_k:
             proposals = self._collect_proposals(active, iteration)
@@ -1085,7 +1312,7 @@ class ServingEngine:
                         jnp.asarray(pos), jnp.asarray(mask), self.cache,
                     )
             with self.tracer.span("sample", iteration=iteration):
-                sampled, ok = self._sample_all_slots(logits)
+                sampled, ok, packed = self._sample_all_slots(logits)
             bad = [s for s in active if not ok[s.index]]
             if bad:
                 raise EngineCrashError(
@@ -1098,7 +1325,10 @@ class ServingEngine:
                 now = time.perf_counter()
                 self.stats.inc("decode_tokens", len(active))
                 for s in active:
-                    self._emit(s, int(sampled[s.index]), now, finished)
+                    self._emit(
+                        s, int(sampled[s.index]), now, finished,
+                        lp=self._lp_echo(s, packed[s.index]),
+                    )
 
         if capturing:
             # close the window (blocking on a cache leaf so the
@@ -1149,14 +1379,17 @@ class ServingEngine:
             if slot.filled == slot.prompt_len:
                 # prompt complete: the chunk's last-position logits give
                 # the first generated token (generate_cached's contract)
-                tok, ok = self._sample_rows([slot], logits[None])
+                tok, ok, packed = self._sample_rows([slot], logits[None])
                 if not ok[0]:
                     raise EngineCrashError(
                         f"non-finite logits prefilling slot {slot.index} "
                         f"(request {slot.request.request_id}): corrupt "
                         "slot pool or numerically diverged params"
                     )
-                self._emit(slot, int(tok[0]), time.perf_counter(), finished)
+                self._emit(
+                    slot, int(tok[0]), time.perf_counter(), finished,
+                    lp=self._lp_echo(slot, packed[0]),
+                )
 
     # -- speculative decoding (serving/spec.py) ------------------------
 
@@ -1175,6 +1408,7 @@ class ServingEngine:
         """
         from differential_transformer_replication_tpu.serving.spec import (
             DraftSlot,
+            constrain_proposals,
         )
 
         if faults.spec_drafter_crash_at(iteration):
@@ -1203,6 +1437,16 @@ class ServingEngine:
         if not infos:
             return {}
         props = self._drafter.propose_all(infos)
+        if props and self._constraints:
+            # drop draft suffixes the slot's FSM can never accept —
+            # the verify step would reject them row-for-row anyway
+            # (serving/spec.py:constrain_proposals)
+            fsms = {}
+            for s in active:
+                fsm = self._slot_fsm(s)
+                if fsm is not None:
+                    fsms[s.index] = (fsm, s.fsm_state)
+            props = constrain_proposals(props, fsms)
         if not props:
             # the no-proposal signature of a tripped drafter: check
             # (and mirror) the crash counter only on this path so the
@@ -1238,8 +1482,9 @@ class ServingEngine:
         # ONE packed int operand (see _build_spec_step_fns._unpack):
         # tokens | positions | write targets | draft | dlen | counts |
         # topks | PRNG base (bitcast) | temperature (bitcast) |
-        # force-reject — a single host->device conversion per step
-        ints = np.zeros((B, c + 7), np.int32)
+        # force-reject | penalties (bitcast) — a single host->device
+        # conversion per step
+        ints = np.zeros((B, c + 10), np.int32)
         tok_blk = ints[:, 0:L]
         pos_blk = ints[:, L:2 * L]
         targets = ints[:, 2 * L:3 * L]
@@ -1247,6 +1492,9 @@ class ServingEngine:
         bases = ints[:, c + 3:c + 5].view(np.uint32)
         temps = ints[:, c + 5].view(np.float32)
         temps[:] = 1.0
+        pens = ints[:, c + 7:c + 10].view(np.float32)
+        pens[:, 0] = 1.0  # repetition penalty (1 = off)
+        need_mask = need_counts = False
         if self._pages is not None:
             tables = self._pages.tables()
             ps = self.serving.kv_page_size
@@ -1270,6 +1518,13 @@ class ServingEngine:
             row[c + 2] = prm.top_k or 0  # topks
             bases[s.index] = self._base_keys[s.request.request_id]
             temps[s.index] = prm.temperature
+            pens[s.index, 0] = prm.repetition_penalty
+            pens[s.index, 1] = prm.presence_penalty
+            pens[s.index, 2] = prm.frequency_penalty
+            if self._slot_fsm(s) is not None:
+                need_mask = True
+            if _penalties_on(prm):
+                need_counts = True
             if self._pages is not None:
                 for j in range(dl + 1):
                     targets[s.index, j] = tables[
@@ -1279,6 +1534,33 @@ class ServingEngine:
                 targets[s.index, :dl + 1] = s.index
         dlen = ints[:, c]
         ints[0, c + 6] = int(faults.spec_reject_storm_at(iteration))
+        # the verify pipeline's mask/histogram operands: per verify
+        # row j, the FSM row for the state reached through drafts
+        # 0..j-1 (walked host-side — table lookups, no device work)
+        # and the PRE-BLOCK histogram (the kernel adds the in-block
+        # draft cumsum itself). Inert cached constants when no active
+        # slot engages the pipeline — the zero-recompile contract's
+        # operand side.
+        V = self.cfg.vocab_size
+        allowed3, pcounts = self._inert_ops(("spec", B), (B, L))
+        if need_mask:
+            am = np.ones((B, L, V), bool)
+            for s in active:
+                fsm = self._slot_fsm(s)
+                if fsm is None:
+                    continue
+                st = s.fsm_state
+                am[s.index, 0] = fsm.allowed_row(st)
+                for j, t in enumerate(proposals.get(s.index, [])):
+                    st = fsm.advance(st, int(t))
+                    am[s.index, j + 1] = fsm.allowed_row(st)
+            allowed3 = jnp.asarray(am)
+        if need_counts:
+            cm = np.zeros((B, V), np.int32)
+            for s in active:
+                if _penalties_on(s.request.params):
+                    cm[s.index] = self._slot_counts(s)
+            pcounts = jnp.asarray(cm)
         # accept-variant pick: all-greedy steps run the threefry-free
         # specialization (bit-identical on greedy rows)
         spec_fn = self._spec_fn[
@@ -1298,11 +1580,12 @@ class ServingEngine:
             if self._pages is not None:
                 out, self.cache = spec_fn(
                     self.params, jnp.asarray(ints), self.cache,
-                    jnp.asarray(tables),
+                    jnp.asarray(tables), allowed3, pcounts,
                 )
             else:
                 out, self.cache = spec_fn(
                     self.params, jnp.asarray(ints), self.cache,
+                    allowed3, pcounts,
                 )
         # one transfer for all three host-consumed outputs
         out = np.asarray(out)
@@ -1323,6 +1606,23 @@ class ServingEngine:
             for s in active:
                 dl = int(dlen[s.index])
                 n = int(n_emit[s.index])
+                if s.constraint is not None:
+                    # a constraint can CLOSE mid-verify-window: every
+                    # later row's mask is all-zero, so its "greedy
+                    # correction" is argmax(-inf) garbage. Truncate at
+                    # the first token produced by a zeroed row — the
+                    # next step's sweep retires the slot typed, exactly
+                    # like the non-spec path (which never consumes a
+                    # zero mask because the sweep runs before decode).
+                    st, keep = s.fsm_state, 0
+                    for j in range(n):
+                        if st < 0 or not s.constraint.masks[st].any():
+                            break
+                        st = s.constraint.advance(
+                            st, int(toks[s.index, j])
+                        )
+                        keep += 1
+                    n = keep
                 p0 = s.prompt_len + len(s.generated) - 1
                 if dl:
                     s.spec_proposed += dl
@@ -1335,9 +1635,12 @@ class ServingEngine:
                 self._drafter.commit(s.index, p0 + n)
                 for j in range(n):
                     emitted += 1
-                    self._emit(s, int(toks[s.index, j]), now, finished)
+                    self._emit(
+                        s, int(toks[s.index, j]), now, finished,
+                        lp=self._spec_lp_echo(s, out[s.index], j, L),
+                    )
                     if s.state == FREE:
-                        break  # EOS/length retired the slot mid-block
+                        break  # EOS/stop/length retired the slot mid-block
             self.stats.inc("decode_tokens", emitted)
 
     def spec_stats(self) -> Optional[dict]:
@@ -1371,6 +1674,14 @@ class ServingEngine:
         occupied = self.scheduler.occupied()
         self._slot_gauge.set(occupied)
         self._queue_gauge.set(self.scheduler.queue_len())
+        # structured-decoding mirror (BOTH cache layouts — keep it
+        # ahead of the paged early-return below)
+        self._constrained_gauge.set(len(self._constraints))
+        cst = self._constraint_cache.stats()
+        self._ccache_entries_gauge.set(cst["entries"])
+        self._ccache_bytes_gauge.set(cst["bytes"])
+        self._ccache_hits_counter.set(cst["hits_total"])
+        self._ccache_misses_counter.set(cst["misses_total"])
         if self._spec_accept_gauge is not None:
             proposed = self.stats["spec_proposed"]
             self._spec_accept_gauge.set(
@@ -1406,6 +1717,14 @@ class ServingEngine:
         contiguous path): total/free/cached pages plus the monotonic
         prefix-cache counters (serving/pages.py:PagePool.stats)."""
         return None if self._pages is None else self._pages.stats()
+
+    def constrain_stats(self) -> dict:
+        """Point-in-time structured-decoding snapshot for /health:
+        in-flight constrained requests plus the compile cache's locked
+        counters (serving/constrain.py:ConstraintCache.stats)."""
+        out = dict(self._constraint_cache.stats())
+        out["active"] = len(self._constraints)
+        return out
 
     def take_finished(self) -> List[RequestOutput]:
         """Outputs accumulated by a :meth:`step` that raised partway
@@ -1536,6 +1855,7 @@ class ServingEngine:
         the server maps to the 503 shed path — it never touches the
         device."""
         self._base_keys.pop(request.request_id, None)
+        self._drop_constraint(request.request_id)
         self.stats.inc("page_shed")
         self._finished_counter.inc(reason="page_exhausted")
         if self._tracing:
@@ -1601,53 +1921,163 @@ class ServingEngine:
 
     # -- internals ----------------------------------------------------
 
+    def _slot_fsm(self, s: Slot):
+        """The slot's compiled token FSM, attached lazily (admission
+        happens inside the scheduler, which knows nothing of
+        constraints; the engine-side map is keyed by request_id). None
+        for unconstrained requests."""
+        if s.constraint is None:
+            ent = self._constraints.get(s.request.request_id)
+            if ent is None:
+                return None
+            s.constraint = ent[1]
+            s.fsm_state = ent[1].start
+        return s.constraint
+
+    def _slot_counts(self, s: Slot) -> np.ndarray:
+        """The slot's generated-token histogram — built once at the
+        first penalized sample, then incremented per emitted token
+        (_emit); rebuilding the (V,) array per iteration would be the
+        exact host cost class the packed operands exist to avoid."""
+        if s.penalty_counts is None:
+            h = np.zeros((self.cfg.vocab_size,), np.int32)
+            for t in s.generated:
+                h[t] += 1
+            s.penalty_counts = h
+        return s.penalty_counts
+
+    def _inert_ops(self, key, shape):
+        """Cached all-ones mask + zero-histogram DEVICE constants for
+        a pipeline call with no constrained/penalized active rows:
+        the common case pays no per-step (B, V) host build or
+        transfer, and the pipeline's ``where`` passes raw logits
+        through bit-identically."""
+        ops = self._inert.get(key)
+        if ops is None:
+            V = self.cfg.vocab_size
+            ops = (
+                jnp.ones(shape + (V,), bool),
+                jnp.zeros((shape[0], V), jnp.int32),
+            )
+            self._inert[key] = ops
+        return ops
+
+    def _sample_operands(self, rows, B):
+        """Packed (B, 8) int32 sampler operand plus the pipeline's
+        allowed/counts arrays for a (row index, slot) assignment (see
+        _build_step_fns._sample for the column layout). Rows not named
+        keep inert defaults (temp 1, penalties off, mask all-ones)."""
+        ints = np.zeros((B, 8), np.int32)
+        f = ints[:, 4:8].view(np.float32)
+        f[:, 0] = 1.0  # temperature
+        f[:, 1] = 1.0  # repetition penalty (1 = off)
+        need_mask = need_counts = False
+        for i, s in rows:
+            p = s.request.params
+            ints[i, 0] = len(s.generated)
+            ints[i, 1] = p.top_k or 0
+            ints[i, 2:4].view(np.uint32)[:] = (
+                self._base_keys[s.request.request_id]
+            )
+            f[i, 0] = p.temperature
+            f[i, 1] = p.repetition_penalty
+            f[i, 2] = p.presence_penalty
+            f[i, 3] = p.frequency_penalty
+            if self._slot_fsm(s) is not None:
+                need_mask = True
+            if _penalties_on(p):
+                need_counts = True
+        allowed, counts = self._inert_ops(B, (B,))
+        if need_mask:
+            am = np.ones((B, self.cfg.vocab_size), bool)
+            for i, s in rows:
+                fsm = self._slot_fsm(s)
+                if fsm is not None:
+                    am[i] = fsm.allowed_row(s.fsm_state)
+            allowed = jnp.asarray(am)
+        if need_counts:
+            cm = np.zeros((B, self.cfg.vocab_size), np.int32)
+            for i, s in rows:
+                if _penalties_on(s.request.params):
+                    cm[i] = self._slot_counts(s)
+            counts = jnp.asarray(cm)
+        return ints, allowed, counts
+
     def _sample_rows(self, slots: List[Slot], logits):
-        """Sample one token for each given slot from (n, V) logits;
-        returns (tokens, finite-ok) per row."""
-        bases = jnp.asarray(
-            np.stack([
-                self._base_keys[s.request.request_id] for s in slots
-            ])
+        """Sample one token for each given slot from (n, V) logits
+        through the logit pipeline; returns (tokens, finite-ok,
+        packed echo rows) — the packed layout is
+        _build_step_fns._sample's output contract."""
+        ints, allowed, counts = self._sample_operands(
+            list(enumerate(slots)), len(slots)
         )
-        counts = jnp.asarray(
-            [len(s.generated) for s in slots], jnp.int32
-        )
-        temps = jnp.asarray(
-            [s.request.params.temperature for s in slots], jnp.float32
-        )
-        topks = jnp.asarray(
-            [(s.request.params.top_k or 0) for s in slots], jnp.int32
-        )
-        toks, ok = self._sample_fn(bases, counts, logits, temps, topks)
-        return np.asarray(toks), np.asarray(ok)
+        out = np.asarray(self._sample_fn(
+            jnp.asarray(ints), logits, allowed, counts
+        ))
+        return out[:, 0], out[:, 1].astype(bool), out
 
     def _sample_all_slots(self, logits):
         """Full-pool variant with inert defaults on non-active rows, so
         the decode-path sampler always sees the same (B, V) shape.
-        Returns (tokens, finite-ok); only ACTIVE rows' flags mean
+        Returns (tokens, finite-ok, packed); only ACTIVE rows mean
         anything (inactive rows compute garbage by design)."""
-        B = self._rows
-        bases = np.zeros((B, 2), np.uint32)
-        counts = np.zeros((B,), np.int32)
-        temps = np.ones((B,), np.float32)
-        topks = np.zeros((B,), np.int32)
-        for s in self.scheduler.active_slots():
-            p = s.request.params
-            bases[s.index] = self._base_keys[s.request.request_id]
-            counts[s.index] = len(s.generated)
-            temps[s.index] = p.temperature
-            topks[s.index] = p.top_k or 0
-        toks, ok = self._sample_fn(
-            jnp.asarray(bases), jnp.asarray(counts), logits,
-            jnp.asarray(temps), jnp.asarray(topks),
+        ints, allowed, counts = self._sample_operands(
+            [(s.index, s) for s in self.scheduler.active_slots()],
+            self._rows,
         )
-        return np.asarray(toks), np.asarray(ok)
+        out = np.asarray(self._sample_fn(
+            jnp.asarray(ints), logits, allowed, counts
+        ))
+        return out[:, 0], out[:, 1].astype(bool), out
+
+    def _lp_echo(self, s: Slot, row: np.ndarray):
+        """Decode one sampler echo row into the (chosen logprob,
+        [(token id, logprob)] top list) pair _emit accumulates — None
+        when the request asked for none (``params.logprobs == 0``).
+        Per-request widths <= the compiled lp_k are host-side
+        truncation, never a new trace."""
+        n = s.request.params.logprobs
+        if not n:
+            return None
+        K = self._lp_k
+        chosen = float(row[2:3].view(np.float32)[0])
+        k = min(n, K)
+        ids = row[3:3 + k]
+        lps = row[3 + K:3 + K + k].view(np.float32)
+        return chosen, [
+            (int(i), float(v)) for i, v in zip(ids, lps)
+        ]
+
+    def _spec_lp_echo(self, s: Slot, row: np.ndarray, j: int, L: int):
+        """Per-row logprob echo from the spec verify step's packed
+        output (see _build_spec_step_fns._pack_out): verify row j's
+        chosen-token logprob + top list for the same request surface
+        as :meth:`_lp_echo`."""
+        n = s.request.params.logprobs
+        if not n:
+            return None
+        K = self._lp_k
+        base = L + 2
+        chosen = float(row[base + j:base + j + 1].view(np.float32)[0])
+        k = min(n, K)
+        o = base + L + j * K
+        ids = row[o:o + k]
+        lps = row[o + L * K:o + L * K + k].view(np.float32)
+        return chosen, [
+            (int(i), float(v)) for i, v in zip(ids, lps)
+        ]
 
     def _emit(self, slot: Slot, token: int, now: float,
-              finished: List[RequestOutput]) -> None:
+              finished: List[RequestOutput], lp=None) -> None:
         prev_token_t = slot.token_times[-1] if slot.token_times else None
         slot.generated.append(token)
         slot.token_times.append(now)
+        if lp is not None:
+            if slot.token_logprobs is None:
+                slot.token_logprobs = []
+                slot.top_logprobs = []
+            slot.token_logprobs.append(lp[0])
+            slot.top_logprobs.append(lp[1])
         if len(slot.generated) == 1:
             slot.first_token_time = now
             slot.state = ACTIVE
@@ -1667,10 +2097,29 @@ class ServingEngine:
             else self.serving.eos_token_id
         )
         hit_eos = eos is not None and token == eos
-        if hit_eos or len(slot.generated) >= p.max_new_tokens:
-            finished.append(
-                self._finish(slot, "eos" if hit_eos else "length")
-            )
+        stop_hit = False
+        if not hit_eos and p.stop:
+            g = slot.generated
+            for seq in p.stop:
+                n = len(seq)
+                if len(g) >= n and tuple(g[-n:]) == seq:
+                    stop_hit = True
+                    break
+        if hit_eos or stop_hit or len(slot.generated) >= p.max_new_tokens:
+            finished.append(self._finish(
+                slot,
+                "eos" if hit_eos
+                else ("stop_sequence" if stop_hit else "length"),
+            ))
+            return
+        # the slot decodes on: keep its pipeline state current. The
+        # histogram only exists once a penalized sample built it; the
+        # FSM cursor follows every emitted token (the next step's mask
+        # row — and the zero-row sweep — read it).
+        if slot.penalty_counts is not None:
+            slot.penalty_counts[token] += 1
+        if slot.constraint is not None:
+            slot.fsm_state = slot.constraint.advance(slot.fsm_state, token)
 
     def _finish(self, slot: Slot, reason: str,
                 now: Optional[float] = None) -> RequestOutput:
@@ -1693,6 +2142,14 @@ class ServingEngine:
             ),
             spec_proposed=slot.spec_proposed,
             spec_accepted=slot.spec_accepted,
+            token_logprobs=(
+                list(slot.token_logprobs)
+                if slot.token_logprobs is not None else None
+            ),
+            top_logprobs=(
+                list(slot.top_logprobs)
+                if slot.top_logprobs is not None else None
+            ),
         )
         if self._tracing:
             targs = (
@@ -1714,9 +2171,13 @@ class ServingEngine:
                 tokens=len(out.tokens), **sargs,
             )
         del self._base_keys[slot.request.request_id]
+        self._drop_constraint(slot.request.request_id)
         if reason == "deadline":
             self.stats.inc("deadline_expired")
-        else:
+        elif reason != "constraint_dead_end":
+            # a dead end is a typed FAILURE delivery (HTTP 400 with
+            # partial output), not a completion — it rides only the
+            # labeled finished counter
             self.stats.inc("completed")
         self._finished_counter.inc(reason=reason)
         self.scheduler.retire(slot)
@@ -1727,6 +2188,7 @@ class ServingEngine:
         """A request whose deadline passed while it waited for a slot:
         it never touches the device; the caller gets a typed error."""
         self._base_keys.pop(request.request_id, None)
+        self._drop_constraint(request.request_id)
         self.stats.inc("deadline_expired")
         self._finished_counter.inc(reason="deadline")
         if self._tracing:
@@ -1818,6 +2280,7 @@ class ServingEngine:
                 rid = slot.request.request_id
                 lost.append(rid)
                 self._base_keys.pop(rid, None)
+                self._drop_constraint(rid)
         preserved = list(self.scheduler.queue)
         if self._paged:
             # fresh page pool AND an empty radix cache: untrusted KV

@@ -24,7 +24,9 @@ class SamplingParams:
 
     Defaults reproduce the reference generation contract: temperature 1,
     no top-k (control.py:168-169). ``temperature <= 0`` means greedy
-    argmax; ``top_k`` None/<=0 means off.
+    argmax; ``top_k`` None/0 means off (negative is rejected — it used
+    to slip through silently and explode inside the batched sampler).
+    The full field table lives in README.md ("Structured decoding").
     """
 
     max_new_tokens: int = 16
@@ -41,6 +43,31 @@ class SamplingParams:
     # compiled draft ladder clamp to it — per-request draft lengths
     # ride the jitted verify step as runtime arrays, never recompiling.
     draft_len: Optional[int] = None
+    # ---- structured decoding (serving/constrain.py) -----------------
+    # At most ONE of json_schema / regex / choices may be set. Each is
+    # compiled once into a token-level FSM (cached/refcounted across
+    # requests) whose per-state masks ride the jitted pool step as
+    # runtime arrays — constrained traffic never recompiles.
+    json_schema: Optional[str] = None  # JSON text of the schema
+    regex: Optional[str] = None
+    choices: Optional[tuple] = None  # tuple of candidate strings
+    # ---- logit pipeline ---------------------------------------------
+    # repetition_penalty: >1 divides positive / multiplies negative
+    # logits of already-generated tokens (1.0 = off); presence/
+    # frequency subtract flat / count-proportional penalties
+    # (0.0 = off). Applied BEFORE the constraint mask and top-k.
+    repetition_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    # Multi-token stop sequences: tuple of token-id tuples. Generation
+    # finishes with finish_reason="stop_sequence" when the generated
+    # tail matches any sequence (match included in the output, like
+    # eos). Host-side suffix check — never touches the jitted step.
+    stop: Optional[tuple] = None
+    # Echo per-token logprobs: 0 = off; N>0 returns the chosen token's
+    # logprob plus the top-N (id, logprob) alternatives per emitted
+    # token, capped by ServingConfig.max_logprobs.
+    logprobs: int = 0
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -53,6 +80,10 @@ class SamplingParams:
         # sampler — on the engine thread, wedging the whole server
         if self.top_k is not None and not isinstance(self.top_k, int):
             raise ValueError(f"top_k must be an int or None, got {self.top_k!r}")
+        if self.top_k is not None and self.top_k < 0:
+            raise ValueError(
+                f"top_k must be >= 0 (0/None = off), got {self.top_k}"
+            )
         if self.eos_token_id is not None and not isinstance(
             self.eos_token_id, int
         ):
@@ -70,6 +101,81 @@ class SamplingParams:
                 f"draft_len must be a non-negative int or None, got "
                 f"{self.draft_len!r}"
             )
+        constraints = [
+            k for k in ("json_schema", "regex", "choices")
+            if getattr(self, k) is not None
+        ]
+        if len(constraints) > 1:
+            raise ValueError(
+                "at most one of json_schema/regex/choices may be set, "
+                f"got {constraints}"
+            )
+        if self.json_schema is not None and not isinstance(
+            self.json_schema, str
+        ):
+            raise ValueError(
+                f"json_schema must be a JSON string, got "
+                f"{self.json_schema!r}"
+            )
+        if self.regex is not None and not isinstance(self.regex, str):
+            raise ValueError(f"regex must be a string, got {self.regex!r}")
+        if self.choices is not None:
+            # normalize list -> tuple so the frozen dataclass stays
+            # hashable and the constraint-cache key is canonical
+            if isinstance(self.choices, list):
+                object.__setattr__(self, "choices", tuple(self.choices))
+            if (
+                not isinstance(self.choices, tuple)
+                or not self.choices
+                or not all(isinstance(c, str) and c for c in self.choices)
+            ):
+                raise ValueError(
+                    "choices must be a non-empty sequence of non-empty "
+                    f"strings, got {self.choices!r}"
+                )
+        for name in ("repetition_penalty", "presence_penalty",
+                     "frequency_penalty"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)):
+                raise ValueError(f"{name} must be a number, got {v!r}")
+        if self.repetition_penalty <= 0:
+            raise ValueError(
+                "repetition_penalty must be > 0 (1.0 = off), got "
+                f"{self.repetition_penalty}"
+            )
+        if self.stop is not None:
+            if isinstance(self.stop, list):
+                object.__setattr__(
+                    self, "stop",
+                    tuple(tuple(int(t) for t in s) for s in self.stop),
+                )
+            if (
+                not isinstance(self.stop, tuple)
+                or not self.stop
+                or not all(
+                    isinstance(s, tuple) and s
+                    and all(isinstance(t, int) for t in s)
+                    for s in self.stop
+                )
+            ):
+                raise ValueError(
+                    "stop must be a non-empty sequence of non-empty "
+                    f"token-id sequences, got {self.stop!r}"
+                )
+        if not isinstance(self.logprobs, int) or self.logprobs < 0:
+            raise ValueError(
+                f"logprobs must be a non-negative int, got "
+                f"{self.logprobs!r}"
+            )
+
+    @property
+    def constrained(self) -> bool:
+        """Whether any structured-decoding constraint is set."""
+        return (
+            self.json_schema is not None
+            or self.regex is not None
+            or self.choices is not None
+        )
 
 
 @dataclass(frozen=True)
@@ -108,7 +214,9 @@ class RequestOutput:
     request_id: int
     prompt: List[int]
     tokens: List[int]
-    finish_reason: str  # "length" | "eos"
+    # "length" | "eos" | "stop_sequence" | "constraint_complete" |
+    # "constraint_dead_end" | "deadline" | "page_exhausted"
+    finish_reason: str
     submit_time: float = 0.0
     first_token_time: float = 0.0
     finish_time: float = 0.0
@@ -125,6 +233,13 @@ class RequestOutput:
     # when speculation was off (or never engaged) for this request.
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # logprob echo (params.logprobs > 0): per generated token the
+    # chosen token's logprob, and the top-N (token_id, logprob)
+    # alternatives — both computed on the PROCESSED logits (penalties
+    # + constraint mask applied), i.e. the distribution actually
+    # sampled from. None when the request did not ask for logprobs.
+    token_logprobs: Optional[List[float]] = None
+    top_logprobs: Optional[List[List[tuple]]] = None
 
     @property
     def ttft(self) -> float:

@@ -96,6 +96,23 @@ class Slot:
     # the numpy prompt every decode iteration was measurable hot-loop
     # host cost
     prompt_ids: Optional[list] = None
+    # structured decoding (serving/constrain.py): the compiled token
+    # FSM (attached lazily by the engine on first hot-path touch, so
+    # unconstrained slots never pay the cache lookup) and the cursor
+    # into its state table, advanced host-side per emitted token.
+    # fsm_state -1 is the dead-end sentinel (all-zero mask row) — only
+    # the constrain_dead_end fault plants it; compiled FSMs prune dead
+    # states so natural generation cannot reach one.
+    constraint: Optional[object] = None
+    fsm_state: int = 0
+    # generated-token occurrence counts for the repetition/presence/
+    # frequency penalties — a (V,) int32 histogram, allocated lazily
+    # (None for requests with every penalty off)
+    penalty_counts: Optional[np.ndarray] = None
+    # logprob echo accumulators (SamplingParams.logprobs > 0): chosen
+    # token's logprob and top-N (id, logprob) pairs per emitted token
+    token_logprobs: Optional[list] = None
+    top_logprobs: Optional[list] = None
 
     @property
     def prompt_len(self) -> int:
@@ -117,6 +134,11 @@ class Slot:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.prompt_ids = None
+        self.constraint = None
+        self.fsm_state = 0
+        self.penalty_counts = None
+        self.token_logprobs = None
+        self.top_logprobs = None
 
 
 def _pow2_chunk(n: int, cap: int) -> int:
@@ -271,6 +293,11 @@ class Scheduler:
             slot.spec_proposed = 0
             slot.spec_accepted = 0
             slot.prompt_ids = None
+            slot.constraint = None
+            slot.fsm_state = 0
+            slot.penalty_counts = None
+            slot.token_logprobs = None
+            slot.top_logprobs = None
             slot.submit_time = t_submit
             slot.deadline = deadline
             slot.trace = trace

@@ -49,6 +49,10 @@ from differential_transformer_replication_tpu.obs.registry import (
 from differential_transformer_replication_tpu.obs.trace import (
     from_payload as trace_from_payload,
 )
+from differential_transformer_replication_tpu.serving.constrain import (
+    ConstraintCompileError,
+    ConstraintDeadEndError,
+)
 from differential_transformer_replication_tpu.serving.engine import (
     EngineCrashError,
     ServingEngine,
@@ -366,6 +370,15 @@ class EngineRunner:
                 )
                 err.output = out
                 self._settle(pending, error=err)
+            elif out.finish_reason == "constraint_dead_end":
+                # typed retriable failure with the partial output
+                # attached — the HTTP layer maps it to 400
+                # "constraint_dead_end" (serving/constrain.py)
+                self._settle(pending, error=ConstraintDeadEndError(
+                    f"request {out.request_id} hit a constraint dead "
+                    f"end after {len(out.tokens)} generated tokens",
+                    output=out,
+                ))
             else:
                 self._settle(pending, result=out)
 
@@ -703,6 +716,14 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                     spec = spec_stats()
                     if spec is not None:
                         payload["spec"] = spec
+                # structured-decoding snapshot (serving/constrain.py):
+                # in-flight constrained requests + compile-cache
+                # entries/bytes/hit/miss/eviction counters
+                constrain_stats = getattr(
+                    client.runner.engine, "constrain_stats", None
+                )
+                if constrain_stats is not None:
+                    payload["constraints"] = constrain_stats()
                 self._reply(200, payload)
             elif self.path == "/ready":
                 if client.runner.accepting():
@@ -755,12 +776,38 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                     raise ValueError("prompt_ids (or prompt) required")
                 top_k = req.get("top_k")
                 eos = req.get("eos_token_id")
+                choices = req.get("choices")
+                stop = req.get("stop")
+                # json_schema arrives as a JSON VALUE (object) or a
+                # pre-encoded string; SamplingParams wants the string
+                schema = req.get("json_schema")
+                if schema is not None and not isinstance(schema, str):
+                    schema = json.dumps(schema)
                 params = SamplingParams(
                     max_new_tokens=int(req.get("max_new_tokens", 16)),
                     temperature=float(req.get("temperature", 1.0)),
                     top_k=None if top_k is None else int(top_k),
                     seed=int(req.get("seed", 0)),
                     eos_token_id=None if eos is None else int(eos),
+                    json_schema=schema,
+                    regex=req.get("regex"),
+                    choices=choices,
+                    repetition_penalty=float(
+                        req.get("repetition_penalty", 1.0)
+                    ),
+                    presence_penalty=float(
+                        req.get("presence_penalty", 0.0)
+                    ),
+                    frequency_penalty=float(
+                        req.get("frequency_penalty", 0.0)
+                    ),
+                    stop=(
+                        None if stop is None
+                        else tuple(
+                            tuple(int(t) for t in seq) for seq in stop
+                        )
+                    ),
+                    logprobs=int(req.get("logprobs", 0)),
                 )
                 deadline_s = req.get("deadline_s")
                 # "received", not "admitted": a QueueFullError /
@@ -778,8 +825,29 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                     ),
                     trace=ctx,
                 )
+            except ConstraintCompileError as e:
+                # must precede the ValueError branch (it IS one): a
+                # malformed/unsupported constraint spec fails typed at
+                # submit with the engine untouched — a distinct code so
+                # clients can tell "fix your schema" from "bad request"
+                _fail(400, {"error": str(e),
+                            "code": "constraint_compile_failed"})
+                return
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 _fail(400, {"error": str(e), "code": "bad_request"})
+                return
+            except ConstraintDeadEndError as e:
+                # the constraint FSM hit an all-zero mask mid-decode:
+                # typed 400 with the partial output — retriable per the
+                # error's flag, but a retry of the SAME spec dead-ends
+                # again unless the fault was injected chaos
+                _fail(400, {
+                    "error": str(e),
+                    "code": "constraint_dead_end",
+                    "partial_tokens": (
+                        e.output.tokens if e.output is not None else []
+                    ),
+                })
                 return
             except QueueFullError as e:
                 # overload: reject fast with the retryable status so
@@ -861,6 +929,12 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                 "ttft_ms": round(out.ttft * 1e3, 3),
                 "trace_id": out.trace_id or ctx.trace_id,
             }
+            if out.token_logprobs is not None:
+                payload["token_logprobs"] = out.token_logprobs
+                payload["top_logprobs"] = [
+                    [[tid, lp] for tid, lp in row]
+                    for row in out.top_logprobs
+                ]
             if tokenizer is not None:
                 payload["text"] = tokenizer.decode(out.tokens)
             events.emit("request_finished",
@@ -1067,13 +1141,23 @@ def main() -> None:
         print("[serve] no checkpoint given: random-init demo model")
 
     tokenizer = None
+    # id -> decoded-string table for the constraint FSM compiler
+    # (serving/constrain.py). Without a tokenizer the demo model maps
+    # printable-ASCII ids to their characters so constrained requests
+    # still work against the random-init model ("" = never allowed).
+    vocab = [
+        chr(i) if 32 <= i < 127 else ""
+        for i in range(model_cfg.vocab_size)
+    ]
     if args.tokenizer:
         from differential_transformer_replication_tpu.data.tokenizer import (
             check_tokenizer_matches,
             load_tokenizer,
+            vocab_strings,
         )
 
         tokenizer = load_tokenizer(args.tokenizer)
+        vocab = vocab_strings(tokenizer, model_cfg.vocab_size)
         if meta is not None:
             # refuse to serve text through a tokenizer that cannot belong
             # to the checkpoint (same guard as sample.py — a clobbered
@@ -1136,7 +1220,7 @@ def main() -> None:
 
         events = EventLog(args.event_log, process="replica")
     engine = ServingEngine(params, model_cfg, serving, tracer=tracer,
-                           spec_drafter=spec_drafter)
+                           spec_drafter=spec_drafter, vocab=vocab)
     client = ServingClient(engine)
 
     # process identity on /metrics: lets the router's aggregated

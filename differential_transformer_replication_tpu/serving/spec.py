@@ -488,3 +488,31 @@ def build_drafter(serving, target_cfg, rope_len: int,
         prefill_chunk=serving.prefill_chunk,
         draft_len=serving.spec_draft_len,
     )
+
+
+def constrain_proposals(props: Dict[int, List[int]],
+                        fsms: Dict[int, tuple]) -> Dict[int, List[int]]:
+    """Truncate drafter proposals at the first token a slot's
+    constraint FSM disallows (serving/constrain.py:TokenFsm).
+
+    ``fsms`` maps slot index -> (fsm, current state) for constrained
+    slots; unconstrained slots pass through untouched. A draft the FSM
+    rejects outright is dropped (the slot rides the verify step with
+    draft length 0 — a runtime array, no recompile). Truncation is an
+    OPTIMIZATION, not a correctness requirement: the verify step's
+    accept compares each draft token against the argmax/draw of the
+    constraint-MASKED target logits, so a disallowed draft token is
+    always rejected anyway — pre-truncating just stops the drafter
+    from burning verify rows it can never win (Leviathan's
+    distribution-preservation is untouched either way)."""
+    if not fsms:
+        return props
+    out: Dict[int, List[int]] = {}
+    for i, toks in props.items():
+        ent = fsms.get(i)
+        if ent is not None:
+            fsm, state = ent
+            toks = toks[:fsm.prefix_len(toks, state=state)]
+        if toks:
+            out[i] = toks
+    return out

@@ -106,6 +106,29 @@ serving/server.py):
                         degrade gracefully to ~non-spec — one emitted
                         token per slot per step, outputs still exact.
                         NOT one-shot: a range is a storm window.
+  ``constrain_dead_end@N``
+                        poison one constrained ACTIVE slot's FSM
+                        cursor with the dead-end sentinel before
+                        engine iteration N's decode: every token is
+                        masked out, and the engine must retire the
+                        request TYPED (finish_reason
+                        "constraint_dead_end", partial output
+                        delivered, slot + pages reclaimed) — never
+                        hang, never emit a garbage token. One-shot.
+                        Compiled FSMs prune dead states (Willard &
+                        Louf), so only this fault reaches the
+                        non-accepting zero-mask sweep.
+
+Constraint fault points (call-point style — ``@N`` counts CALLS):
+
+  ``constrain_compile_fail`` / ``constrain_compile_fail@N``
+                        fail the Nth upcoming constraint FSM compile
+                        (serving/constrain.py:compile_constraint)
+                        with the typed ConstraintCompileError: the
+                        submit path must reject the request (HTTP
+                        400 "constraint_compile_failed") with the
+                        engine untouched — no queue entry, no slot,
+                        no cache reference.
 
 Router fault points (call-point style like ``ckpt_*`` — ``@N`` counts
 CALLS until the fault fires, default 1; exercised by
@@ -163,6 +186,9 @@ _STEP_KINDS = (
     # speculative-decoding kinds (serving/spec.py): drafter-pool
     # poison (one-shot) and the persistent 0%-acceptance storm
     "spec_drafter_crash", "spec_reject_storm",
+    # structured-decoding kind (serving/constrain.py): dead-end-sentinel
+    # poison of one constrained slot's FSM cursor
+    "constrain_dead_end",
 )
 _POINT_KINDS = (
     "ckpt_write", "ckpt_fsync", "ckpt_manifest", "ckpt_gc",
@@ -171,6 +197,8 @@ _POINT_KINDS = (
     # router points (serving/router.py): probe/pick fire through
     # check(), replica_hang through stall()
     "router_probe_fail", "router_pick_raise", "router_replica_hang",
+    # constraint-compile point (serving/constrain.py:compile_constraint)
+    "constrain_compile_fail",
 )
 
 
@@ -324,6 +352,19 @@ def spec_reject_storm_at(iteration: int) -> bool:
     (``spec_reject_storm@A-B``) for a sustained storm; the throughput
     floor under it is the non-spec rate."""
     return iteration in _get()["spec_reject_storm"]
+
+
+def constrain_dead_end_at(iteration: int) -> bool:
+    """One-shot constraint dead-end fault: when armed for this engine
+    iteration, the engine plants the dead-end sentinel (fsm_state -1)
+    on one constrained ACTIVE slot — the zero-mask sweep must retire
+    it typed (finish_reason "constraint_dead_end"), never hang or
+    emit through an all-zero mask."""
+    p = _get()
+    if iteration in p["constrain_dead_end"]:
+        p["constrain_dead_end"].discard(iteration)
+        return True
+    return False
 
 
 def train_stall(step: int) -> None:

@@ -84,6 +84,37 @@ def main() -> None:
                         "n-gram prompt-lookup fallback. Greedy "
                         "(--temperature 0) output is bit-identical "
                         "to the non-spec path")
+    p.add_argument("--json-schema", default=None,
+                   help="structured decoding (serving/constrain.py): "
+                        "constrain output to valid JSON matching this "
+                        "schema (JSON text, or a path to a .json file); "
+                        "routes through the serving engine")
+    p.add_argument("--regex", default=None,
+                   help="constrain output to match this regular "
+                        "expression (at most one of --json-schema / "
+                        "--regex / --choices)")
+    p.add_argument("--choices", action="append", default=None,
+                   metavar="TEXT",
+                   help="constrain output to exactly one of these "
+                        "strings (repeatable)")
+    p.add_argument("--repetition-penalty", type=float, default=1.0,
+                   help="divide positive / multiply negative logits of "
+                        "already-generated tokens (1.0 = off)")
+    p.add_argument("--presence-penalty", type=float, default=0.0,
+                   help="flat logit subtraction for any token already "
+                        "generated at least once (0 = off)")
+    p.add_argument("--frequency-penalty", type=float, default=0.0,
+                   help="logit subtraction scaled by each token's "
+                        "generated count (0 = off)")
+    p.add_argument("--stop", action="append", default=None,
+                   metavar="TEXT",
+                   help="stop sequence: finish when the generated "
+                        "tokens end with this string's encoding "
+                        "(repeatable; finish_reason=stop_sequence)")
+    p.add_argument("--logprobs", type=int, default=0,
+                   help="echo the chosen token's logprob plus the "
+                        "top-N alternatives per generated token "
+                        "(engine route)")
     args = p.parse_args()
 
     from differential_transformer_replication_tpu.data.tokenizer import (
@@ -136,9 +167,23 @@ def main() -> None:
     rng = jax.random.PRNGKey(args.seed)
     in_window = len(ids) + args.max_new_tokens <= model_cfg.block_size
     spec_requested = bool(args.spec_draft_ckpt) or args.spec_draft_len > 0
-    if (args.kv_page_size > 0 or spec_requested) and (
-        in_window or model_cfg.model != "diff"
-    ):
+    schema = args.json_schema
+    if schema and os.path.exists(schema):  # path form: read the file
+        with open(schema) as f:
+            schema = f.read()
+    constrained = bool(schema or args.regex or args.choices)
+    # the logit pipeline (constraints, penalties, stop sequences,
+    # logprob echo) lives in the serving engine's jitted pool step —
+    # any of these routes sampling through it
+    pipeline_requested = constrained or bool(args.stop) or (
+        args.logprobs > 0
+        or args.repetition_penalty != 1.0
+        or args.presence_penalty != 0.0
+        or args.frequency_penalty != 0.0
+    )
+    if (
+        args.kv_page_size > 0 or spec_requested or pipeline_requested
+    ) and (in_window or model_cfg.model != "diff"):
         # engine route (paged KV and/or speculative decoding): one
         # tiny serving engine. Paged: the FIRST sample prefills the
         # prompt alone, then its retirement donates the prompt pages
@@ -187,14 +232,35 @@ def main() -> None:
                 else len(ids) + args.max_new_tokens
             ),
         )
+        vocab = None
+        if constrained:
+            # the FSM compiler walks the id -> decoded-text table; the
+            # engine only needs it when constraints are actually used
+            from differential_transformer_replication_tpu.data.tokenizer import (  # noqa: E501
+                vocab_strings,
+            )
+
+            vocab = vocab_strings(tokenizer, model_cfg.vocab_size)
         engine = ServingEngine(params, model_cfg, serving,
-                               spec_drafter=spec_drafter)
+                               spec_drafter=spec_drafter, vocab=vocab)
+
+        stop = None
+        if args.stop:
+            stop = tuple(
+                tuple(tokenizer.encode(s).ids) for s in args.stop
+            )
 
         def _params(i):
             return SamplingParams(
                 max_new_tokens=args.max_new_tokens,
                 temperature=args.temperature,
                 top_k=args.top_k, seed=args.seed + i,
+                json_schema=schema, regex=args.regex,
+                choices=tuple(args.choices) if args.choices else None,
+                repetition_penalty=args.repetition_penalty,
+                presence_penalty=args.presence_penalty,
+                frequency_penalty=args.frequency_penalty,
+                stop=stop, logprobs=args.logprobs,
             )
 
         outs = engine.generate([ids], params=[_params(0)])
@@ -213,10 +279,27 @@ def main() -> None:
             print(f"[sample] spec ({spec['mode']}): proposed="
                   f"{spec['proposed']} accepted={spec['accepted']} "
                   f"rate={spec['acceptance_rate']}")
+        if constrained:
+            cs = engine.constrain_stats()
+            print(f"[sample] constrained: cache entries="
+                  f"{cs['entries']} hits={cs['hits_total']} "
+                  f"misses={cs['misses_total']}")
         for i, o in enumerate(outs):
-            print(f"--- sample {i} ---")
+            print(f"--- sample {i} ({o.finish_reason}) ---")
             print(tokenizer.decode(o.prompt + o.tokens))
+            if o.token_logprobs is not None:
+                lps = " ".join(f"{lp:.3f}" for lp in o.token_logprobs)
+                print(f"    logprobs: {lps}")
         return
+
+    if pipeline_requested:
+        raise SystemExit(
+            "--json-schema/--regex/--choices/--stop/--logprobs and the "
+            "penalty flags run in the serving engine's logit pipeline, "
+            "which the diff family past its context window cannot "
+            "route through — shorten --max-new-tokens to fit "
+            "block_size or use the control/ndiff families"
+        )
 
     if in_window or model_cfg.model != "diff":
         # the ring cache keeps O(T)/token past block_size for the RoPE

@@ -1,0 +1,789 @@
+"""Structured decoding (serving/constrain.py + the engine pipeline).
+
+The subsystem's contract, pinned at every layer: the FSM compiler
+(regex -> char DFA -> token FSM over a concrete vocab, Willard & Louf
+2023) admits exactly the constraint's language; the refcounted compile
+cache shares one FSM across identical requests; and inside the ONE
+jitted pool step, constraints/penalties/stop/logprobs ride runtime
+arrays — unconstrained rows stay bit-identical, mixed traffic never
+recompiles, and constrained+spec greedy output is bit-identical to
+constrained non-spec greedy for all three model families.
+"""
+
+import json
+import re as pyre
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+from functools import lru_cache
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.config import (
+    ModelConfig,
+    ServingConfig,
+)
+from differential_transformer_replication_tpu.models import init_model
+from differential_transformer_replication_tpu.serving import (
+    ConstraintCache,
+    ConstraintCompileError,
+    ConstraintDeadEndError,
+    SamplingParams,
+    ServingClient,
+    ServingEngine,
+    serve,
+)
+from differential_transformer_replication_tpu.serving.constrain import (
+    build_token_fsm,
+    compile_constraint,
+    compile_regex,
+    schema_to_regex,
+    spec_key,
+)
+from differential_transformer_replication_tpu.utils import faults
+
+REPO = Path(__file__).resolve().parents[1]
+
+V = 128  # printable ASCII must fit: '{' is 0x7b
+
+
+def _char_vocab(v=V):
+    return [chr(i) if 32 <= i < 127 else "" for i in range(v)]
+
+
+def _ids(text):
+    return [ord(c) for c in text]
+
+
+def _text(tokens, vocab=None):
+    vocab = vocab or _char_vocab()
+    return "".join(vocab[t] for t in tokens)
+
+
+def _cfg(kind):
+    return ModelConfig(
+        model=kind, vocab_size=V, n_embd=32, n_head=2, n_layer=2,
+        block_size=64, dropout=0.0, n_terms=3, compute_dtype="float32",
+    )
+
+
+@lru_cache(maxsize=None)
+def _setup(kind):
+    cfg = _cfg(kind)
+    return cfg, init_model(jax.random.PRNGKey(0), cfg)
+
+
+def _serving(**kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("prefill_budget", 16)
+    return ServingConfig(**kw)
+
+
+def _prompts(lens, seed=1):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, V, size=n).tolist() for n in lens]
+
+
+# ---------------------------------------------------------------------
+# regex -> char DFA
+# ---------------------------------------------------------------------
+
+
+class TestRegexCompiler:
+    @pytest.mark.parametrize("pattern,samples", [
+        ("[ab]{4,8}", ["abab", "aaaaaaaa", "ab", "ababababa", "abcx"]),
+        ("a(b|c)*d", ["ad", "abcbcd", "abd", "a", "abc", "dd"]),
+        ("yes|no|maybe", ["yes", "no", "maybe", "ye", "nope", ""]),
+        ("-?[0-9]+", ["-7", "42", "007", "-", "", "4.2"]),
+        ("x?y+z*", ["y", "xyz", "xyyzz", "x", "z", "xy"]),
+    ])
+    def test_matches_python_re(self, pattern, samples):
+        dfa = compile_regex(pattern)
+        for s in samples:
+            assert dfa.matches(s) == bool(pyre.fullmatch(pattern, s)), (
+                pattern, s
+            )
+
+    def test_literal_escapes(self):
+        dfa = compile_regex(r"\{a\}")
+        assert dfa.matches("{a}")
+        assert not dfa.matches("a")
+
+
+class TestSchemaToRegex:
+    def _dfa(self, schema):
+        return compile_regex(schema_to_regex(schema))
+
+    def test_boolean_object(self):
+        dfa = self._dfa({
+            "type": "object",
+            "properties": {"ok": {"type": "boolean"}},
+            "required": ["ok"],
+        })
+        assert dfa.matches('{"ok":true}')
+        assert dfa.matches('{"ok":false}')
+        assert not dfa.matches('{"ok":1}')
+        assert not dfa.matches("{}")
+        assert not dfa.matches('{"ok": true}')  # canonical: no spaces
+
+    def test_enum_const_and_scalars(self):
+        assert self._dfa({"enum": ["x", "y"]}).matches('"x"')
+        assert not self._dfa({"enum": ["x", "y"]}).matches('"z"')
+        assert self._dfa({"const": 42}).matches("42")
+        assert self._dfa({"type": "integer"}).matches("-7")
+        assert not self._dfa({"type": "integer"}).matches("4.2")
+        assert self._dfa({"type": "null"}).matches("null")
+
+    def test_string_bounds(self):
+        dfa = self._dfa({"type": "string", "maxLength": 3})
+        assert dfa.matches('"abc"')
+        assert not dfa.matches('"abcd"')
+
+    def test_nested_object_and_array(self):
+        dfa = self._dfa({
+            "type": "object",
+            "properties": {
+                "tags": {"type": "array",
+                         "items": {"type": "boolean"}},
+            },
+        })
+        assert dfa.matches('{"tags":[]}')
+        assert dfa.matches('{"tags":[true,false]}')
+        assert not dfa.matches('{"tags":[true,]}')
+
+    def test_unsupported_fails_typed(self):
+        with pytest.raises(ConstraintCompileError):
+            schema_to_regex({"type": "array"})  # items required
+        with pytest.raises(ConstraintCompileError):
+            schema_to_regex({"anyOf": []})
+        with pytest.raises(ConstraintCompileError):
+            schema_to_regex("not-a-dict")
+
+
+# ---------------------------------------------------------------------
+# char DFA -> token FSM over a vocab
+# ---------------------------------------------------------------------
+
+
+class TestTokenFsm:
+    # multi-char BPE-style vocab: id 0 is the "" never-allowed marker
+    VOCAB = ["", "a", "b", "ab", "ba", "c"]
+
+    def _fsm(self, pattern, eos=None):
+        return build_token_fsm(compile_regex(pattern), self.VOCAB, eos)
+
+    def test_start_mask_walks_multichar_tokens(self):
+        fsm = self._fsm("ab+")
+        row = fsm.allowed_row(fsm.start)
+        # "a" and "ab" both spell a prefix of the language; "b"/"ba"/
+        # "c" do not; "" never advances anything
+        assert row.tolist() == [False, True, False, True, False, False]
+
+    def test_walk_matches_prefix_len(self):
+        fsm = self._fsm("ab+")
+        assert fsm.matches([3])          # "ab"
+        assert fsm.matches([1, 2, 2])    # "a","b","b"
+        assert not fsm.matches([1])      # "a" alone: not accepting
+        assert not fsm.matches([4])      # "ba"
+        assert fsm.prefix_len([1, 2, 4]) == 2  # "ba" after "ab" dies
+        assert fsm.walk([1, 2]) >= 0
+        assert fsm.walk([2]) == -1
+
+    def test_eos_column_on_accepting_states_only(self):
+        eos = 0  # reuse the "" id as EOS: it must appear via the EOS
+        fsm = self._fsm("ab", eos=eos)  # column, never via text walk
+        assert not fsm.allowed_row(fsm.start)[eos]
+        end = fsm.walk([3])  # "ab" -> accepting
+        assert fsm.is_accepting(end)
+        assert fsm.allowed_row(end)[eos]
+        assert fsm.advance(end, eos) == -1  # EOS has no successor
+
+    def test_empty_language_fails_typed(self):
+        with pytest.raises(ConstraintCompileError):
+            self._fsm("z+")  # unspellable with this vocab
+
+    def test_nbytes_accounts_tables(self):
+        fsm = self._fsm("ab+")
+        assert fsm.nbytes >= fsm.masks.nbytes + fsm.trans.nbytes
+
+
+class TestConstraintCache:
+    KEYS = [("regex", "[ab]{2}", None), ("regex", "a+", None),
+            ("regex", "b+", None)]
+    VOCAB = ["", "a", "b"]
+
+    def test_refcount_hit_miss_stats(self):
+        c = ConstraintCache(max_entries=8)
+        f1 = c.acquire(self.KEYS[0], self.VOCAB)
+        f2 = c.acquire(self.KEYS[0], self.VOCAB)
+        assert f1 is f2
+        st = c.stats()
+        assert st["entries"] == 1 and st["referenced"] == 1
+        assert st["hits_total"] == 1 and st["misses_total"] == 1
+        c.release(self.KEYS[0])
+        c.release(self.KEYS[0])
+        assert c.stats()["referenced"] == 0
+        assert c.stats()["entries"] == 1  # stays cached at refcount 0
+        assert c.stats()["bytes"] > 0
+
+    def test_lru_eviction_spares_referenced(self):
+        c = ConstraintCache(max_entries=2)
+        c.acquire(self.KEYS[0], self.VOCAB)  # held
+        c.acquire(self.KEYS[1], self.VOCAB)
+        c.release(self.KEYS[1])
+        c.acquire(self.KEYS[2], self.VOCAB)
+        c.release(self.KEYS[2])
+        st = c.stats()
+        # KEYS[1] (oldest refcount-0) was evicted; the referenced
+        # KEYS[0] survived
+        assert st["entries"] == 2 and st["evictions_total"] == 1
+        c.acquire(self.KEYS[1], self.VOCAB)
+        assert c.stats()["misses_total"] == 4  # 3 cold + re-compile
+
+    def test_bad_capacity(self):
+        with pytest.raises(ValueError):
+            ConstraintCache(max_entries=0)
+
+
+# ---------------------------------------------------------------------
+# the shared logit pipeline (models/decode.py)
+# ---------------------------------------------------------------------
+
+
+class TestLogitPipeline:
+    def test_numpy_oracle_and_bitwise_passthrough(self):
+        from differential_transformer_replication_tpu.models.decode import (
+            apply_logit_pipeline,
+        )
+
+        rng = np.random.default_rng(0)
+        B, Vs = 3, 7
+        logits = rng.normal(size=(B, Vs)).astype(np.float32)
+        counts = rng.integers(0, 3, size=(B, Vs)).astype(np.int32)
+        allowed = rng.random((B, Vs)) > 0.3
+        allowed[0] = True  # row 0: default row
+        counts[0] = 0
+        rep = np.array([1.0, 1.5, 2.0], np.float32)
+        pres = np.array([0.0, 0.4, 0.0], np.float32)
+        freq = np.array([0.0, 0.0, 0.2], np.float32)
+        got = np.asarray(apply_logit_pipeline(
+            logits, allowed, counts, rep, pres, freq
+        ))
+        # numpy oracle
+        seen = counts > 0
+        pen = np.where(
+            seen,
+            np.where(logits > 0, logits / rep[:, None],
+                     logits * rep[:, None]),
+            logits,
+        )
+        pen = pen - pres[:, None] * seen - freq[:, None] * counts
+        ref = np.where(allowed, pen, -np.inf)
+        ref[0] = logits[0]  # inactive row passes through raw
+        assert np.array_equal(got[1:], ref[1:].astype(np.float32))
+        # the default row is BITWISE the input — the engine's pinned
+        # unconstrained bit-repro depends on this
+        assert got[0].tobytes() == logits[0].tobytes()
+
+
+# ---------------------------------------------------------------------
+# engine integration: the one jitted pool step
+# ---------------------------------------------------------------------
+
+
+REGEX = "[ab]{4,8}"
+
+
+def _constrained_params(seed=0, n=16, **kw):
+    kw.setdefault("regex", REGEX)
+    return SamplingParams(max_new_tokens=n, temperature=0.0,
+                          seed=seed, **kw)
+
+
+class TestEngineConstrained:
+    def test_greedy_valid_and_bit_reproducible_across_batches(self):
+        """The same constrained request produces IDENTICAL tokens
+        alone and inside a mixed batch; its unconstrained neighbors
+        are bit-identical to an engine that never saw a constraint
+        (the all-ones mask row passes logits through bitwise)."""
+        cfg, params = _setup("control")
+        vocab = _char_vocab()
+        cprompt = _prompts([6], seed=3)[0]
+        uprompts = _prompts([5, 9, 3], seed=4)
+
+        alone = ServingEngine(params, cfg, _serving(), vocab=vocab)
+        (c_alone,) = alone.generate([cprompt],
+                                    params=[_constrained_params()])
+        plain = ServingEngine(params, cfg, _serving())
+        u_alone = plain.generate(
+            uprompts,
+            params=[SamplingParams(max_new_tokens=8, temperature=0.0,
+                                   seed=7 + i)
+                    for i in range(3)],
+        )
+
+        mixed = ServingEngine(params, cfg, _serving(), vocab=vocab)
+        outs = mixed.generate(
+            [cprompt] + uprompts,
+            params=[_constrained_params()] + [
+                SamplingParams(max_new_tokens=8, temperature=0.0,
+                               seed=7 + i)
+                for i in range(3)
+            ],
+        )
+        assert outs[0].tokens == c_alone.tokens
+        fsm = compile_constraint(
+            spec_key(_constrained_params(), None), vocab
+        )
+        assert fsm.matches(c_alone.tokens)
+        assert outs[0].finish_reason == "constraint_complete"
+        for got, ref in zip(outs[1:], u_alone):
+            assert got.tokens == ref.tokens
+
+    def test_zero_recompiles_for_mixed_churn(self):
+        """After one warm mixed pass, a different constraint, a
+        different batch mix, and penalty/logprob variation compile
+        NOTHING: per-request state rides runtime arrays."""
+        from differential_transformer_replication_tpu.analysis.sanitizers import (  # noqa: E501
+            RecompileSentinel,
+        )
+
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _serving(), vocab=_char_vocab())
+        warm = _prompts([4, 7, 5, 9], seed=5)
+        eng.generate(
+            warm,
+            params=[_constrained_params()] + [
+                SamplingParams(max_new_tokens=6, temperature=0.0,
+                               seed=i)
+                for i in range(3)
+            ],
+        )
+        with RecompileSentinel(budget=0, name="constrain-churn"):
+            outs = eng.generate(
+                _prompts([6, 3, 8, 5], seed=6),
+                params=[
+                    _constrained_params(regex="(ab|ba){2,5}c?"),
+                    _constrained_params(
+                        regex=None,
+                        json_schema=json.dumps({
+                            "type": "object",
+                            "properties": {
+                                "ok": {"type": "boolean"},
+                            },
+                        }),
+                    ),
+                    SamplingParams(max_new_tokens=6, temperature=0.0,
+                                   seed=11, repetition_penalty=1.3,
+                                   logprobs=2),
+                    SamplingParams(max_new_tokens=6, temperature=0.0,
+                                   seed=12),
+                ],
+            )
+        assert outs[0].finish_reason == "constraint_complete"
+        assert _text(outs[1].tokens) in ('{"ok":true}', '{"ok":false}')
+
+    def test_penalties_presence_blocks_repeats(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _serving())
+        (out,) = eng.generate(
+            [_prompts([5], seed=9)[0]],
+            params=[SamplingParams(max_new_tokens=10, temperature=0.0,
+                                   seed=0, presence_penalty=1e4)],
+        )
+        # a huge presence penalty makes greedy spend each token once
+        assert len(set(out.tokens)) == len(out.tokens)
+
+    def test_stop_sequence_finishes_typed(self):
+        cfg, params = _setup("control")
+        prompt = _prompts([6], seed=10)[0]
+        eng = ServingEngine(params, cfg, _serving())
+        (ref,) = eng.generate(
+            [prompt],
+            params=[SamplingParams(max_new_tokens=8, temperature=0.0,
+                                   seed=0)],
+        )
+        assert len(ref.tokens) == 8
+        stop = (tuple(ref.tokens[2:4]),)
+        (out,) = eng.generate(
+            [prompt],
+            params=[SamplingParams(max_new_tokens=8, temperature=0.0,
+                                   seed=0, stop=stop)],
+        )
+        assert out.finish_reason == "stop_sequence"
+        assert out.tokens == ref.tokens[:4]
+        # the labeled finished counter saw it
+        text = eng.registry.render()
+        assert 'reason="stop_sequence"' in text
+
+    def test_logprob_echo_greedy(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _serving())
+        (out,) = eng.generate(
+            [_prompts([5], seed=11)[0]],
+            params=[SamplingParams(max_new_tokens=6, temperature=0.0,
+                                   seed=0, logprobs=3)],
+        )
+        assert len(out.token_logprobs) == len(out.tokens)
+        assert len(out.top_logprobs) == len(out.tokens)
+        for tok, lp, top in zip(out.tokens, out.token_logprobs,
+                                out.top_logprobs):
+            assert lp <= 0.0
+            assert 1 <= len(top) <= 3
+            ids = [t for t, _ in top]
+            lps = [v for _, v in top]
+            # greedy chose the argmax: it leads the top-k echo
+            assert ids[0] == tok
+            assert abs(lps[0] - lp) < 1e-5
+            assert lps == sorted(lps, reverse=True)
+
+    def test_unconstrained_requests_carry_no_echo(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _serving())
+        (out,) = eng.generate(
+            [_prompts([5], seed=11)[0]],
+            params=[SamplingParams(max_new_tokens=4, temperature=0.0,
+                                   seed=0)],
+        )
+        assert out.token_logprobs is None
+        assert out.top_logprobs is None
+
+    def test_constrain_stats_and_gauges(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _serving(), vocab=_char_vocab())
+        eng.generate(
+            _prompts([4, 6], seed=12),
+            params=[_constrained_params(seed=i) for i in range(2)],
+        )
+        st = eng.constrain_stats()
+        assert st["entries"] == 1  # one compile, shared
+        assert st["misses_total"] == 1 and st["hits_total"] == 1
+        assert st["active"] == 0  # both released at retire
+        text = eng.registry.render()
+        for name in (
+            "serving_constrained_requests_active",
+            "serving_constraint_cache_entries",
+            "serving_constraint_cache_bytes",
+            "serving_constraint_cache_hits_total",
+            "serving_constraint_cache_misses_total",
+        ):
+            assert name in text
+
+    def test_constraint_without_vocab_fails_typed(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _serving())  # no vocab table
+        with pytest.raises((ConstraintCompileError, ValueError)):
+            eng.generate([_prompts([4])[0]],
+                         params=[_constrained_params()])
+
+
+# the tentpole's distribution pin: constrained+spec greedy output is
+# bit-identical to constrained non-spec greedy for all three families
+@pytest.mark.parametrize("kind", [
+    "control",
+    pytest.param("diff", marks=pytest.mark.slow),
+    pytest.param("ndiff", marks=pytest.mark.slow),
+])
+def test_constrained_spec_greedy_bit_parity(kind):
+    cfg, params = _setup(kind)
+    vocab = _char_vocab()
+    prompts = _prompts([6, 4, 9], seed=13)
+    ps = [_constrained_params(seed=i) for i in range(3)]
+
+    plain = ServingEngine(params, cfg, _serving(), vocab=vocab)
+    refs = plain.generate(prompts, params=ps)
+    spec = ServingEngine(
+        params, cfg,
+        _serving(spec_mode="ngram", spec_draft_len=4),
+        vocab=vocab,
+    )
+    outs = spec.generate(prompts, params=ps)
+    for got, ref in zip(outs, refs):
+        assert got.tokens == ref.tokens
+        assert got.finish_reason == ref.finish_reason
+    fsm = compile_constraint(spec_key(ps[0], None), vocab)
+    for o in refs:
+        assert fsm.matches(o.tokens)
+
+
+# ---------------------------------------------------------------------
+# fault points
+# ---------------------------------------------------------------------
+
+
+class TestFaults:
+    def setup_method(self):
+        faults.reset()
+
+    def teardown_method(self):
+        faults.reset()
+
+    def test_dead_end_retires_typed_with_partial_output(self):
+        """constrain_dead_end@N poisons a constrained slot's FSM
+        cursor: the request must retire as a typed retriable failure
+        with its partial output — never hang, never emit through a
+        zeroed mask — and the slot must be reusable immediately."""
+        cfg, params = _setup("control")
+        faults.arm("constrain_dead_end@0-50")
+        client = ServingClient(
+            ServingEngine(params, cfg, _serving(), vocab=_char_vocab())
+        )
+        try:
+            with pytest.raises(ConstraintDeadEndError) as ei:
+                client.generate(
+                    _prompts([6], seed=14)[0],
+                    params=_constrained_params(),
+                    timeout=120,
+                )
+            out = ei.value.output
+            assert out.finish_reason == "constraint_dead_end"
+            assert isinstance(out.tokens, list)
+            # the slot and its pages came back: the engine still serves
+            ok = client.generate(
+                _prompts([4], seed=15)[0],
+                params=SamplingParams(max_new_tokens=4,
+                                      temperature=0.0, seed=0),
+                timeout=120,
+            )
+            assert ok.finish_reason == "length"
+            st = client.runner.engine.constrain_stats()
+            assert st["active"] == 0
+        finally:
+            client.close()
+
+    def test_compile_fail_rejects_at_submit_engine_untouched(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _serving(), vocab=_char_vocab())
+        faults.arm("constrain_compile_fail@1")
+        with pytest.raises(ConstraintCompileError):
+            eng.generate([_prompts([4])[0]],
+                         params=[_constrained_params()])
+        # the injected failure consumed the point; the SAME spec now
+        # compiles and decodes — nothing engine-side was corrupted
+        (out,) = eng.generate([_prompts([4])[0]],
+                              params=[_constrained_params()])
+        assert out.finish_reason == "constraint_complete"
+
+
+# ---------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_http_constrained_end_to_end():
+    """POST /generate with a regex constraint; malformed schema ->
+    400 constraint_compile_failed; injected dead end -> 400
+    constraint_dead_end with partial_tokens; /metrics exports the
+    constraint gauges."""
+    faults.reset()
+    cfg, params = _setup("control")
+    client = ServingClient(
+        ServingEngine(params, cfg, _serving(), vocab=_char_vocab())
+    )
+    httpd = serve(client, port=0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def _post(payload):
+        return urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+
+    try:
+        prompt = _prompts([6], seed=16)[0]
+        with urllib.request.urlopen(
+            _post({"prompt_ids": prompt, "max_new_tokens": 16,
+                   "temperature": 0.0, "regex": REGEX,
+                   "logprobs": 2}),
+            timeout=120,
+        ) as r:
+            body = json.load(r)
+        assert body["finish_reason"] == "constraint_complete"
+        assert pyre.fullmatch(REGEX, _text(body["tokens"]))
+        assert len(body["token_logprobs"]) == len(body["tokens"])
+        assert all(len(row) <= 2 for row in body["top_logprobs"])
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                _post({"prompt_ids": prompt, "max_new_tokens": 4,
+                       "json_schema": {"type": "array"}}),
+                timeout=30,
+            )
+        assert ei.value.code == 400
+        err = json.load(ei.value)
+        assert err["code"] == "constraint_compile_failed"
+
+        faults.arm("constrain_dead_end@0-50")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                _post({"prompt_ids": prompt, "max_new_tokens": 16,
+                       "temperature": 0.0, "regex": REGEX}),
+                timeout=120,
+            )
+        faults.reset()
+        assert ei.value.code == 400
+        err = json.load(ei.value)
+        assert err["code"] == "constraint_dead_end"
+        assert isinstance(err["partial_tokens"], list)
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ) as r:
+            text = r.read().decode()
+        assert "serving_constraint_cache_entries" in text
+        assert "serving_constrained_requests_active" in text
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health", timeout=30
+        ) as r:
+            health = json.load(r)
+        assert "constraints" in health
+    finally:
+        faults.reset()
+        httpd.shutdown()
+        httpd.server_close()
+        client.close()
+
+
+# ---------------------------------------------------------------------
+# SamplingParams validation (satellite: the negative-top_k hole)
+# ---------------------------------------------------------------------
+
+
+class TestSamplingParamsValidation:
+    def test_negative_top_k_rejected(self):
+        with pytest.raises(ValueError, match="top_k"):
+            SamplingParams(max_new_tokens=4, top_k=-3)
+
+    def test_at_most_one_constraint(self):
+        with pytest.raises(ValueError, match="at most one"):
+            SamplingParams(max_new_tokens=4, regex="a+",
+                           choices=("a",))
+
+    def test_penalty_and_stop_validation(self):
+        with pytest.raises(ValueError, match="repetition_penalty"):
+            SamplingParams(max_new_tokens=4, repetition_penalty=0.0)
+        p = SamplingParams(max_new_tokens=4, stop=[[1, 2], [3]])
+        assert p.stop == ((1, 2), (3,))
+        with pytest.raises(ValueError):
+            SamplingParams(max_new_tokens=4, logprobs=-1)
+
+
+# ---------------------------------------------------------------------
+# GL301 mutation test: the cache's lock discipline is machine-checked
+# ---------------------------------------------------------------------
+
+
+class TestGL301CoversConstraintCache:
+    """ConstraintCache is a lock-owning class shared between the
+    engine thread and /health / /metrics readers; GL301 is the machine
+    check that its counter/refcount writes stay under ``self._lock``.
+    Planting exactly that bug — a counter write hoisted OUT of the
+    lock in ``release`` — in the real module source MUST fire; the
+    unmutated module must stay clean."""
+
+    SPEC = (
+        REPO / "differential_transformer_replication_tpu" / "serving"
+        / "constrain.py"
+    )
+    ANCHOR = (
+        "        with self._lock:\n"
+        "            ent = self._entries.get(key)\n"
+        "            if ent is not None and ent.refs > 0:\n"
+        "                ent.refs -= 1"
+    )
+
+    def _copy(self, tmp_path, src):
+        # keep the serving/ path component: GL301 is a serving-dir rule
+        path = tmp_path / "serving" / "constrain.py"
+        path.parent.mkdir(parents=True)
+        path.write_text(src)
+        return path
+
+    def _lint(self, path, rules):
+        sys.path.insert(0, str(REPO))
+        from differential_transformer_replication_tpu.analysis.lint import (
+            lint_paths,
+        )
+
+        return lint_paths([str(path)], rules=rules)
+
+    def test_unmutated_cache_is_lock_clean(self, tmp_path):
+        path = self._copy(tmp_path, self.SPEC.read_text())
+        result = self._lint(path, ["GL301", "GL601", "GL602"])
+        assert [f.rule for f in result.active] == []
+
+    def test_planted_off_lock_counter_write_fires(self, tmp_path):
+        src = self.SPEC.read_text()
+        assert self.ANCHOR in src, (
+            "mutation anchor vanished — ConstraintCache.release's lock "
+            "block moved; update the anchor so this mutation test "
+            "keeps guarding it"
+        )
+        mutated = src.replace(
+            self.ANCHOR,
+            "        self._misses += 1  # planted: off-lock write\n"
+            + self.ANCHOR,
+        )
+        path = self._copy(tmp_path, mutated)
+        result = self._lint(path, ["GL301"])
+        assert [f.rule for f in result.active] == ["GL301"]
+        (finding,) = result.active
+        assert "_misses" in finding.message
+
+    def test_planted_write_under_lock_stays_clean(self, tmp_path):
+        src = self.SPEC.read_text()
+        mutated = src.replace(
+            self.ANCHOR,
+            self.ANCHOR.replace(
+                "                ent.refs -= 1",
+                "                self._misses += 0  # under the lock\n"
+                "                ent.refs -= 1",
+            ),
+        )
+        path = self._copy(tmp_path, mutated)
+        result = self._lint(path, ["GL301"])
+        assert [f.rule for f in result.active] == []
+
+
+# ---------------------------------------------------------------------
+# tools
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestTools:
+    def test_serve_bench_constrained_smoke(self):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "serve_bench.py"),
+             "--smoke", "--constrained", "regex"],
+            capture_output=True, text=True, timeout=900,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        line = json.loads(r.stdout.strip().splitlines()[-1])
+        assert line["schema_validity_rate"] == 1.0
+        assert line["compiles_in_window"] == 0
+        assert line["constraint_cache"]["hits_total"] >= 1
+
+    def test_constrain_report_smoke_check(self):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "constrain_report.py"),
+             "--smoke", "--check", "--spec", "choices"],
+            capture_output=True, text=True, timeout=900,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        line = json.loads(r.stdout.strip().splitlines()[-1])
+        assert line["constrained_validity_diff"] == 1.0
+        assert line["constrained_validity_control"] == 1.0
+        assert "lambda_mean" in line

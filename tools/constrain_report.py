@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Diff-vs-control schema-validity report for structured decoding.
+
+The paper's serving-side question: does differential attention's
+noise-cancellation make outputs *naturally* better-structured, and
+what does FSM-constrained decoding (serving/constrain.py) add on top?
+This tool answers it as one JSON line: the SAME greedy workload runs
+through a diff-family and a control-family engine twice — once
+unconstrained (``natural_validity_*``: how often free-running output
+happens to match the schema) and once constrained
+(``constrained_validity_*``: guaranteed 1.0 by the FSM masks,
+model-independent) — alongside the diff checkpoint's effective-lambda
+record (obs/introspect.py), so validity and the learned λ drift land
+in the same row and can be correlated across checkpoints of a run::
+
+    python tools/constrain_report.py --diff-ckpt runs/diff/best_model.ckpt \
+        --control-ckpt runs/control/best_model.ckpt --spec json --check
+
+``--check`` turns the report into a gate: exit 2 unless the
+constrained arms are BOTH exactly 1.0 (the subsystem's contract — a
+single invalid constrained output means masks leaked). ``--smoke``
+substitutes tiny random-init models (validity of the constrained arms
+is still 1.0 by construction; the natural arms are then just noise).
+
+Prompts are synthetic over a printable-ASCII char vocabulary — the
+same id -> text convention data/tokenizer.vocab_strings feeds the real
+server — so the tool needs no tokenizer directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+_SPECS = {
+    "json": {"json_schema": json.dumps({
+        "type": "object",
+        "properties": {"ok": {"type": "boolean"}},
+        "required": ["ok"],
+    })},
+    "regex": {"regex": "[ab]{4,8}"},
+    "choices": {"choices": ("yes", "no", "maybe")},
+}
+
+
+def _validity(outs, fsm, eos):
+    n = 0
+    for out in outs:
+        toks = list(out.tokens)
+        if eos is not None and toks and toks[-1] == eos:
+            toks.pop()
+        if fsm.matches(toks):
+            n += 1
+    return n / max(1, len(outs))
+
+
+def _run_family(params, model_cfg, serving, vocab, prompts, ckw,
+                new_tokens, seed):
+    """One family, both arms. Returns (natural, constrained) validity
+    plus the engine's constraint-cache stats."""
+    from differential_transformer_replication_tpu.serving import (
+        SamplingParams,
+        ServingEngine,
+    )
+    from differential_transformer_replication_tpu.serving.constrain import (
+        compile_constraint,
+        spec_key,
+    )
+
+    engine = ServingEngine(params, model_cfg, serving, vocab=vocab)
+    eos = serving.eos_token_id
+
+    def _arm(constrained):
+        ps = [
+            SamplingParams(
+                max_new_tokens=new_tokens, temperature=0.0,
+                seed=seed + i, **(ckw if constrained else {}),
+            )
+            for i in range(len(prompts))
+        ]
+        return engine.generate(prompts, params=ps)
+
+    natural = _arm(False)
+    constrained = _arm(True)
+    fsm = compile_constraint(
+        spec_key(
+            SamplingParams(max_new_tokens=new_tokens, **ckw), eos
+        ),
+        vocab,
+    )
+    return (
+        _validity(natural, fsm, eos),
+        _validity(constrained, fsm, eos),
+        engine.constrain_stats(),
+    )
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--diff-ckpt", default=None,
+                   help="diff-family checkpoint dir (best_model.ckpt)")
+    p.add_argument("--control-ckpt", default=None,
+                   help="control-family checkpoint dir")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny random-init diff + control instead of "
+                        "checkpoints; seconds on CPU")
+    p.add_argument("--spec", default="json",
+                   choices=tuple(sorted(_SPECS)),
+                   help="canned constraint over the ASCII char vocab "
+                        "(same set as serve_bench --constrained)")
+    p.add_argument("--requests", type=int, default=8)
+    p.add_argument("--new-tokens", type=int, default=24)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--check", action="store_true",
+                   help="gate mode: exit 2 unless BOTH constrained "
+                        "arms report validity exactly 1.0")
+    p.add_argument("--out", default=None,
+                   help="also append the JSON line to this file")
+    args = p.parse_args()
+    if not args.smoke and not (args.diff_ckpt and args.control_ckpt):
+        raise SystemExit(
+            "pass --diff-ckpt AND --control-ckpt, or --smoke"
+        )
+
+    import jax
+
+    from differential_transformer_replication_tpu.config import (
+        ModelConfig,
+        ServingConfig,
+    )
+    from differential_transformer_replication_tpu.obs.introspect import (
+        lambda_record,
+        make_param_summary,
+    )
+
+    if args.smoke:
+        families = {}
+        for fam in ("diff", "control"):
+            cfg = ModelConfig(
+                model=fam, vocab_size=128, n_embd=32, n_head=2,
+                n_layer=2, block_size=64, dropout=0.0,
+                compute_dtype="float32",
+            )
+            from differential_transformer_replication_tpu.models import (
+                init_model,
+            )
+
+            families[fam] = (
+                init_model(jax.random.PRNGKey(args.seed), cfg), cfg
+            )
+    else:
+        from differential_transformer_replication_tpu.train.checkpoint import (  # noqa: E501
+            load_params_for_inference,
+        )
+
+        families = {}
+        for fam, ck in (("diff", args.diff_ckpt),
+                        ("control", args.control_ckpt)):
+            params, cfg, _ = load_params_for_inference(ck)
+            if (fam == "diff") != (cfg.model in ("diff", "ndiff")):
+                raise SystemExit(
+                    f"--{fam}-ckpt {ck} is a {cfg.model!r}-family "
+                    "checkpoint"
+                )
+            families[fam] = (params, cfg)
+
+    ckw = _SPECS[args.spec]
+    line = {"metric": "constrained_schema_validity",
+            "constrained_spec": args.spec,
+            "n_requests": args.requests,
+            "new_tokens": args.new_tokens,
+            "smoke": bool(args.smoke)}
+    ok = True
+    for fam, (params, cfg) in families.items():
+        if cfg.vocab_size < 128:
+            raise SystemExit(
+                f"{fam} vocab_size {cfg.vocab_size} < 128: the char "
+                "vocab must cover printable ASCII for the canned specs"
+            )
+        vocab = [
+            chr(i) if 32 <= i < 127 else ""
+            for i in range(cfg.vocab_size)
+        ]
+        rng = np.random.default_rng(args.seed)
+        prompts = [
+            rng.integers(
+                0, cfg.vocab_size,
+                size=int(rng.integers(4, 13)),
+            ).tolist()
+            for _ in range(args.requests)
+        ]
+        serving = ServingConfig(
+            num_slots=min(8, max(1, args.requests)),
+            prefill_chunk=16, prefill_budget=32,
+            max_seq_len=(0 if cfg.model == "diff"
+                         else cfg.block_size + args.new_tokens),
+        )
+        nat, con, cstats = _run_family(
+            params, cfg, serving, vocab, prompts, ckw,
+            args.new_tokens, args.seed,
+        )
+        line[f"natural_validity_{fam}"] = round(nat, 5)
+        line[f"constrained_validity_{fam}"] = round(con, 5)
+        line[f"constraint_cache_hits_{fam}"] = cstats["hits_total"]
+        ok = ok and con == 1.0
+        # λ record for the differential family: the paper's per-layer
+        # effective lambda lands in the SAME row as the validity split
+        if cfg.model in ("diff", "ndiff"):
+            summary = make_param_summary(cfg)(params)
+            rec = lambda_record(jax.device_get(summary), cfg)
+            lams = [v for k, v in rec.items()
+                    if k.startswith("lambda_l")
+                    and not k.startswith("lambda_init")]
+            line.update(
+                {k: v for k, v in rec.items() if k.startswith("lambda")}
+            )
+            if lams:
+                line["lambda_mean"] = round(
+                    float(np.mean(lams)), 6
+                )
+    line["natural_vs_constrained_gap_diff"] = round(
+        line["constrained_validity_diff"]
+        - line["natural_validity_diff"], 5
+    )
+    line["check"] = bool(args.check)
+    line["ok"] = ok
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    if args.check and not ok:
+        print(
+            "[constrain_report] FAIL: a constrained arm reported "
+            "validity < 1.0 — the FSM masks leaked an invalid token",
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -645,6 +645,214 @@ def _run_spec_ab(args, params, model_cfg, serving) -> None:
     )
 
 
+_CONSTRAINT_SPECS = {
+    # every canned spec is BOUNDED (no unbounded repetition), so each
+    # constrained request reaches an accepting terminal state well
+    # inside its token budget and schema_validity_rate can hit 1.0
+    "json": {"json_schema": json.dumps({
+        "type": "object",
+        "properties": {"ok": {"type": "boolean"}},
+        "required": ["ok"],
+    })},
+    "regex": {"regex": "[ab]{4,8}"},
+    "choices": {"choices": ("yes", "no", "maybe")},
+}
+
+
+def _run_constrained_ab(args, params, model_cfg, serving) -> None:
+    """``--constrained SPEC`` workload: ONE engine, MIXED traffic —
+    alternating constrained (FSM-masked, serving/constrain.py) and
+    unconstrained requests through the same jitted pool step — measured
+    under the RecompileSentinel. Constraints ride runtime arrays, so
+    ``compiles_in_window`` must stay 0: mixed traffic is the whole
+    point of the design. Every constrained output is re-walked through
+    an independently compiled FSM (``schema_validity_rate``); the
+    canned specs are bounded, so 1.0 is the only acceptable value.
+    Compose with ``--spec ngram`` for the constrained+speculative arm
+    (drafts are FSM-pre-truncated, then verify re-checks)."""
+    import jax  # noqa: F401  (engine stack below pulls it in anyway)
+
+    from differential_transformer_replication_tpu.analysis.sanitizers import (
+        RecompileSentinel,
+    )
+    from differential_transformer_replication_tpu.models.decode import (
+        kv_store_dtype,
+    )
+    from differential_transformer_replication_tpu.serving import (
+        SamplingParams,
+        ServingClient,
+        ServingEngine,
+    )
+    from differential_transformer_replication_tpu.serving.constrain import (
+        compile_constraint,
+        spec_key,
+    )
+
+    ckw = _CONSTRAINT_SPECS[args.constrained]
+    # synthetic char vocab: id -> its ASCII char, the idiom the real
+    # server gets from data/tokenizer.vocab_strings. Ids outside
+    # printable ASCII decode to "" (never allowed under a constraint;
+    # unconstrained requests still sample them freely)
+    vocab = [
+        chr(i) if 32 <= i < 127 else "" for i in range(model_cfg.vocab_size)
+    ]
+    if args.spec:
+        serving = serving.replace(
+            spec_mode=args.spec, spec_draft_len=args.spec_draft_len,
+            spec_verify=args.spec_verify,
+        )
+        if args.spec == "model":
+            raise SystemExit(
+                "--constrained composes with --spec ngram (the model "
+                "drafter would need a checkpoint sharing this synthetic "
+                "char vocab)"
+            )
+
+    rng = np.random.default_rng(args.seed)
+    max_prompt = min(args.max_prompt,
+                     model_cfg.block_size - args.new_tokens - 1)
+    min_prompt = max(1, min(args.min_prompt, max_prompt))
+    prompts = [
+        rng.integers(
+            0, model_cfg.vocab_size,
+            size=int(rng.integers(min_prompt, max_prompt + 1)),
+        ).tolist()
+        for _ in range(args.requests)
+    ]
+    constrained_ids = set(range(0, len(prompts), 2))  # even = constrained
+
+    def _params(i):
+        kw = dict(ckw) if i in constrained_ids else {}
+        return SamplingParams(
+            max_new_tokens=args.new_tokens,
+            temperature=args.temperature, seed=args.seed + i, **kw,
+        )
+
+    def _workload(client):
+        completed = {}
+        lock = threading.Lock()
+        next_idx = [0]
+
+        def worker():
+            while True:
+                with lock:
+                    i = next_idx[0]
+                    if i >= len(prompts):
+                        return
+                    next_idx[0] += 1
+                out = client.generate(prompts[i], params=_params(i),
+                                      timeout=600)
+                with lock:
+                    completed[i] = out
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker)
+                   for _ in range(args.clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert len(completed) == len(prompts), "requests went missing"
+        return wall, completed
+
+    # unmeasured warm pass (fresh engine; the jitted closures are
+    # module-cached, so its compiles carry to the measured engine)
+    warm = ServingClient(ServingEngine(params, model_cfg, serving,
+                                       vocab=vocab))
+    _workload(warm)
+    warm.close()
+    engine = ServingEngine(params, model_cfg, serving, vocab=vocab)
+    client = ServingClient(engine)
+    sentinel = RecompileSentinel(
+        budget=(None if args.allow_recompiles < 0
+                else args.allow_recompiles),
+        name="serve-bench-constrained-window",
+    )
+    with sentinel:
+        wall, completed = _workload(client)
+    spec_stats = engine.spec_stats() if args.spec else None
+    cstats = engine.constrain_stats()
+    client.close()
+
+    # validity oracle: an FSM compiled OUTSIDE the engine re-walks
+    # every constrained output end to end
+    sp0 = _params(0)
+    fsm = compile_constraint(spec_key(sp0, serving.eos_token_id), vocab)
+    eos = serving.eos_token_id
+    n_valid = 0
+    finish_reasons = {}
+    c_tokens = u_tokens = 0
+    for i, out in completed.items():
+        if i not in constrained_ids:
+            u_tokens += len(out.tokens)
+            continue
+        c_tokens += len(out.tokens)
+        toks = list(out.tokens)
+        if eos is not None and toks and toks[-1] == eos:
+            toks.pop()
+        if fsm.matches(toks):
+            n_valid += 1
+        fr = out.finish_reason
+        finish_reasons[fr] = finish_reasons.get(fr, 0) + 1
+    n_con = len(constrained_ids)
+    validity = n_valid / max(1, n_con)
+    con_tps = c_tokens / wall
+    unc_tps = u_tokens / wall
+    line = {
+        "metric": "serving_constrained_output_tokens_per_sec",
+        "value": round(con_tps, 1),
+        "unit": "tokens/sec",
+        "constrained_spec": args.constrained,
+        "schema_validity_rate": round(validity, 5),
+        "constrained_tok_per_s": round(con_tps, 1),
+        "unconstrained_tok_per_s": round(unc_tps, 1),
+        "compiles_in_window": sentinel.count,
+        "constraint_cache": {
+            k: cstats[k]
+            for k in ("entries", "bytes", "hits_total", "misses_total")
+        },
+        "constrained_finish_reasons": finish_reasons,
+        "n_constrained": n_con,
+        "n_unconstrained": len(prompts) - n_con,
+        "spec_mode": args.spec or "",
+        "spec_acceptance_rate": (
+            spec_stats["acceptance_rate"] if spec_stats else None
+        ),
+        "output_tokens": c_tokens + u_tokens,
+        "wall_s": round(wall, 3),
+        "model": model_cfg.model,
+        "decode_attention_impl": (
+            serving.decode_attention_impl
+            or model_cfg.decode_attention_impl
+        ),
+        "kv_cache_dtype": kv_store_dtype(
+            model_cfg if not serving.kv_cache_dtype
+            else model_cfg.replace(kv_cache_dtype=serving.kv_cache_dtype)
+        ),
+        "kv_page_size": serving.kv_page_size,
+        "num_slots": serving.num_slots,
+        "clients": args.clients,
+        "new_tokens": args.new_tokens,
+        "temperature": args.temperature,
+        "prompt_len_range": [min_prompt, max_prompt],
+        "smoke": bool(args.smoke),
+    }
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    print(
+        f"[serve_bench] constrained A/B ({args.constrained}"
+        f"{'+spec ' + args.spec if args.spec else ''}) "
+        f"validity={validity:.3f} constrained={con_tps:.1f} tok/s "
+        f"unconstrained={unc_tps:.1f} tok/s "
+        f"compiles={sentinel.count} "
+        f"cache_hits={cstats['hits_total']}",
+        file=sys.stderr,
+    )
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--smoke", action="store_true",
@@ -697,6 +905,24 @@ def main() -> None:
     p.add_argument("--prefix-cache-pages", type=int, default=0,
                    help="extra pool pages kept as cached-prefix "
                         "headroom")
+    p.add_argument("--constrained", default=None,
+                   choices=tuple(sorted(_CONSTRAINT_SPECS)),
+                   help="structured-decoding A/B (serving/constrain.py): "
+                        "mixed traffic — alternating constrained and "
+                        "unconstrained requests — through ONE engine, "
+                        "measured under the RecompileSentinel. The JSON "
+                        "line reports schema_validity_rate (every "
+                        "constrained output re-walked through an "
+                        "independently compiled FSM; must be 1.0), "
+                        "constrained_tok_per_s vs "
+                        "unconstrained_tok_per_s, compiles_in_window "
+                        "(must be 0: constraints ride runtime arrays) "
+                        "and constraint-cache hit counters. Canned "
+                        "specs over a synthetic ASCII char vocab: "
+                        "'json' (a boolean-field object schema), "
+                        "'regex' ([ab]{4,8}), 'choices' (yes/no/"
+                        "maybe). Composes with --spec ngram for the "
+                        "constrained+speculative arm. In-process only")
     p.add_argument("--spec", default=None, choices=("ngram", "model"),
                    help="speculative-decoding A/B (serving/spec.py): "
                         "run the SAME workload twice — non-spec "
@@ -800,6 +1026,21 @@ def main() -> None:
             args.requests, args.clients = 8, 4
             args.max_prompt, args.new_tokens = 10, 24
             args.temperature = 0.0
+        if args.constrained:
+            # constrained smoke: the char vocab must cover printable
+            # ASCII (the JSON spec needs '{' = 0x7b), and the token
+            # budget must cover the longest bounded path of every
+            # canned spec ('{"ok":false}' = 13 single-char tokens)
+            args.vocab_size = 128
+            args.new_tokens = max(args.new_tokens, 16)
+            args.block_size = max(args.block_size,
+                                  args.max_prompt + args.new_tokens + 4)
+    if args.constrained and (args.target or args.http):
+        raise SystemExit(
+            "--constrained is an in-process A/B bench (it builds the "
+            "engine with a synthetic char vocab and reads the "
+            "constraint-cache counters directly)"
+        )
     if args.spec and (args.target or args.http):
         raise SystemExit(
             "--spec is an in-process A/B bench (it builds both engines "
@@ -904,6 +1145,10 @@ def main() -> None:
             os.path.join(args.trace_dir, "serve_bench.engine.trace.json"),
             process_name="serve-bench-engine",
         )
+    if args.constrained:
+        # handles --spec itself (the constrained+speculative arm)
+        _run_constrained_ab(args, params, model_cfg, serving)
+        return
     if args.spec:
         _run_spec_ab(args, params, model_cfg, serving)
         return
