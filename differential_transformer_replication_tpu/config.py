@@ -332,6 +332,25 @@ class ServingConfig:
     # past this bound). Entries are host numpy tables — bytes show on
     # /metrics as serving_constraint_cache_bytes.
     constraint_cache_entries: int = 32
+    # Host-RAM KV page tier (serving/host_tier.py). 0 = off. > 0 =
+    # evicted full radix pages DEMOTE into pinned host buffers up to
+    # this many bytes (own LRU) instead of vanishing, and admissions
+    # matching a demoted prefix PROMOTE it back with a host->device
+    # copy — never a recompute. Also enables mid-decode preemption:
+    # a lower class's pages stash here and resume bit-exact. int8
+    # pages (~0.53x bf16 bytes) make a few GB hold ~50x the HBM pool.
+    # Only meaningful with kv_page_size > 0.
+    host_tier_bytes: int = 0
+    # Anti-starvation aging for priority scheduling: a queued request's
+    # effective rank improves by one class per this many seconds
+    # waited, so saturating high-priority traffic cannot starve the
+    # batch class forever. 0 = no aging (strict class order).
+    priority_aging_s: float = 10.0
+    # Per-class concurrent-slot bounds, "class:N,class:N" (classes from
+    # serving/request.py:PRIORITY_CLASSES). A class at its bound stops
+    # admitting until one of its slots retires — e.g. "batch:2" keeps
+    # bulk traffic from occupying the whole pool. "" = no bounds.
+    priority_max_slots: str = ""
 
     def __post_init__(self):
         if self.decode_attention_impl not in ("", "xla", "pallas"):
@@ -407,10 +426,56 @@ class ServingConfig:
                 "constraint_cache_entries must be >= 1, got "
                 f"{self.constraint_cache_entries}"
             )
+        if self.host_tier_bytes < 0:
+            raise ValueError(
+                f"host_tier_bytes must be >= 0, got {self.host_tier_bytes}"
+            )
+        if self.priority_aging_s < 0:
+            raise ValueError(
+                f"priority_aging_s must be >= 0, got "
+                f"{self.priority_aging_s}"
+            )
+        self.priority_slot_bounds()  # validate the spec string eagerly
 
     def paged(self) -> bool:
         """Whether the engine runs the paged KV-cache subsystem."""
         return self.kv_page_size > 0
+
+    def tiered(self) -> bool:
+        """Whether the engine runs the host-RAM page tier (and with it
+        mid-decode preemption)."""
+        return self.paged() and self.host_tier_bytes > 0
+
+    def priority_slot_bounds(self) -> dict:
+        """Parsed ``priority_max_slots``: {class: max concurrent slots}.
+        Raises on unknown classes or malformed entries."""
+        bounds: dict = {}
+        if not self.priority_max_slots:
+            return bounds
+        valid = ("high", "normal", "batch")
+        for part in self.priority_max_slots.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            cls, sep, n = part.partition(":")
+            cls = cls.strip()
+            if not sep or cls not in valid:
+                raise ValueError(
+                    "priority_max_slots entries must be 'class:N' with "
+                    f"class in {valid}, got {part!r}"
+                )
+            try:
+                bound = int(n)
+            except ValueError:
+                raise ValueError(
+                    f"priority_max_slots bound must be an int, got {n!r}"
+                )
+            if bound < 1:
+                raise ValueError(
+                    f"priority_max_slots bound must be >= 1, got {bound}"
+                )
+            bounds[cls] = bound
+        return bounds
 
     def spec_enabled(self) -> bool:
         """Whether the engine runs the speculative-decoding subsystem

@@ -54,6 +54,10 @@ class LatencyObjective:
     histogram: str       # registry histogram name
     threshold_s: float   # latency bound (aligns best with a bucket edge)
     target: float        # e.g. 0.99
+    # label selector for a labeled histogram child, e.g.
+    # (("priority", "high"),) to read one priority class's ladder from
+    # serving_class_ttft_seconds. Empty = the unlabeled histogram.
+    labels: Tuple[Tuple[str, str], ...] = ()
 
     def __post_init__(self):
         if not 0.0 < self.target < 1.0:
@@ -256,7 +260,14 @@ class SLOMonitor:
         with self._lock:
             out: Dict[str, dict] = {}
             for o in self.latency:
-                snap = self.registry.histogram(o.histogram).snapshot()
+                # a labeled objective must re-fetch the histogram with
+                # the SAME labelnames tuple it was registered under
+                # (the registry enforces one tuple per name forever)
+                hist = self.registry.histogram(
+                    o.histogram,
+                    labelnames=tuple(k for k, _ in o.labels),
+                )
+                snap = hist.snapshot(**dict(o.labels))
                 bounds, cumulative = snap["buckets"], snap["cumulative"]
                 good = good_count_under(bounds, cumulative,
                                         o.threshold_s)
@@ -282,15 +293,35 @@ def default_serving_objectives(
     itl_threshold_s: float = 0.25,
     latency_target: float = 0.99,
     availability_target: float = 0.999,
+    priority_classes: Sequence[str] = ("high", "normal", "batch"),
 ) -> Tuple[List[LatencyObjective], List[AvailabilityObjective]]:
     """The serving stack's stock objectives over the engine's existing
-    metrics (serving/engine.py names), used by the server CLI knobs."""
+    metrics (serving/engine.py names), used by the server CLI knobs.
+
+    Beyond the aggregate ttft/itl objectives, one TTFT and one ITL
+    objective per priority class rides along (over the engine's
+    ``serving_class_*`` histograms), so burn rates are visible
+    per-class: under KV pressure the whole point of the priority
+    scheduler is that "high" keeps its budget while "batch" burns.
+    Classes with no traffic report no error ratio (None), so unused
+    classes never alarm. Pass ``priority_classes=()`` to disable."""
     latency = [
         LatencyObjective("ttft", "serving_ttft_seconds",
                          ttft_threshold_s, latency_target),
         LatencyObjective("itl", "serving_itl_seconds",
                          itl_threshold_s, latency_target),
     ]
+    for cls in priority_classes:
+        latency.append(LatencyObjective(
+            f"ttft_{cls}", "serving_class_ttft_seconds",
+            ttft_threshold_s, latency_target,
+            labels=(("priority", cls),),
+        ))
+        latency.append(LatencyObjective(
+            f"itl_{cls}", "serving_class_itl_seconds",
+            itl_threshold_s, latency_target,
+            labels=(("priority", cls),),
+        ))
     availability = [
         AvailabilityObjective(
             "availability",

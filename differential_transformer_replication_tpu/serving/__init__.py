@@ -20,6 +20,7 @@ _EXPORTS = {
     "ConstraintCompileError": "constrain",
     "ConstraintDeadEndError": "constrain",
     "TokenFsm": "constrain",
+    "HostTier": "host_tier",
     "Request": "request",
     "RequestOutput": "request",
     "SamplingParams": "request",
@@ -54,6 +55,9 @@ if TYPE_CHECKING:  # static analyzers see the eager imports
     from differential_transformer_replication_tpu.serving.engine import (
         EngineCrashError,
         ServingEngine,
+    )
+    from differential_transformer_replication_tpu.serving.host_tier import (
+        HostTier,
     )
     from differential_transformer_replication_tpu.serving.pages import (
         PagePool,

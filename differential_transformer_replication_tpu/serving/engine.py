@@ -177,6 +177,35 @@ _STAT_SPEC = {
         "Drafter pools rebuilt after the finite-logits guard tripped "
         "(engine fell back to non-spec decode, never garbage tokens).",
     ),
+    # host-tier / preemption (serving/host_tier.py): the graceful-
+    # degradation counters — demote/promote traffic, mid-decode
+    # preemptions and bit-exact resumes, and the typed fallbacks where
+    # a tier transfer degraded to recompute instead of wedging
+    "preemptions": (
+        "serving_preemptions_total",
+        "Mid-decode preemptions: a lower-priority request's KV pages "
+        "stashed to the host tier to unblock a higher class.",
+    ),
+    "resumes": (
+        "serving_preempt_resumes_total",
+        "Preempted requests swapped back in bit-exact from their "
+        "host-tier stash.",
+    ),
+    "tier_demotions": (
+        "serving_host_tier_demotions_total",
+        "Evicted radix pages demoted into the host-RAM tier.",
+    ),
+    "tier_promotions": (
+        "serving_host_tier_promotions_total",
+        "Host-tier pages promoted back to device at admission "
+        "(a copy, never a recompute).",
+    ),
+    "tier_fallbacks": (
+        "serving_host_tier_fallbacks_total",
+        "Tier transfers that degraded to recompute or full restart "
+        "(failed/corrupt demote, promote, or swap-in) — typed, "
+        "counted, never a wedge.",
+    ),
 }
 
 
@@ -194,8 +223,11 @@ class EngineCrashError(RuntimeError):
 def _build_step_fns(cfg: ModelConfig, rope_len: int,
                     page_size: int = 0, num_pages: int = 0,
                     lp_k: int = 5):
-    """Jitted (prefill, decode, sample, page_copy) closures for
-    (cfg, rope_len[, page geometry], logprob echo width).
+    """Jitted (prefill, decode, sample, page_copy, page_extract,
+    page_inject) closures for (cfg, rope_len[, page geometry], logprob
+    echo width). The last three are the paged path's page plumbing
+    (COW forks + the host tier's demote/promote transfers) and None on
+    the contiguous path.
 
     Cached at module level so engines with the same model/config share
     compile caches (and tests can count compiles across engine
@@ -275,6 +307,34 @@ def _build_step_fns(cfg: ModelConfig, rope_len: int,
 
         def _page_copy(cache, src, dst):
             return copy_cache_pages(cache, src, dst)
+
+        def _page_extract(cache, src):
+            """One physical page's leaves sliced out of the pool (the
+            host-tier demotion/stash capture). A scalar ``src`` take
+            REMOVES the page axis, so each leaf is exactly one page's
+            K/V image. NOT donated — the pool stays live; the engine
+            fetches the result to host numpy."""
+            return [
+                {key: jnp.take(c[key], src,
+                               axis=KV_CACHE_BATCH_AXIS[key])
+                 for key in c}
+                for c in cache
+            ]
+
+        def _page_inject(cache, dst, payload):
+            """Write one page image into physical page ``dst`` (the
+            host-tier promotion/swap-in). ``dst`` is a runtime scalar,
+            so page placement never recompiles — the same contract as
+            ``_page_copy``."""
+            out = []
+            for c, p in zip(cache, payload):
+                layer = {}
+                for key in c:
+                    axis = KV_CACHE_BATCH_AXIS[key]
+                    idx = (slice(None),) * axis + (dst,)
+                    layer[key] = c[key].at[idx].set(p[key])
+                out.append(layer)
+            return out
 
     if cfg.decode_attention_impl == "pallas":
 
@@ -413,11 +473,15 @@ def _build_step_fns(cfg: ModelConfig, rope_len: int,
             jax.jit(_decode_paged, donate_argnums=(3,) if donate else ()),
             jax.jit(_sample),
             jax.jit(_page_copy, donate_argnums=(0,) if donate else ()),
+            jax.jit(_page_extract),  # cache NOT donated: it stays live
+            jax.jit(_page_inject, donate_argnums=(0,) if donate else ()),
         )
     return (
         jax.jit(_prefill, donate_argnums=(1,) if donate else ()),
         jax.jit(_decode, donate_argnums=(4,) if donate else ()),
         jax.jit(_sample),
+        None,
+        None,
         None,
     )
 
@@ -733,15 +797,36 @@ class ServingEngine:
         # free pages; a radix tree shares cached prompt prefixes.
         self._paged = self.serving.paged()
         self._pages: Optional[PagePool] = None
+        # Host-RAM page tier (serving/host_tier.py): evicted full radix
+        # pages demote there instead of vanishing; admissions matching
+        # a demoted prefix promote it back with a copy, never a
+        # recompute. The tier also holds preempted requests' stashes.
+        self._tier = None
+        # request_id -> host-side decode snapshot of a PREEMPTED
+        # request (its KV pages live in a tier stash under the same
+        # id); consumed by the bit-exact resume path in _admit_paged
+        self._resume: dict = {}
+        # (slot, snapshot) pairs resumed by THIS step's admission gate;
+        # step() restores their decode state after plan() commits
+        self._resumed: list = []
         if self._paged:
             ps = self.serving.kv_page_size
             pool = self.serving.resolved_pool_pages(cfg)  # checks ps | M
+            if self.serving.tiered():
+                from differential_transformer_replication_tpu.serving.host_tier import (
+                    HostTier,
+                )
+
+                self._tier = HostTier(
+                    budget_bytes=self.serving.host_tier_bytes
+                )
             self._pages = PagePool(
                 page_size=ps,
                 pages_per_slot=cfg.block_size // ps,
                 num_slots=self.serving.num_slots,
                 total_pages=pool + 1,  # + the reserved trash page
                 prefix_cache=self.serving.prefix_cache,
+                tier=self._tier,
             )
         # Speculative decoding (serving/spec.py): the drafter proposes
         # up to spec_draft_len tokens per slot per iteration; the
@@ -808,7 +893,7 @@ class ServingEngine:
             }
             self._drafter_crashes_seen = 0
         (self._prefill_fn, self._decode_fn, self._sample_fn,
-         self._copy_fn) = _build_step_fns(
+         self._copy_fn, self._extract_fn, self._inject_fn) = _build_step_fns(
             cfg, self.max_total,
             page_size=self.serving.kv_page_size if self._paged else 0,
             num_pages=self._pages.total_pages if self._paged else 0,
@@ -824,6 +909,9 @@ class ServingEngine:
             on_retire=(
                 self._on_retire
                 if (self._paged or self._spec_k) else None
+            ),
+            on_preempt=(
+                self._preempt_slot if self._tier is not None else None
             ),
         )
         self._next_id = 0
@@ -880,6 +968,27 @@ class ServingEngine:
         )
         self._queue_gauge = self.registry.gauge(
             "serving_queue_depth", "Requests waiting for a slot."
+        )
+        # priority-class telemetry: per-class queue depths plus the
+        # per-class TTFT/ITL series obs/slo.py's per-class objectives
+        # evaluate — a saturating batch class cannot hide an
+        # interactive-class SLO violation inside an unlabeled series
+        self._queue_class_gauge = self.registry.gauge(
+            "serving_queue_depth_by_class",
+            "Requests waiting for a slot, by priority class.",
+            labelnames=("priority",),
+        )
+        self._class_ttft_hist = self.registry.histogram(
+            "serving_class_ttft_seconds",
+            "Time from submit to first generated token, by priority "
+            "class.",
+            labelnames=("priority",),
+        )
+        self._class_itl_hist = self.registry.histogram(
+            "serving_class_itl_seconds",
+            "Inter-token latency between consecutive generated tokens, "
+            "by priority class.",
+            labelnames=("priority",),
         )
         # quantization-aware capacity telemetry: the per-slot HBM cost of
         # KV state (int8 roughly halves it vs bf16 — the dashboards'
@@ -940,6 +1049,50 @@ class ServingEngine:
                 "HBM bytes per physical KV page across all layers "
                 "(int8-aware: values + fp32 scale planes).",
             ).set(page_bytes(cfg, self.serving.kv_page_size))
+            self._tier_prefix_hits_counter = self.registry.counter(
+                "serving_host_tier_prefix_hits_total",
+                "Admissions whose prefix match extended into the "
+                "host tier (promoted, never recomputed).",
+            )
+        # host-tier telemetry: byte/entry gauges plus the tier's locked
+        # counters, mirrored on every gauge refresh (the page-pool
+        # pattern) — the "Serving under memory pressure" runbook's
+        # dashboard surface
+        if self._tier is not None:
+            self.registry.gauge(
+                "serving_host_tier_budget_bytes",
+                "Configured host-RAM byte budget of the KV page tier.",
+            ).set(self.serving.host_tier_bytes)
+            self._tier_bytes_gauge = self.registry.gauge(
+                "serving_host_tier_bytes",
+                "Host bytes currently held by the KV page tier "
+                "(cached prefixes + pinned preemption stashes).",
+            )
+            self._tier_entries_gauge = self.registry.gauge(
+                "serving_host_tier_entries",
+                "Demoted prefix pages currently cached in the host tier.",
+            )
+            self._tier_stashes_gauge = self.registry.gauge(
+                "serving_host_tier_stashes",
+                "Preempted requests with KV stashed in the host tier.",
+            )
+            self._tier_hits_counter = self.registry.counter(
+                "serving_host_tier_hits_total",
+                "Host-tier prefix lookups that hit a demoted page.",
+            )
+            self._tier_misses_counter = self.registry.counter(
+                "serving_host_tier_misses_total",
+                "Host-tier prefix lookups that missed.",
+            )
+            self._tier_evictions_counter = self.registry.counter(
+                "serving_host_tier_evictions_total",
+                "Cached tier pages LRU-evicted under the byte budget.",
+            )
+            self._tier_corrupt_counter = self.registry.counter(
+                "serving_host_tier_corrupt_total",
+                "Tier page images whose CRC32 verify failed (dropped "
+                "and recomputed, never injected).",
+            )
         # speculative-decoding telemetry: the aggregate proposed/
         # accepted counters ride _STAT_SPEC (so /health and /metrics
         # can never disagree); the acceptance-rate gauge and the
@@ -1137,6 +1290,7 @@ class ServingEngine:
         self.scheduler.cancel(request_id)
         del self._base_keys[request_id]
         self._drop_constraint(request_id)
+        self._drop_resume(request_id)
         self.stats.inc("cancelled")
         self._finished_counter.inc(reason="cancelled")
         return True
@@ -1207,10 +1361,40 @@ class ServingEngine:
                 # scheduler commits a slot to it
                 admit = (
                     lambda slot, entry: self._admit_paged(
-                        slot, entry, finished
+                        slot, entry, iteration, finished
                     )
                 )
             chunks = self.scheduler.plan(admit=admit)
+
+        if self._resumed:
+            # requests swapped back in by this plan's admission gate:
+            # restore the host-side decode state snapshotted at
+            # preemption — the device KV was re-injected bit-exact
+            # above, so generation continues as if never interrupted
+            # (pinned by tests/test_tiering.py). plan() committed the
+            # slot as a fresh PREFILL with filled == prompt_len, so no
+            # prefill chunks were planned for it.
+            for slot, snap in self._resumed:
+                slot.generated = list(snap["generated"])
+                slot.token_times = list(snap["token_times"])
+                slot.first_token_time = snap["first_token_time"]
+                slot.filled = snap["filled"]
+                slot.cached_len = snap["cached_len"]
+                slot.spec_proposed = snap["spec_proposed"]
+                slot.spec_accepted = snap["spec_accepted"]
+                slot.prompt_ids = snap["prompt_ids"]
+                slot.penalty_counts = snap["penalty_counts"]
+                slot.token_logprobs = snap["token_logprobs"]
+                slot.top_logprobs = snap["top_logprobs"]
+                ent = self._constraints.get(slot.request.request_id)
+                if ent is not None:
+                    # attach the FSM directly — _slot_fsm's lazy path
+                    # would RESET the cursor to the FSM's start state
+                    slot.constraint = ent[1]
+                    slot.fsm_state = snap["fsm_state"]
+                slot.state = ACTIVE
+                self._resume.pop(slot.request.request_id, None)
+            self._resumed = []
 
         if chunks:
             with self.tracer.span(
@@ -1674,6 +1858,8 @@ class ServingEngine:
         occupied = self.scheduler.occupied()
         self._slot_gauge.set(occupied)
         self._queue_gauge.set(self.scheduler.queue_len())
+        for cls, depth in self.scheduler.queue_depths().items():
+            self._queue_class_gauge.set(depth, priority=cls)
         # structured-decoding mirror (BOTH cache layouts — keep it
         # ahead of the paged early-return below)
         self._constrained_gauge.set(len(self._constraints))
@@ -1696,6 +1882,16 @@ class ServingEngine:
             self._prefix_hits_counter.set(st["hits_total"])
             self._prefix_misses_counter.set(st["misses_total"])
             self._prefix_evictions_counter.set(st["evictions_total"])
+            self._tier_prefix_hits_counter.set(st["tier_hits_total"])
+            if self._tier is not None:
+                ts = self._tier.stats()
+                self._tier_bytes_gauge.set(ts["bytes"])
+                self._tier_entries_gauge.set(ts["entries"])
+                self._tier_stashes_gauge.set(ts["stashes"])
+                self._tier_hits_counter.set(ts["hits_total"])
+                self._tier_misses_counter.set(ts["misses_total"])
+                self._tier_evictions_counter.set(ts["evictions_total"])
+                self._tier_corrupt_counter.set(ts["corrupt_total"])
             held = sum(
                 min(s.filled + len(s.generated), self.cfg.block_size)
                 for s in self.scheduler.slots if s.state != FREE
@@ -1717,6 +1913,27 @@ class ServingEngine:
         contiguous path): total/free/cached pages plus the monotonic
         prefix-cache counters (serving/pages.py:PagePool.stats)."""
         return None if self._pages is None else self._pages.stats()
+
+    def tier_stats(self) -> Optional[dict]:
+        """Point-in-time host-tier snapshot for /health (None when the
+        tier is off): byte budget/usage, cached entries and pinned
+        stashes, the tier's locked hit/miss/eviction/corrupt/rejected
+        counters (serving/host_tier.py:HostTier.stats), plus the
+        engine-side demote/promote/preempt/resume/fallback totals."""
+        if self._tier is None:
+            return None
+        out = dict(self._tier.stats())
+        out["demotions"] = self.stats["tier_demotions"]
+        out["promotions"] = self.stats["tier_promotions"]
+        out["fallbacks"] = self.stats["tier_fallbacks"]
+        out["preemptions"] = self.stats["preemptions"]
+        out["resumes"] = self.stats["resumes"]
+        return out
+
+    def queue_depths(self) -> dict:
+        """Admission-queue depth by priority class (every class
+        present, zero-filled) — the /health per-class view."""
+        return self.scheduler.queue_depths()
 
     def constrain_stats(self) -> dict:
         """Point-in-time structured-decoding snapshot for /health:
@@ -1784,6 +2001,12 @@ class ServingEngine:
         }
         if self._copy_fn is not None:
             out["page_copy"] = self._copy_fn._cache_size()
+        if self._extract_fn is not None:
+            # the host tier's transfer closures: scalar page indices
+            # ride as runtime arrays, so demote/promote/preempt/resume
+            # churn pins each at 1 entry (tests/test_tiering.py)
+            out["page_extract"] = self._extract_fn._cache_size()
+            out["page_inject"] = self._inject_fn._cache_size()
         if self._spec_fn is not None:
             # the k rung of the verify ladder (both accept variants);
             # "decode" above is the k=0 rung — together they are THE
@@ -1804,27 +2027,48 @@ class ServingEngine:
 
     # -- paged admission / release (serving/pages.py) ------------------
 
-    def _admit_paged(self, slot: Slot, entry,
+    def _admit_paged(self, slot: Slot, entry, iteration: int,
                      finished: List[RequestOutput]) -> Optional[int]:
-        """Scheduler admission gate: plan the head-of-line request
-        against the radix cache + page pool. Returns the cached prefix
-        length to skip (>= 0), None to keep it queued (transient page
-        shortage, FCFS head-of-line), or -1 after shedding it with the
-        typed :class:`PagePoolExhaustedError` output."""
+        """Scheduler admission gate: plan the selected request against
+        the radix cache + page pool (and, when tiered, the host tier).
+        Returns the cached/restored prefix length to skip (>= 0), None
+        to keep it queued (transient page shortage — the scheduler may
+        preempt a lower class on this verdict and retry), or -1 after
+        shedding it with the typed :class:`PagePoolExhaustedError`
+        output."""
         request, prompt, t_submit, _deadline, trace = entry
+        if request.request_id in self._resume:
+            verdict = self._try_resume(slot, entry, iteration)
+            if verdict == "wait":
+                return None
+            if verdict == "ok":
+                # the full KV image (prompt AND generated) was
+                # re-injected: nothing to prefill
+                return int(prompt.shape[0])
+            # "restart": the stash was unusable — fall through to a
+            # fresh admission; fold_in(key, t) token keys make the
+            # recomputed output bit-identical to the uninterrupted run
         try:
             adm = self._pages.plan_admission(
                 slot.index, [int(t) for t in prompt],
                 request.params.max_new_tokens,
             )
         except PagePoolExhaustedError:
+            self._drain_demotions(iteration)
             finished.append(
                 self._shed_page_exhausted(request, prompt, t_submit,
                                           trace)
             )
             return -1
+        # demotion plans from this planning call's evictions MUST be
+        # captured before any copy/promote/prefill could overwrite the
+        # freed physical pages (serving/pages.py:take_demotions)
+        self._drain_demotions(iteration)
         if adm is None:
             return None
+        cached = adm.cached_len
+        if adm.promotes:
+            cached = self._apply_promotes(adm, iteration)
         for src, dst in adm.copies:
             # COW fork: the shared page's prefix K/V lands on a page
             # this slot privately owns; applied BEFORE any further
@@ -1832,7 +2076,185 @@ class ServingEngine:
             self.cache = self._copy_fn(
                 self.cache, np.int32(src), np.int32(dst)
             )
-        return adm.cached_len
+        return cached
+
+    # -- host tier: demote / promote / preempt / resume ----------------
+    # (serving/host_tier.py; all single-engine-thread, pool lock ->
+    # tier lock order per GL601)
+
+    def _extract_page(self, page: int) -> list:
+        """One physical page's device bytes as OWNED, writable host
+        numpy (per-layer leaf dicts) — the capture side of demotion
+        and preemption stashing. ``np.array`` (not ``asarray``): the
+        tier checksums the buffer and the swap-corrupt fault flips a
+        byte in place, so the copy must not alias device memory."""
+        out = self._extract_fn(self.cache, np.int32(page))
+        return [
+            {key: np.array(leaf) for key, leaf in layer.items()}
+            for layer in out
+        ]
+
+    def _inject_page(self, page: int, payload) -> bool:
+        """Write one host page image into physical page ``page`` (the
+        promote/swap-in transfer), retried with a short backoff —
+        a transient device_put failure degrades to recompute at the
+        caller, never a wedge."""
+        for attempt in range(3):
+            try:
+                self.cache = self._inject_fn(
+                    self.cache, np.int32(page), payload
+                )
+                return True
+            except Exception:
+                if attempt == 2:
+                    return False
+                time.sleep(0.005 * (attempt + 1))
+        return False
+
+    def _drain_demotions(self, iteration: int) -> None:
+        """Capture the pool's pending demotion plans into the host
+        tier. Runs immediately after EVERY pool planning call (success
+        or not): the freed pages' device bytes are still the evicted
+        prefix until a later planning call hands them back out. A
+        failed capture (the ``page_demote_fail`` fault) just skips the
+        tier — the prefix degrades to recompute, typed and counted."""
+        if self._tier is None:
+            return
+        plans = self._pages.take_demotions()
+        if not plans:
+            return
+        if faults.page_demote_fail_at(iteration):
+            self.stats.inc("tier_fallbacks", len(plans))
+            return
+        for prefix, page in plans:
+            if self._tier.put(prefix, self._extract_page(page)):
+                self.stats.inc("tier_demotions")
+
+    def _apply_promotes(self, adm, iteration: int) -> int:
+        """Stage an admission's host-tier pages back onto the device
+        (a copy, never a recompute). Pages apply in prompt order; the
+        first failed verify/inject truncates the restored prefix there
+        — the remainder simply prefills. The ``page_promote_hang``
+        fault stalls (DTX_TIER_HANG_S) then fails every promote."""
+        ps = self.serving.kv_page_size
+        ok_pages = 0
+        if not faults.page_promote_hang_at(iteration):
+            for dst, ent in adm.promotes:
+                if not ent.verify():
+                    self._tier.note_corrupt()
+                    break
+                if not self._inject_page(int(dst), ent.payload):
+                    break
+                ok_pages += 1
+        if ok_pages:
+            self.stats.inc("tier_promotions", ok_pages)
+        if ok_pages < len(adm.promotes):
+            self.stats.inc(
+                "tier_fallbacks", len(adm.promotes) - ok_pages
+            )
+        return adm.device_cached + ok_pages * ps
+
+    def _preempt_slot(self, slot: Slot) -> None:
+        """Scheduler preemption hook (plan()'s blocked-admission path):
+        stash an ACTIVE lower-priority slot's live KV pages and host
+        decode state to the tier, free its pages, and REQUEUE it with
+        its ORIGINAL submit_time so anti-starvation aging keeps
+        accruing. The later swap-in (:meth:`_try_resume`) is bit-exact
+        — no recompute, no recompile."""
+        rid = slot.request.request_id
+        ps = self.serving.kv_page_size
+        # pages actually written so far: after emitting g tokens the
+        # device KV covers positions 0..P+g-2 (the last token's KV is
+        # written by its NEXT step); ceil((P+g)/ps) over-covers that
+        # and never exceeds the slot's allocation
+        pos = slot.prompt_len + len(slot.generated)
+        n_live = min(-(-pos // ps), self._pages.pages_per_slot)
+        row = self._pages.table_row(slot.index)
+        payloads = [
+            self._extract_page(int(row[j])) for j in range(n_live)
+        ]
+        self._tier.stash(rid, payloads)
+        self._resume[rid] = {
+            "n_live": n_live,
+            "generated": list(slot.generated),
+            "token_times": list(slot.token_times),
+            "first_token_time": slot.first_token_time,
+            "filled": slot.filled,
+            "cached_len": slot.cached_len,
+            "spec_proposed": slot.spec_proposed,
+            "spec_accepted": slot.spec_accepted,
+            "prompt_ids": slot.prompt_ids,
+            "penalty_counts": slot.penalty_counts,
+            "token_logprobs": slot.token_logprobs,
+            "top_logprobs": slot.top_logprobs,
+            "fsm_state": slot.fsm_state,
+        }
+        self.scheduler.queue.append(
+            (slot.request, slot.prompt, slot.submit_time,
+             slot.deadline, slot.trace)
+        )
+        self._pages.release(slot.index, [], False)
+        if self._drafter is not None:
+            self._drafter.release(slot.index)
+        self.stats.inc("preemptions")
+        # reset directly, NOT scheduler.retire: the retire hook would
+        # release the slot's pages a second time
+        slot.reset()
+
+    def _try_resume(self, slot: Slot, entry, iteration: int) -> str:
+        """Swap a preempted request back in: reserve private pages for
+        its FULL KV image and inject the stash, checksum-verified.
+        Returns "wait" (pool cannot free enough yet — the scheduler
+        may preempt for it), "ok" (resumed bit-exact; step() restores
+        the host state after plan() commits), or "restart" (stash
+        unusable — degrade to a bit-exact full recompute, typed and
+        counted)."""
+        request, prompt, _t_submit, _deadline, _trace = entry
+        rid = request.request_id
+        snap = self._resume[rid]
+        pages = self._pages.plan_resume(
+            slot.index,
+            self._pages.pages_needed(
+                int(prompt.shape[0]), request.params.max_new_tokens
+            ),
+        )
+        self._drain_demotions(iteration)
+        if pages is None:
+            return "wait"
+        ents = self._tier.unstash(rid)
+        ok = ents is not None
+        if ok and faults.page_swap_corrupt_at(iteration):
+            # flip one byte of the first payload leaf in place: the
+            # CRC verify below must catch it and degrade to restart
+            layer0 = ents[0].payload[0]
+            leaf = layer0[next(iter(layer0))]
+            leaf.reshape(-1).view(np.uint8)[0] ^= 0xFF
+        if ok:
+            for pg, ent in zip(pages, ents):
+                if not ent.verify():
+                    self._tier.note_corrupt()
+                    ok = False
+                    break
+                if not self._inject_page(int(pg), ent.payload):
+                    ok = False
+                    break
+        if not ok:
+            self._pages.release(slot.index, [], False)
+            self._resume.pop(rid, None)
+            self._tier.drop_stash(rid)
+            self.stats.inc("tier_fallbacks")
+            return "restart"
+        self._resumed.append((slot, snap))
+        self.stats.inc("resumes")
+        return "ok"
+
+    def _drop_resume(self, request_id: int) -> None:
+        """Forget a preempted request's swap-in state on every path
+        that forgets its key chain (cancel, expire, shed, crash loss)
+        — a leaked stash would pin host-tier bytes forever."""
+        self._resume.pop(request_id, None)
+        if self._tier is not None:
+            self._tier.drop_stash(request_id)
 
     def _release_slot_pages(self, slot: Slot) -> None:
         """Scheduler retirement hook (every retire path: finish,
@@ -1856,6 +2278,7 @@ class ServingEngine:
         device."""
         self._base_keys.pop(request.request_id, None)
         self._drop_constraint(request.request_id)
+        self._drop_resume(request.request_id)
         self.stats.inc("page_shed")
         self._finished_counter.inc(reason="page_exhausted")
         if self._tracing:
@@ -1864,6 +2287,16 @@ class ServingEngine:
                 reason="page_exhausted",
                 **(instant_args(trace) if trace is not None else {}),
             )
+        # Retry-After from the pool's OBSERVED drain rate: seconds
+        # until enough pages free for THIS request at the recent
+        # eviction/release throughput, instead of a static guess —
+        # serving/retry.py honors it as the client backoff floor and
+        # the server echoes it in the 503's Retry-After header
+        retry_after = self._pages.estimated_drain_s(
+            self._pages.pages_needed(
+                len(prompt), request.params.max_new_tokens
+            )
+        )
         return RequestOutput(
             request_id=request.request_id,
             prompt=[int(t) for t in prompt],
@@ -1874,6 +2307,7 @@ class ServingEngine:
             finish_time=time.perf_counter(),
             token_times=[],
             trace_id=trace.trace_id if trace is not None else None,
+            retry_after=retry_after,
         )
 
     def _corrupt_cached_prefix(self) -> None:
@@ -2082,6 +2516,10 @@ class ServingEngine:
             slot.first_token_time = now
             slot.state = ACTIVE
             self._ttft_hist.observe(now - slot.submit_time)
+            self._class_ttft_hist.observe(
+                now - slot.submit_time,
+                priority=slot.request.params.priority,
+            )
             if self._tracing:
                 self.tracer.instant(
                     "first_token", rid=slot.request.request_id,
@@ -2090,6 +2528,10 @@ class ServingEngine:
                 )
         elif prev_token_t is not None:
             self._itl_hist.observe(now - prev_token_t)
+            self._class_itl_hist.observe(
+                now - prev_token_t,
+                priority=slot.request.params.priority,
+            )
         p = slot.request.params
         eos = (
             p.eos_token_id
@@ -2189,6 +2631,7 @@ class ServingEngine:
         it never touches the device; the caller gets a typed error."""
         self._base_keys.pop(request.request_id, None)
         self._drop_constraint(request.request_id)
+        self._drop_resume(request.request_id)
         self.stats.inc("deadline_expired")
         self._finished_counter.inc(reason="deadline")
         if self._tracing:
@@ -2281,7 +2724,17 @@ class ServingEngine:
                 lost.append(rid)
                 self._base_keys.pop(rid, None)
                 self._drop_constraint(rid)
+                self._drop_resume(rid)
         preserved = list(self.scheduler.queue)
+        self._resumed = []
+        if self._tier is not None:
+            # host-cached prefixes are as untrusted as the device pool
+            # they were captured from (a poisoned page demotes with a
+            # VALID checksum — the CRC guards torn transfers, not
+            # upstream corruption). Preempted requests' stashes
+            # SURVIVE: their owners ride the preserved queue and
+            # resume bit-exact on the rebuilt engine.
+            self._tier.clear_cache()
         if self._paged:
             # fresh page pool AND an empty radix cache: untrusted KV
             # includes every cached prefix (the poisoned-prefix fault's
@@ -2303,6 +2756,9 @@ class ServingEngine:
             on_retire=(
                 self._on_retire
                 if (self._paged or self._spec_k) else None
+            ),
+            on_preempt=(
+                self._preempt_slot if self._tier is not None else None
             ),
         )
         self.scheduler.queue.extend(preserved)

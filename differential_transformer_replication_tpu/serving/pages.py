@@ -57,6 +57,7 @@ carries 1-byte values plus the fp32 per-vector scale planes
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -74,12 +75,23 @@ class PagePoolExhaustedError(RuntimeError):
 
 @dataclass
 class Admission:
-    """One planned admission: how much prefill the radix cache already
-    covers, and the device copies the engine must apply (COW forks)."""
+    """One planned admission: how much prefill the prefix cache covers,
+    the device copies the engine must apply (COW forks), and the
+    host-tier pages to promote. ``cached_len`` counts DEVICE-resident
+    tokens plus every planned promotion; ``device_cached`` counts only
+    the device-resident part — when a promotion fails mid-apply the
+    engine truncates its effective cached length back toward
+    ``device_cached`` (recompute fallback, never garbage KV)."""
 
-    cached_len: int  # prompt tokens whose KV is already resident
+    cached_len: int  # prompt tokens covered, promotions included
     copies: List[Tuple[int, int]] = field(default_factory=list)
     hit: bool = False
+    # host-tier promotions: (dst physical page, TierEntry) per promoted
+    # full page, in prompt order starting at device_cached. The payload
+    # was fetched (and checksum-verified) at plan time; the engine
+    # re-verifies at injection and degrades to recompute on mismatch.
+    promotes: List[Tuple[int, object]] = field(default_factory=list)
+    device_cached: int = 0  # tokens already resident in HBM
 
 
 class _Node:
@@ -144,10 +156,13 @@ class PagePool:
     lock (graftlint GL602)."""
 
     TRASH = 0  # reserved physical page: unallocated / inactive writes
+    # observation window for the page drain-rate estimate behind
+    # PagePoolExhaustedError's Retry-After (estimated_drain_s)
+    DRAIN_WINDOW_S = 30.0
 
     def __init__(self, *, page_size: int, pages_per_slot: int,
                  num_slots: int, total_pages: int,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, tier=None):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         if total_pages < pages_per_slot + 2:
@@ -162,6 +177,12 @@ class PagePool:
         self.total_pages = total_pages
         self.capacity = total_pages - 1  # page 0 is the trash page
         self.prefix_cache = prefix_cache
+        # optional host-RAM page tier (serving/host_tier.py): evicted
+        # full radix pages demote there instead of vanishing, and
+        # admission planning consults it past the device match. Lock
+        # order is PagePool._lock -> HostTier._lock (GL601): the tier
+        # never calls back into the pool.
+        self._tier = tier
         self._lock = threading.Lock()
         self._clock = 0
         self._force_exhausted = False
@@ -188,9 +209,23 @@ class PagePool:
         ]
         self._root = _Node((), self.TRASH, None, 0)  # graftlint: threadsafe (_locked helper: every caller holds self._lock)
         self._nodes: List[_Node] = []
+        # demotion plans awaiting the engine: (full token prefix, page)
+        # per evicted full page. The engine drains this IMMEDIATELY
+        # after every planning call — before applying copies/promotes
+        # and before any prefill — so the page's device bytes are still
+        # the evicted prefix when captured. A pool reset discards them
+        # (the device data is untrusted after a crash).
+        self._pending_demotions: List[Tuple[tuple, int]] = []  # graftlint: threadsafe (_locked helper: every caller holds self._lock)
+        # recent page-free events (monotonic timestamp, count) — the
+        # observed drain throughput behind estimated_drain_s(), which
+        # turns PagePoolExhaustedError's Retry-After into a measure of
+        # actual pool drain time instead of a static queue bound
+        if not hasattr(self, "_freed_log"):
+            self._freed_log: List[Tuple[float, int]] = []  # graftlint: threadsafe (_locked helper: every caller holds self._lock)
         # monotonic counters (prometheus semantics) survive reset —
         # a crash-rebuild must not zero the fleet's hit-rate series
-        for name in ("hits", "misses", "evictions", "cow_forks"):
+        for name in ("hits", "misses", "evictions", "cow_forks",
+                     "tier_hits"):
             if not hasattr(self, "_" + name):
                 setattr(self, "_" + name, 0)
 
@@ -255,6 +290,28 @@ class PagePool:
             matched = 0
             if self.prefix_cache and not rolls:
                 full, fork, matched = self._match_locked(prompt)
+            device_cached = len(full) * ps
+            # host-tier extension: where the device walk ended, keep
+            # matching FULL pages against demoted prefixes. Payloads
+            # are fetched (and checksum-verified) NOW, under the pool
+            # lock (pool -> tier order, GL601), so a later tier
+            # eviction cannot tear this plan. A tier hit supersedes a
+            # partial COW fork at the same logical page — a full page
+            # strictly dominates a partial one.
+            tier_entries: List[object] = []
+            if (self._tier is not None and self.prefix_cache
+                    and not rolls):
+                j = len(full)
+                while (j + 1) * ps <= len(prompt) - 1:
+                    ent = self._tier.get(tuple(prompt[:(j + 1) * ps]))
+                    if ent is None:
+                        break
+                    tier_entries.append(ent)
+                    j += 1
+                if tier_entries:
+                    fork = None
+                    matched = j * ps
+                    self._tier_hits += 1
             # pin the matched chain before eviction runs: a refs==0
             # cached node we are about to share must not be evicted to
             # satisfy our own reservation
@@ -288,12 +345,20 @@ class PagePool:
             if fork is not None:
                 copies.append((fork[0].page, pages[0]))
                 self._cow_forks += 1
+            # promoted pages land on the slot's FIRST private pages
+            # (logical indices len(full)..): injected there they are
+            # ordinary private prefix KV, donated back to the radix
+            # tree at release like any prefilled page
+            promotes = [
+                (pages[t], ent) for t, ent in enumerate(tier_entries)
+            ]
             if matched > 0:
                 self._hits += 1
             else:
                 self._misses += 1
             return Admission(cached_len=matched, copies=copies,
-                             hit=matched > 0)
+                             hit=matched > 0, promotes=promotes,
+                             device_cached=device_cached)
 
     def _match_locked(self, prompt: Sequence[int]):
         """Longest cached prefix of ``prompt``, capped at
@@ -350,11 +415,95 @@ class PagePool:
                     victim = node
         if victim is None:
             return False
+        if self._tier is not None and victim.filled == self.page_size:
+            # demote instead of forget: plan a host capture of the
+            # evicted FULL page (partial tails are rare — one per
+            # prompt — and stay plain evictions). The HBM page is
+            # freed either way; the engine captures its still-intact
+            # bytes when it drains the plan, before any reuse writes.
+            self._pending_demotions.append(  # graftlint: threadsafe (_locked helper: every caller holds self._lock)
+                (self._node_prefix(victim), victim.page)
+            )
         del victim.parent.children[victim.key]
         self._nodes.remove(victim)
         self._free.append(victim.page)
+        self._note_freed_locked(1)
         self._evictions += 1  # graftlint: threadsafe (_locked helper: every caller holds self._lock)
         return True
+
+    @staticmethod
+    def _node_prefix(node: _Node) -> tuple:
+        """The full token prefix a node's page covers (root -> node key
+        concatenation) — the host tier's lookup key."""
+        parts = []
+        while node is not None and node.key:
+            parts.append(node.key)
+            node = node.parent
+        out: List[int] = []
+        for key in reversed(parts):
+            out.extend(key)
+        return tuple(out)
+
+    def _note_freed_locked(self, n: int) -> None:
+        """Record page-free events for the drain-rate estimate; the
+        log is pruned to the observation window on every append."""
+        now = time.monotonic()
+        self._freed_log.append((now, n))  # graftlint: threadsafe (_locked helper: every caller holds self._lock)
+        cutoff = now - self.DRAIN_WINDOW_S
+        while self._freed_log and self._freed_log[0][0] < cutoff:
+            self._freed_log.pop(0)
+
+    def plan_resume(self, slot: int,
+                    total_pages: int) -> Optional[List[int]]:
+        """Reserve PRIVATE pages for a preempted request swapping back
+        in: no radix matching — the request's full KV image (prompt
+        AND generated tokens) is injected from its host-tier stash, so
+        every page is privately owned from the start. Returns the
+        allocated pages in logical order, or None when the pool cannot
+        free enough right now (the request stays queued; the priority
+        scheduler may preempt a lower class to make room)."""
+        with self._lock:
+            pages = self._take_pages_locked(total_pages)
+            if pages is None:
+                return None
+            row = self._np.zeros(self.pages_per_slot, self._np.int32)
+            for j, pg in enumerate(pages):
+                row[j] = pg
+            self._tables[slot] = row
+            self._slot_nodes[slot] = []
+            self._slot_private[slot] = list(pages)
+            return pages
+
+    def take_demotions(self) -> List[Tuple[tuple, int]]:
+        """Drain the pending demotion plans (prefix key, freed page).
+        The engine MUST call this immediately after EVERY planning call
+        (plan_admission / plan_resume, success or not) and capture the
+        named pages' device bytes before applying any copy, promote or
+        prefill — freed pages are only ever handed back out by later
+        planning calls on the same single engine thread, so the bytes
+        are still the evicted prefix at capture time."""
+        with self._lock:
+            out, self._pending_demotions = self._pending_demotions, []
+            return out
+
+    def estimated_drain_s(self, pages_needed: int) -> Optional[float]:
+        """Seconds until ``pages_needed`` pages drain at the observed
+        free rate (evictions + releases over the last DRAIN_WINDOW_S)
+        — the Retry-After a shed request should back off for. None
+        when nothing freed recently (no basis for an estimate; callers
+        fall back to their static default)."""
+        with self._lock:
+            if not self._freed_log:
+                return None
+            now = time.monotonic()
+            cutoff = now - self.DRAIN_WINDOW_S
+            freed = sum(n for t, n in self._freed_log if t >= cutoff)
+            if freed <= 0:
+                return None
+            oldest = max(self._freed_log[0][0], cutoff)
+            elapsed = max(now - oldest, 1e-3)
+            rate = freed / elapsed
+            return max(pages_needed, 1) / rate
 
     # -- release / cache insertion ------------------------------------
 
@@ -380,9 +529,13 @@ class PagePool:
             donated: List[int] = []
             if cacheable and self.prefix_cache and len(prompt) > 0:
                 donated = self._insert_locked(prompt, row, shared_full)
+            freed = 0
             for pg in private:
                 if pg not in donated:
                     self._free.append(pg)
+                    freed += 1
+            if freed:
+                self._note_freed_locked(freed)
 
     def _insert_locked(self, prompt: Sequence[int], row,
                        shared_full: int) -> List[int]:
@@ -450,6 +603,7 @@ class PagePool:
                 "hits_total": self._hits,
                 "misses_total": self._misses,
                 "evictions_total": self._evictions,
+                "tier_hits_total": self._tier_hits,
                 "page_size": self.page_size,
                 "pages_per_slot": self.pages_per_slot,
             }

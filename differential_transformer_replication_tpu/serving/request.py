@@ -17,6 +17,12 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+# Priority classes, best-first. The scheduler (serving/scheduler.py)
+# admits by effective rank = PRIORITY_RANK[class] - age/priority_aging_s,
+# so a starved batch request eventually outranks fresh high traffic.
+PRIORITY_CLASSES = ("high", "normal", "batch")
+PRIORITY_RANK = {c: i for i, c in enumerate(PRIORITY_CLASSES)}
+
 
 @dataclass(frozen=True)
 class SamplingParams:
@@ -68,6 +74,12 @@ class SamplingParams:
     # logprob plus the top-N (id, logprob) alternatives per emitted
     # token, capped by ServingConfig.max_logprobs.
     logprobs: int = 0
+    # Priority class (PRIORITY_CLASSES): "high" = interactive traffic
+    # the scheduler admits first and never preempts; "batch" = bulk
+    # traffic that yields its pages (mid-decode preemption to the host
+    # tier) when higher classes are blocked on the pool. Anti-starvation
+    # aging (ServingConfig.priority_aging_s) guarantees batch progress.
+    priority: str = "normal"
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -167,6 +179,11 @@ class SamplingParams:
                 f"logprobs must be a non-negative int, got "
                 f"{self.logprobs!r}"
             )
+        if self.priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"priority must be one of {PRIORITY_CLASSES}, got "
+                f"{self.priority!r}"
+            )
 
     @property
     def constrained(self) -> bool:
@@ -240,6 +257,11 @@ class RequestOutput:
     # sampled from. None when the request did not ask for logprobs.
     token_logprobs: Optional[List[float]] = None
     top_logprobs: Optional[List[List[tuple]]] = None
+    # Backoff hint for shed requests (finish_reason "page_exhausted"):
+    # seconds until the pool is expected to drain enough pages, from
+    # PagePool.estimated_drain_s (observed eviction/release throughput).
+    # None = no estimate; HTTP Retry-After falls back to queue bounds.
+    retry_after: Optional[float] = None
 
     @property
     def ttft(self) -> float:

@@ -23,14 +23,19 @@ Device work (the actual chunk/decode calls) lives in serving/engine.py.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from differential_transformer_replication_tpu.config import ServingConfig
-from differential_transformer_replication_tpu.serving.request import Request
+from differential_transformer_replication_tpu.serving.request import (
+    PRIORITY_CLASSES,
+    PRIORITY_RANK,
+    Request,
+)
 
 FREE = "free"
 PREFILL = "prefill"
@@ -150,13 +155,21 @@ def _pow2_chunk(n: int, cap: int) -> int:
 class Scheduler:
     """FCFS queue + slot pool bookkeeping (see module docstring)."""
 
-    def __init__(self, serving: ServingConfig, on_retire=None):
+    def __init__(self, serving: ServingConfig, on_retire=None,
+                 on_preempt=None):
         self.serving = serving
         # retirement hook: called with the slot BEFORE it resets, on
         # EVERY retire path (finish, deadline, cancel) — how the paged
         # engine returns KV pages / inserts prompts into the radix
         # cache (serving/engine.py:_release_slot_pages). None = no-op.
         self.on_retire = on_retire
+        # preemption hook (serving/engine.py:_preempt_slot, set only
+        # when the host tier is on): called with an ACTIVE victim slot
+        # when a strictly better-ranked request is blocked on pages.
+        # The engine stashes the victim's KV to the host tier, releases
+        # its pages, REQUEUES it (original submit_time, so aging keeps
+        # accruing) and resets the slot. None = no preemption.
+        self.on_preempt = on_preempt
         self.slots = [Slot(index=i) for i in range(serving.num_slots)]
         # (request, cropped prompt, submit_time, deadline, trace) —
         # deadline is an absolute perf_counter() timestamp, 0.0 = none;
@@ -211,6 +224,14 @@ class Scheduler:
     def queue_len(self) -> int:
         return len(self.queue)
 
+    def queue_depths(self) -> Dict[str, int]:
+        """Waiting requests per priority class — the per-class queue
+        depth EngineRunner surfaces on /health and /metrics."""
+        depths = {c: 0 for c in PRIORITY_CLASSES}
+        for e in self.queue:
+            depths[e[0].params.priority] += 1
+        return depths
+
     def free_slots(self) -> List[Slot]:
         return [s for s in self.slots if s.state == FREE]
 
@@ -251,6 +272,35 @@ class Scheduler:
 
     # -- the per-iteration decision -----------------------------------
 
+    def _effective_rank(self, priority: str, submit_time: float,
+                        now: float) -> float:
+        """Class rank with anti-starvation aging: every
+        ``priority_aging_s`` seconds waited improves the rank by one
+        class, so a starved batch request eventually outranks fresh
+        high-priority traffic (bounded starvation by construction)."""
+        rank = float(PRIORITY_RANK.get(priority, 1))
+        aging = self.serving.priority_aging_s
+        if aging > 0:
+            rank -= int(max(now - submit_time, 0.0) / aging)
+        return rank
+
+    def _preempt_victim(self, blocked_rank: float,
+                        now: float) -> Optional[Slot]:
+        """The ACTIVE slot with the WORST effective rank, provided it
+        is STRICTLY worse than the blocked request's — equal-class
+        peers never preempt each other, so all-one-class traffic
+        degrades exactly like the pre-priority FCFS engine."""
+        worst, worst_rank = None, blocked_rank
+        for s in self.slots:
+            if s.state != ACTIVE:
+                continue
+            r = self._effective_rank(
+                s.request.params.priority, s.submit_time, now
+            )
+            if r > worst_rank:
+                worst, worst_rank = s, r
+        return worst
+
     def plan(self, admit=None) -> List[Tuple[Slot, int, int]]:
         """Admit + plan this iteration's prefill work.
 
@@ -258,30 +308,67 @@ class Scheduler:
         admission order, budget-capped); the engine executes them in
         order and flips a slot to ACTIVE when its prompt completes.
 
+        Admission is priority-aware: each round picks the queued
+        request with the best (effective rank, queue position) — aging
+        per :meth:`_effective_rank` — skipping classes at their
+        ``priority_max_slots`` bound. All-normal traffic reduces
+        exactly to the old FCFS order.
+
         ``admit`` is the paged engine's admission gate: called with
-        ``(slot, queue_entry)`` for the head-of-line request BEFORE it
-        is committed, it returns the cached prefix length to skip
-        (>= 0, prefill starts there), None to keep the request queued
-        (free pages exhausted — admission keys on pages, not slots, so
-        head-of-line blocking preserves FCFS), or -1 when the gate
-        consumed the entry itself (typed shed). None gate = admit
+        ``(slot, queue_entry)`` for the selected request BEFORE it is
+        committed, it returns the cached prefix length to skip (>= 0,
+        prefill starts there), None to keep the request queued (free
+        pages exhausted), or -1 when the gate consumed the entry
+        itself (typed shed). On None, if a preemption hook is set and
+        an ACTIVE slot ranks strictly worse than the blocked request,
+        that victim is preempted (its pages stash to the host tier)
+        and the gate retried; otherwise admission stops for this
+        iteration — blocking preserves rank order. None gate = admit
         unconditionally (the contiguous path).
         """
-        free = [s for s in self.slots if s.state == FREE]
-        fi = 0
-        while fi < len(free) and self.queue:
-            slot = free[fi]
-            entry = self.queue[0]
+        bounds = self.serving.priority_slot_bounds()
+        now = time.perf_counter()
+        while self.queue:
+            free = [s for s in self.slots if s.state == FREE]
+            if not free:
+                break
+            # per-class occupancy for the admission bounds; recomputed
+            # each round (admissions and preemptions change it)
+            occ: Dict[str, int] = {}
+            for s in self.slots:
+                if s.state != FREE:
+                    cls = s.request.params.priority
+                    occ[cls] = occ.get(cls, 0) + 1
+            best_i, best_key = None, None
+            for i, e in enumerate(self.queue):
+                cls = e[0].params.priority
+                if cls in bounds and occ.get(cls, 0) >= bounds[cls]:
+                    continue
+                key = (self._effective_rank(cls, e[2], now), i)
+                if best_key is None or key < best_key:
+                    best_i, best_key = i, key
+            if best_i is None:
+                break  # every waiting class is at its slot bound
+            slot = free[0]
+            entry = self.queue[best_i]
             cached = 0
             if admit is not None:
                 verdict = admit(slot, entry)
                 if verdict is None:
+                    if self.on_preempt is not None:
+                        victim = self._preempt_victim(best_key[0], now)
+                        if victim is not None:
+                            # the hook stashes KV, releases pages,
+                            # requeues the victim and resets the slot;
+                            # retry the gate against the freed pages
+                            self.on_preempt(victim)
+                            continue
                     break
                 if verdict < 0:
-                    self.queue.popleft()
+                    del self.queue[best_i]
                     continue
                 cached = verdict
-            self.queue.popleft()
+            del self.queue[best_i]
             request, prompt, t_submit, deadline, trace = entry
             slot.state = PREFILL
             slot.request = request
@@ -303,7 +390,6 @@ class Scheduler:
             slot.trace = trace
             slot.admit_seq = self._admit_seq
             self._admit_seq += 1
-            fi += 1
         self.max_concurrent = max(self.max_concurrent, self.occupied())
 
         budget = self.serving.prefill_budget

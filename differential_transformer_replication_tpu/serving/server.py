@@ -369,6 +369,13 @@ class EngineRunner:
                     "page pool exhausted; retry later"
                 )
                 err.output = out
+                if out.retry_after is not None:
+                    # drain-rate-derived backoff hint (PagePool.
+                    # estimated_drain_s): serving/retry.py uses it as
+                    # the backoff floor and the HTTP 503 echoes it in
+                    # Retry-After, so clients wait for actual pool
+                    # drain time instead of a static guess
+                    err.retry_after = out.retry_after
                 self._settle(pending, error=err)
             elif out.finish_reason == "constraint_dead_end":
                 # typed retriable failure with the partial output
@@ -724,6 +731,26 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                 )
                 if constrain_stats is not None:
                     payload["constraints"] = constrain_stats()
+                # host-tier snapshot (serving/host_tier.py): byte
+                # budget/usage, cached/stashed entries, and the
+                # demote/promote/preempt/resume/fallback counters —
+                # the "Serving under memory pressure" runbook's
+                # first-stop view
+                tier_stats = getattr(
+                    client.runner.engine, "tier_stats", None
+                )
+                if tier_stats is not None:
+                    tier = tier_stats()
+                    if tier is not None:
+                        payload["host_tier"] = tier
+                # per-priority-class queue depths: a saturating batch
+                # class is visible as ITS queue growing, not as an
+                # opaque aggregate number
+                queue_depths = getattr(
+                    client.runner.engine, "queue_depths", None
+                )
+                if queue_depths is not None:
+                    payload["queue_by_class"] = queue_depths()
                 self._reply(200, payload)
             elif self.path == "/ready":
                 if client.runner.accepting():
@@ -808,6 +835,7 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                         )
                     ),
                     logprobs=int(req.get("logprobs", 0)),
+                    priority=str(req.get("priority", "normal")),
                 )
                 deadline_s = req.get("deadline_s")
                 # "received", not "admitted": a QueueFullError /
@@ -870,9 +898,19 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                 # retriable=False — no Retry-After, clients must not
                 # burn their budget re-sending it here
                 if getattr(e, "retriable", True):
+                    # prefer the engine's drain-rate-derived estimate
+                    # (seconds until enough pages free at the observed
+                    # eviction/release throughput) over the static
+                    # restart-backoff default
+                    ra = getattr(e, "retry_after", None)
                     _fail(503, {"error": str(e),
                                 "code": "page_pool_exhausted"},
-                          headers=self._retry_after())
+                          headers=(
+                              {"Retry-After":
+                               str(max(1, int(round(ra))))}
+                              if ra is not None
+                              else self._retry_after()
+                          ))
                 else:
                     _fail(503, {"error": str(e),
                                 "code": "page_pool_unfit"})
@@ -1017,6 +1055,23 @@ def main() -> None:
     p.add_argument("--prefix-cache-pages", type=int, default=0,
                    help="extra pool pages reserved as cached-prefix "
                         "headroom on top of the auto sizing")
+    p.add_argument("--host-tier-bytes", type=int, default=0,
+                   help="host-RAM KV page tier (serving/host_tier.py), "
+                        "in bytes (needs --kv-page-size): evicted "
+                        "radix-cached prefixes DEMOTE here instead of "
+                        "vanishing and promote back with a copy, never "
+                        "a recompute; preempted requests stash their "
+                        "live KV here and resume bit-exact. 0 = off")
+    p.add_argument("--priority-aging", type=float, default=10.0,
+                   help="anti-starvation aging (seconds): every this "
+                        "many seconds waited improves a queued "
+                        "request's effective priority by one class, so "
+                        "batch traffic cannot starve under sustained "
+                        "high-priority load (0 = strict classes)")
+    p.add_argument("--priority-max-slots", default="",
+                   help="per-class slot bounds as 'class:N,...' (e.g. "
+                        "'batch:6') capping how many slots one class "
+                        "may hold; '' = no bounds")
     p.add_argument("--spec-mode", default="",
                    choices=("", "ngram", "model"),
                    help="speculative decoding (serving/spec.py): "
@@ -1176,6 +1231,9 @@ def main() -> None:
         kv_pool_pages=args.kv_pool_pages,
         prefix_cache=not args.no_prefix_cache,
         prefix_cache_pages=args.prefix_cache_pages,
+        host_tier_bytes=args.host_tier_bytes,
+        priority_aging_s=args.priority_aging,
+        priority_max_slots=args.priority_max_slots,
         max_queue_len=args.max_queue_len,
         default_deadline_s=args.default_deadline,
         drain_timeout_s=args.drain_timeout,

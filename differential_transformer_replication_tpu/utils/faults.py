@@ -118,6 +118,30 @@ serving/server.py):
                         Compiled FSMs prune dead states (Willard &
                         Louf), so only this fault reaches the
                         non-accepting zero-mask sweep.
+  ``page_demote_fail@N``
+                        fail the host-tier page demotions drained at
+                        engine iteration N (serving/host_tier.py): the
+                        evicted pages' device capture is skipped, the
+                        prefix is simply LOST from the tier (counted
+                        ``serving_host_tier_fallbacks_total``), and the
+                        next request for it recomputes — degradation
+                        back to pre-tier behavior, never a wedge.
+                        One-shot.
+  ``page_promote_hang@N``
+                        stall the promotions applied at engine
+                        iteration N for ``DTX_TIER_HANG_S`` seconds
+                        (default 2.0), then FAIL them: the admission
+                        truncates its cached length back to the
+                        device-resident prefix and prefills the rest —
+                        recompute fallback, typed and counted, never a
+                        hang past the stall or garbage KV. One-shot.
+  ``page_swap_corrupt@N``
+                        flip one byte of a stashed page image before
+                        the swap-in at engine iteration N: the CRC32
+                        verify at injection must catch it, drop the
+                        stash, and fall back to a full bit-exact
+                        restart of the request (fold_in per-request
+                        keys) — never garbage tokens. One-shot.
 
 Constraint fault points (call-point style — ``@N`` counts CALLS):
 
@@ -171,6 +195,7 @@ CKPT_HANG_ENV_VAR = "DTX_CKPT_HANG_S"
 ROUTER_HANG_ENV_VAR = "DTX_ROUTER_HANG_S"
 TRAIN_HANG_ENV_VAR = "DTX_TRAIN_HANG_S"
 SKEW_ENV_VAR = "DTX_SKEW_S"
+TIER_HANG_ENV_VAR = "DTX_TIER_HANG_S"
 
 _STEP_KINDS = (
     "raise", "sigterm", "sigkill", "nan", "corrupt_params",
@@ -189,6 +214,9 @@ _STEP_KINDS = (
     # structured-decoding kind (serving/constrain.py): dead-end-sentinel
     # poison of one constrained slot's FSM cursor
     "constrain_dead_end",
+    # host-tier kinds (serving/host_tier.py): demotion capture failure,
+    # promotion stall-then-fail, and stash corruption before swap-in
+    "page_demote_fail", "page_promote_hang", "page_swap_corrupt",
 )
 _POINT_KINDS = (
     "ckpt_write", "ckpt_fsync", "ckpt_manifest", "ckpt_gc",
@@ -363,6 +391,44 @@ def constrain_dead_end_at(iteration: int) -> bool:
     p = _get()
     if iteration in p["constrain_dead_end"]:
         p["constrain_dead_end"].discard(iteration)
+        return True
+    return False
+
+
+def page_demote_fail_at(iteration: int) -> bool:
+    """One-shot demotion-failure fault: when armed for this engine
+    iteration, the engine SKIPS capturing the drained demotion plans'
+    device bytes — the evicted prefixes are lost from the tier (typed,
+    counted) and later requests recompute them. One-shot."""
+    p = _get()
+    if iteration in p["page_demote_fail"]:
+        p["page_demote_fail"].discard(iteration)
+        return True
+    return False
+
+
+def page_promote_hang_at(iteration: int) -> bool:
+    """One-shot promotion-stall fault: when armed for this engine
+    iteration, the engine sleeps ``DTX_TIER_HANG_S`` seconds (default
+    2.0) and then FAILS the admission's promotions — the recompute
+    fallback (cached length truncated to the device prefix) must kick
+    in, typed and counted, never a wedge."""
+    p = _get()
+    if iteration in p["page_promote_hang"]:
+        p["page_promote_hang"].discard(iteration)
+        time.sleep(float(os.environ.get(TIER_HANG_ENV_VAR, "2.0")))
+        return True
+    return False
+
+
+def page_swap_corrupt_at(iteration: int) -> bool:
+    """One-shot swap-corruption fault: when armed for this engine
+    iteration, the engine flips one byte of a stashed page image
+    before injecting it — the CRC32 verify must detect it and degrade
+    to a bit-exact full restart, never inject garbage KV."""
+    p = _get()
+    if iteration in p["page_swap_corrupt"]:
+        p["page_swap_corrupt"].discard(iteration)
         return True
     return False
 

@@ -1,0 +1,656 @@
+"""Graceful degradation under KV pressure (PR 17).
+
+The load-bearing contracts:
+
+- **Host tier**: evicted radix pages demote into a byte-budgeted,
+  checksummed host-RAM store (serving/host_tier.py) and promote back by
+  copy — revisiting a demoted prefix is bit-exact with recompute and
+  never recompiles; every tier fault (demote failure, promote hang,
+  corrupted swap) degrades to a counted, typed recompute fallback.
+- **Mid-decode preemption**: a low-priority request's live pages stash
+  out to the host tier under page pressure and the request resumes
+  BIT-EXACT after swap-in — greedy and sampled alike (per-request
+  ``fold_in`` key chains), with the decode compile count pinned at 1.
+- **Priority classes**: ``SamplingParams.priority`` orders admission
+  (high < normal < batch), per-class slot bounds cap each class, and
+  anti-starvation aging provably promotes a starved batch request over
+  fresh high-priority traffic.
+- **Shed honesty**: pool exhaustion sheds with a Retry-After derived
+  from the observed page drain rate, and per-class TTFT/ITL histograms
+  feed per-class SLO burn rates.
+"""
+
+import time
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.analysis.sanitizers import (
+    RecompileSentinel,
+)
+from differential_transformer_replication_tpu.config import (
+    ModelConfig,
+    ServingConfig,
+)
+from differential_transformer_replication_tpu.models import (
+    generate_cached,
+    init_model,
+)
+from differential_transformer_replication_tpu.obs.slo import (
+    SLOMonitor,
+    default_serving_objectives,
+)
+from differential_transformer_replication_tpu.serving import (
+    HostTier,
+    PagePool,
+    SamplingParams,
+    Scheduler,
+    ServingEngine,
+)
+from differential_transformer_replication_tpu.serving.host_tier import (
+    payload_nbytes,
+)
+from differential_transformer_replication_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _cfg(kind, **kw):
+    base = dict(
+        model=kind, vocab_size=61, n_embd=32, n_head=2, n_layer=2,
+        block_size=32, dropout=0.0, n_terms=3, compute_dtype="float32",
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@lru_cache(maxsize=None)
+def _setup(kind):
+    cfg = _cfg(kind)
+    return cfg, init_model(jax.random.PRNGKey(0), cfg)
+
+
+def _prompts(lens, vocab, seed=1):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=L).tolist() for L in lens]
+
+
+def _ref_greedy(params, cfg, prompt, n):
+    out = generate_cached(
+        params, jnp.asarray(prompt, jnp.int32)[None], cfg, n,
+        jax.random.PRNGKey(0), temperature=0.0,
+    )
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _tiered(**kw):
+    """Paged + host-tiered serving config; block 32 / page 8 gives
+    4 pages per slot, so small pools create real KV pressure."""
+    base = dict(num_slots=2, prefill_chunk=4, prefill_budget=6,
+                kv_page_size=8, kv_pool_pages=6,
+                host_tier_bytes=1 << 30)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _payload(n=64, layers=2, seed=0):
+    """A fake page image: per-layer dicts of byte arrays
+    (2 * layers * n bytes total)."""
+    rng = np.random.default_rng(seed)
+    return [
+        {"k": rng.integers(0, 255, n, dtype=np.uint8),
+         "v": rng.integers(0, 255, n, dtype=np.uint8)}
+        for _ in range(layers)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# HostTier unit tests (pure host state, no device work)
+# ---------------------------------------------------------------------------
+
+
+class TestHostTier:
+    def test_put_get_roundtrip(self):
+        tier = HostTier(budget_bytes=10_000)
+        p = _payload(seed=1)
+        assert tier.put(("a",), p)
+        ent = tier.get(("a",))
+        assert ent is not None and ent.verify()
+        for got, want in zip(ent.payload, p):
+            np.testing.assert_array_equal(got["k"], want["k"])
+            np.testing.assert_array_equal(got["v"], want["v"])
+        assert tier.get(("zz",)) is None
+        st = tier.stats()
+        assert st["hits_total"] == 1 and st["misses_total"] == 1
+        assert st["entries"] == 1
+        assert st["bytes"] == payload_nbytes(p)
+
+    def test_budget_must_be_positive(self):
+        with pytest.raises(ValueError):
+            HostTier(budget_bytes=0)
+
+    def test_lru_eviction_respects_recency(self):
+        # each payload is 256 bytes; a 600-byte budget holds two
+        tier = HostTier(budget_bytes=600)
+        tier.put(("a",), _payload(seed=1))
+        tier.put(("b",), _payload(seed=2))
+        assert tier.get(("a",)) is not None  # refresh: b is now LRU
+        tier.put(("c",), _payload(seed=3))
+        assert tier.get(("b",)) is None
+        assert tier.get(("a",)) is not None
+        assert tier.get(("c",)) is not None
+        assert tier.stats()["evictions_total"] == 1
+
+    def test_reject_payload_over_budget(self):
+        tier = HostTier(budget_bytes=100)
+        assert not tier.put(("a",), _payload(seed=1))  # 256 > 100
+        st = tier.stats()
+        assert st["rejected_total"] == 1 and st["entries"] == 0
+
+    def test_corruption_reads_as_counted_miss(self):
+        tier = HostTier(budget_bytes=10_000)
+        p = _payload(seed=4)
+        tier.put(("a",), p)
+        p[0]["k"][0] ^= 0xFF  # torn host copy: payload held by reference
+        assert tier.get(("a",)) is None
+        st = tier.stats()
+        assert st["corrupt_total"] == 1 and st["entries"] == 0
+        assert st["misses_total"] == 1 and st["hits_total"] == 0
+
+    def test_stash_is_pinned_and_never_refused(self):
+        tier = HostTier(budget_bytes=600)
+        tier.put(("a",), _payload(seed=1))
+        tier.put(("b",), _payload(seed=2))
+        # a stash evicts cached entries to fit, never gets refused...
+        tier.stash("req1", [_payload(seed=3), _payload(seed=4)])
+        st = tier.stats()
+        assert st["stashes"] == 1 and st["stash_bytes"] == 512
+        assert st["entries"] <= 1  # cached made way
+        # ...and a burst may overshoot the budget outright
+        tier.stash("req2", [_payload(n=512, seed=5)])
+        assert tier.stats()["bytes"] > 600
+        ents = tier.unstash("req1")
+        assert ents is not None and len(ents) == 2
+        assert all(e.verify() for e in ents)
+        assert tier.unstash("req1") is None
+        tier.drop_stash("req2")
+        assert tier.stats()["stash_bytes"] == 0
+
+    def test_clear_cache_keeps_stashes_and_counters(self):
+        tier = HostTier(budget_bytes=10_000)
+        tier.put(("a",), _payload(seed=1))
+        assert tier.get(("a",)) is not None
+        tier.stash("req", [_payload(seed=2)])
+        tier.clear_cache()
+        st = tier.stats()
+        assert st["entries"] == 0 and st["cached_bytes"] == 0
+        assert st["stashes"] == 1 and st["stash_bytes"] > 0
+        assert st["hits_total"] == 1  # monotonic counters survive
+
+
+# ---------------------------------------------------------------------------
+# Priority plumbing: params, config, rank math, queue depths
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityPlumbing:
+    def test_invalid_priority_rejected(self):
+        with pytest.raises(ValueError):
+            SamplingParams(priority="urgent")
+
+    def test_slot_bounds_parse(self):
+        sv = ServingConfig(num_slots=2, priority_max_slots="batch:1")
+        assert sv.priority_slot_bounds() == {"batch": 1}
+        assert ServingConfig().priority_slot_bounds() == {}
+        with pytest.raises(ValueError):
+            ServingConfig(priority_max_slots="batch:zero")
+        with pytest.raises(ValueError):
+            ServingConfig(priority_max_slots="urgent:1")
+
+    def test_tiered_requires_paged_pool(self):
+        assert _tiered().tiered()
+        assert not ServingConfig(num_slots=2).tiered()
+
+    def test_effective_rank_aging(self):
+        sched = Scheduler(ServingConfig(num_slots=1,
+                                        priority_aging_s=1.0))
+        now = 100.0
+        assert sched._effective_rank("high", now, now) == 0.0
+        assert sched._effective_rank("normal", now, now) == 1.0
+        assert sched._effective_rank("batch", now, now) == 2.0
+        # 3.5 s waited at 1 s/class: batch outranks fresh high
+        aged = sched._effective_rank("batch", now - 3.5, now)
+        assert aged == -1.0
+        assert aged < sched._effective_rank("high", now, now)
+        # aging disabled: rank never improves
+        frozen = Scheduler(ServingConfig(num_slots=1,
+                                         priority_aging_s=0.0))
+        assert frozen._effective_rank("batch", now - 1e6, now) == 2.0
+
+    def test_queue_depths_by_class(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, ServingConfig(
+            num_slots=1, prefill_chunk=4, prefill_budget=6))
+        assert eng.queue_depths() == {"high": 0, "normal": 0, "batch": 0}
+        p1, p2, p3 = _prompts([5, 5, 5], cfg.vocab_size, seed=2)
+        eng.submit(p1, max_new_tokens=2, temperature=0.0,
+                   priority="high")
+        eng.submit(p2, max_new_tokens=2, temperature=0.0,
+                   priority="batch")
+        eng.submit(p3, max_new_tokens=2, temperature=0.0,
+                   priority="batch")
+        assert eng.queue_depths() == {"high": 1, "normal": 0,
+                                      "batch": 2}
+        eng.run()
+        assert eng.queue_depths() == {"high": 0, "normal": 0,
+                                      "batch": 0}
+
+
+# ---------------------------------------------------------------------------
+# Priority scheduling order (functional, contiguous engine)
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityScheduling:
+    def test_high_jumps_queued_batch(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, ServingConfig(
+            num_slots=1, prefill_chunk=4, prefill_budget=6))
+        pa, pb, pc = _prompts([5, 5, 5], cfg.vocab_size, seed=5)
+        rid_a = eng.submit(pa, max_new_tokens=3, temperature=0.0,
+                           priority="batch")
+        rid_b = eng.submit(pb, max_new_tokens=3, temperature=0.0,
+                           priority="batch")
+        rid_c = eng.submit(pc, max_new_tokens=3, temperature=0.0,
+                           priority="high")
+        outs = eng.run()
+        # the high request admits first; batch peers keep FCFS order
+        assert [o.request_id for o in outs] == [rid_c, rid_a, rid_b]
+        for rid, p in ((rid_a, pa), (rid_b, pb), (rid_c, pc)):
+            out = next(o for o in outs if o.request_id == rid)
+            assert out.tokens == _ref_greedy(params, cfg, p, 3)
+
+    def test_class_slot_bound_caps_batch(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, ServingConfig(
+            num_slots=2, prefill_chunk=4, prefill_budget=6,
+            priority_max_slots="batch:1"))
+        pa, pb, pc = _prompts([5, 5, 5], cfg.vocab_size, seed=6)
+        rid_b2 = None
+        eng.submit(pa, max_new_tokens=6, temperature=0.0,
+                   priority="batch")
+        rid_b2 = eng.submit(pb, max_new_tokens=2, temperature=0.0,
+                            priority="batch")
+        eng.submit(pc, max_new_tokens=2, temperature=0.0,
+                   priority="high")
+        outs = eng.run()
+        # two slots, but batch is capped at one: the short second batch
+        # request still finishes LAST, held out while high rides along
+        assert outs[-1].request_id == rid_b2
+
+    def test_aging_beats_fresh_high(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, ServingConfig(
+            num_slots=1, prefill_chunk=4, prefill_budget=6,
+            priority_aging_s=0.05))
+        pa, pc = _prompts([5, 5], cfg.vocab_size, seed=8)
+        rid_a = eng.submit(pa, max_new_tokens=2, temperature=0.0,
+                           priority="batch")
+        time.sleep(0.25)  # rank 2 - int(0.25/0.05) < 0 = fresh high
+        eng.submit(pc, max_new_tokens=2, temperature=0.0,
+                   priority="high")
+        outs = eng.run()
+        assert outs[0].request_id == rid_a
+
+    def test_without_aging_high_still_wins(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, ServingConfig(
+            num_slots=1, prefill_chunk=4, prefill_budget=6,
+            priority_aging_s=0.0))
+        pa, pc = _prompts([5, 5], cfg.vocab_size, seed=9)
+        eng.submit(pa, max_new_tokens=2, temperature=0.0,
+                   priority="batch")
+        time.sleep(0.25)
+        rid_c = eng.submit(pc, max_new_tokens=2, temperature=0.0,
+                           priority="high")
+        outs = eng.run()
+        assert outs[0].request_id == rid_c
+
+
+# ---------------------------------------------------------------------------
+# Demote -> promote round trip (bit-exact, no recompute on revisit)
+# ---------------------------------------------------------------------------
+
+
+def _overflow_until(eng, cfg, stat, floor=1, base=100, limit=30):
+    """Push distinct prompts through until ``tier_stats()[stat]``
+    reaches ``floor`` (drives radix eviction -> demotion traffic)."""
+    k = 0
+    while eng.tier_stats()[stat] < floor:
+        p = [(base + k) % cfg.vocab_size] + _prompts(
+            [16], cfg.vocab_size, seed=base + k)[0]
+        eng.generate([p], max_new_tokens=2, temperature=0.0)
+        k += 1
+        assert k < limit, f"no {stat} after {limit} filler prompts"
+
+
+class TestTierRoundTrip:
+    def test_demote_promote_bit_exact(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _tiered())
+        A = [1] + _prompts([16], cfg.vocab_size, seed=7)[0]  # 2 full pages
+        ref_a = _ref_greedy(params, cfg, A, 3)
+        out = eng.generate([A], max_new_tokens=3, temperature=0.0)[0]
+        assert out.tokens == ref_a
+        _overflow_until(eng, cfg, "demotions")
+        ts0 = eng.tier_stats()
+        # revisit: A's pages are host-resident now; the admission
+        # promotes them back by copy instead of recomputing prefill
+        out2 = eng.generate([A], max_new_tokens=3, temperature=0.0)[0]
+        ts1 = eng.tier_stats()
+        assert out2.tokens == ref_a
+        assert ts1["promotions"] - ts0["promotions"] >= 1
+        assert ts1["hits_total"] - ts0["hits_total"] >= 1
+        assert ts1["fallbacks"] == 0 and ts1["corrupt_total"] == 0
+        assert eng.page_stats()["tier_hits_total"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Mid-decode preemption + bit-exact resume
+# ---------------------------------------------------------------------------
+
+
+def _run_preempt_scenario(eng, batch_p, high_p, batch_kw, high_kw):
+    """Admit a batch request, let it decode a bit, then submit a high
+    request that cannot fit -> the scheduler preempts the batch slot.
+    Returns {rid: output} after draining."""
+    d0 = eng.stats["decode_tokens"]
+    rid_b = eng.submit(batch_p, priority="batch", **batch_kw)
+    for _ in range(300):
+        eng.step()
+        if eng.stats["decode_tokens"] - d0 >= 2:
+            break
+    assert eng.stats["decode_tokens"] - d0 >= 2
+    rid_h = eng.submit(high_p, priority="high", **high_kw)
+    outs = {o.request_id: o for o in eng.run()}
+    return rid_b, rid_h, outs
+
+
+class TestPreemptResume:
+    # pool of 4 pages: the admitted batch request holds 3, the high
+    # request needs 2 -> admission blocks and preemption must fire
+
+    def test_preempt_resume_bit_exact_greedy(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg,
+                            _tiered(kv_pool_pages=5))
+        batch_p, high_p = _prompts([9, 9], cfg.vocab_size, seed=3)
+        rid_b, rid_h, outs = _run_preempt_scenario(
+            eng, batch_p, high_p,
+            dict(max_new_tokens=8, temperature=0.0),
+            dict(max_new_tokens=23, temperature=0.0))
+        assert outs[rid_h].tokens == _ref_greedy(params, cfg, high_p, 23)
+        assert outs[rid_b].tokens == _ref_greedy(params, cfg, batch_p, 8)
+        ts = eng.tier_stats()
+        assert ts["preemptions"] >= 1 and ts["resumes"] >= 1
+        assert ts["fallbacks"] == 0
+        assert eng.compile_stats()["decode"] == 1
+
+    def test_preempt_resume_bit_exact_sampled(self):
+        # sampled resume leans on the per-request fold_in key chain:
+        # token t's key is a pure function of (seed, t), so the swapped
+        # request continues the exact stream it would have produced
+        cfg, params = _setup("control")
+        sv = _tiered(kv_pool_pages=5)
+        batch_p, high_p = _prompts([9, 9], cfg.vocab_size, seed=29)
+        ref = ServingEngine(params, cfg, sv).generate(
+            [batch_p], max_new_tokens=8, temperature=0.9)[0]
+        eng = ServingEngine(params, cfg, sv)
+        rid_b, _, outs = _run_preempt_scenario(
+            eng, batch_p, high_p,
+            dict(max_new_tokens=8, temperature=0.9),
+            dict(max_new_tokens=23, temperature=0.0))
+        assert outs[rid_b].tokens == ref.tokens
+        assert eng.stats["preemptions"] >= 1
+        assert eng.stats["resumes"] >= 1
+
+    def test_churn_cycle_zero_recompiles(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg,
+                            _tiered(kv_pool_pages=5))
+
+        def cycle(base):
+            b = [base % cfg.vocab_size] + _prompts(
+                [8], cfg.vocab_size, seed=base)[0]
+            h = [(base + 1) % cfg.vocab_size] + _prompts(
+                [8], cfg.vocab_size, seed=base + 1)[0]
+            _run_preempt_scenario(
+                eng, b, h,
+                dict(max_new_tokens=8, temperature=0.0),
+                dict(max_new_tokens=23, temperature=0.0))
+            # revisit under pressure: demote/promote churn rides along
+            eng.generate([b], max_new_tokens=2, temperature=0.0)
+
+        cycle(11)  # warm: admit/demote/promote/preempt/resume all jit
+        p0 = eng.stats["preemptions"]
+        with RecompileSentinel(budget=0, name="tier-churn"):
+            cycle(17)
+        assert eng.stats["preemptions"] > p0
+        assert eng.compile_stats()["decode"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Fault drills: every tier failure degrades to a counted recompute
+# ---------------------------------------------------------------------------
+
+
+def _arm_range(name, start, width=300):
+    faults.arm(",".join(
+        f"{name}@{i}" for i in range(start, start + width)))
+
+
+class TestTierFaultDrills:
+    def test_demote_failure_counts_fallback(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _tiered())
+        A = [1] + _prompts([16], cfg.vocab_size, seed=7)[0]
+        ref = _ref_greedy(params, cfg, A, 3)
+        assert eng.generate(
+            [A], max_new_tokens=3, temperature=0.0)[0].tokens == ref
+        _arm_range("page_demote_fail", eng.stats["iterations"])
+        _overflow_until(eng, cfg, "fallbacks")
+        ts = eng.tier_stats()
+        assert ts["fallbacks"] >= 1
+        assert ts["demotions"] == 0 and ts["entries"] == 0
+        faults.reset()
+        # graceful: the lost pages simply recompute on revisit
+        out = eng.generate([A], max_new_tokens=3, temperature=0.0)[0]
+        assert out.tokens == ref
+
+    def test_promote_hang_falls_back_to_recompute(self, monkeypatch):
+        monkeypatch.setenv("DTX_TIER_HANG_S", "0.02")
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _tiered())
+        A = [1] + _prompts([16], cfg.vocab_size, seed=7)[0]
+        ref = _ref_greedy(params, cfg, A, 3)
+        eng.generate([A], max_new_tokens=3, temperature=0.0)
+        _overflow_until(eng, cfg, "demotions")
+        _arm_range("page_promote_hang", eng.stats["iterations"])
+        out = eng.generate([A], max_new_tokens=3, temperature=0.0)[0]
+        ts = eng.tier_stats()
+        assert out.tokens == ref  # recompute fallback, bit-exact
+        assert ts["fallbacks"] >= 1 and ts["promotions"] == 0
+
+    def test_swap_corruption_restarts_bit_exact(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg,
+                            _tiered(kv_pool_pages=5))
+        batch_p, high_p = _prompts([9, 9], cfg.vocab_size, seed=3)
+        d0 = eng.stats["decode_tokens"]
+        rid_b = eng.submit(batch_p, max_new_tokens=8, temperature=0.0,
+                           priority="batch")
+        for _ in range(300):
+            eng.step()
+            if eng.stats["decode_tokens"] - d0 >= 2:
+                break
+        rid_h = eng.submit(high_p, max_new_tokens=23, temperature=0.0,
+                           priority="high")
+        _arm_range("page_swap_corrupt", eng.stats["iterations"])
+        outs = {o.request_id: o for o in eng.run()}
+        ts = eng.tier_stats()
+        # the corrupted stash is detected, dropped, and the request
+        # RESTARTS from its prompt instead of resuming garbage KV
+        assert ts["corrupt_total"] >= 1 and ts["fallbacks"] >= 1
+        assert ts["preemptions"] >= 1 and ts["resumes"] == 0
+        assert outs[rid_b].tokens == _ref_greedy(params, cfg, batch_p, 8)
+        assert outs[rid_h].tokens == _ref_greedy(params, cfg, high_p, 23)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: crash while a preempted request is swapped out
+# ---------------------------------------------------------------------------
+
+
+class TestChaosCrash:
+    def test_crash_mid_swap_resumes_bit_identical(self):
+        cfg, params = _setup("control")
+        sv = _tiered(kv_pool_pages=5)
+        batch_p, high_p = _prompts([9, 9], cfg.vocab_size, seed=13)
+        ref = ServingEngine(params, cfg, sv).generate(
+            [batch_p], max_new_tokens=8, temperature=0.0)[0]
+        eng = ServingEngine(params, cfg, sv)
+        d0 = eng.stats["decode_tokens"]
+        rid_b = eng.submit(batch_p, max_new_tokens=8, temperature=0.0,
+                           priority="batch")
+        for _ in range(300):
+            eng.step()
+            if eng.stats["decode_tokens"] - d0 >= 2:
+                break
+        rid_h = eng.submit(high_p, max_new_tokens=23, temperature=0.0,
+                           priority="high")
+        for _ in range(300):
+            eng.step()
+            if eng.stats["preemptions"] >= 1:
+                break
+        assert eng.stats["preemptions"] >= 1
+        faults.arm(f"serve_raise@{eng.stats['iterations'] + 1}")
+        with pytest.raises(Exception):
+            while eng.has_work():
+                eng.step()
+        lost = eng.reset_after_crash()
+        assert rid_h in lost  # active at crash time -> lost
+        ts = eng.tier_stats()
+        # the preempted request's stash SURVIVES the crash (it is
+        # decode state, not cache); every cached prefix is dropped as
+        # untrusted
+        assert ts["stashes"] == 1 and ts["entries"] == 0
+        outs = {o.request_id: o for o in eng.run()}
+        assert outs[rid_b].tokens == ref.tokens
+        assert eng.stats["resumes"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Retry-After from the observed drain rate
+# ---------------------------------------------------------------------------
+
+
+class TestRetryAfter:
+    def test_drain_estimate_needs_observations(self):
+        pool = PagePool(page_size=4, pages_per_slot=4, num_slots=2,
+                        total_pages=9, prefix_cache=True)
+        assert pool.estimated_drain_s(2) is None  # no drain observed
+        pool.plan_admission(0, list(range(6)), 3)
+        pool.release(0, list(range(6)), cacheable=False)
+        est = pool.estimated_drain_s(2)
+        assert est is not None and est > 0
+
+    def test_shed_carries_retry_after(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _tiered())
+        p1, p2, p3 = _prompts([9, 9, 9], cfg.vocab_size, seed=21)
+        # successful traffic first: decode past a page boundary so each
+        # release returns a decode-only page to the free list — that is
+        # what feeds the drain log the Retry-After estimate reads
+        eng.generate([p1, p2], max_new_tokens=8, temperature=0.0)
+        faults.arm(f"page_exhaust@{eng.stats['iterations']}")
+        rid = eng.submit(p3, max_new_tokens=2, temperature=0.0)
+        outs = {o.request_id: o for o in eng.run()}
+        out = outs[rid]
+        assert out.finish_reason == "page_exhausted"
+        assert out.retry_after is not None and out.retry_after > 0
+        assert eng.stats["page_shed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Per-class observability: histograms + SLO objectives
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityObservability:
+    def test_per_class_latency_and_slo(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, ServingConfig(
+            num_slots=2, prefill_chunk=4, prefill_budget=6))
+        p1, p2 = _prompts([5, 5], cfg.vocab_size, seed=17)
+        eng.submit(p1, max_new_tokens=2, temperature=0.0,
+                   priority="high")
+        eng.submit(p2, max_new_tokens=2, temperature=0.0,
+                   priority="batch")
+        eng.run()
+        hist = eng.registry.histogram("serving_class_ttft_seconds",
+                                      labelnames=("priority",))
+        assert hist.snapshot(priority="high")["count"] == 1
+        assert hist.snapshot(priority="batch")["count"] == 1
+        assert hist.snapshot(priority="normal")["count"] == 0
+        latency, availability = default_serving_objectives()
+        mon = SLOMonitor(eng.registry, latency=latency,
+                         availability=availability)
+        out = mon.evaluate()
+        assert out["ttft_high"]["count"] == 1.0
+        assert out["ttft_batch"]["count"] == 1.0
+        # a class with no traffic never alarms
+        assert out["ttft_normal"]["error_ratio"] is None
+        assert out["ttft_normal"]["burn_rate"] is None
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: 10x working set sustained through the host tier
+# ---------------------------------------------------------------------------
+
+
+class TestWorkingSetTiering:
+    def test_10x_working_set_sustains_hits(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _tiered())
+        pool_pages = eng.page_stats()["total"]
+        # 10x the device pool in 2-full-page prefixes
+        n_prefix = 10 * pool_pages // 2
+        rng = np.random.default_rng(33)
+        prompts = []
+        for j in range(n_prefix):
+            prefix = [j % cfg.vocab_size] + rng.integers(
+                0, cfg.vocab_size, 15).tolist()
+            prompts.append(prefix + [int(rng.integers(0, cfg.vocab_size))])
+        outs = eng.generate(prompts, max_new_tokens=2, temperature=0.0)
+        assert all(o.finish_reason != "page_exhausted" for o in outs)
+        ts0 = eng.tier_stats()
+        outs = eng.generate(prompts, max_new_tokens=2, temperature=0.0)
+        assert all(o.finish_reason != "page_exhausted" for o in outs)
+        ts1 = eng.tier_stats()
+        hits = ts1["hits_total"] - ts0["hits_total"]
+        misses = ts1["misses_total"] - ts0["misses_total"]
+        assert hits + misses > 0
+        assert hits / (hits + misses) >= 0.8
+        # reuse came through the tier, not the 6-page device pool
+        assert hits >= n_prefix
+        assert ts1["fallbacks"] == 0 and ts1["corrupt_total"] == 0
+        assert eng.stats["page_shed"] == 0
+        assert eng.stats["engine_restarts"] == 0

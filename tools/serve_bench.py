@@ -84,15 +84,41 @@ def _slow_exemplars(completed, n=10):
     """Trace ids of the ~p99 tail: the slowest-TTFT requests, so a
     bench regression is directly looked up in the stitched timeline
     (tools/trace_stitch.py --trace-id <id>). ``completed`` entries are
-    (tokens, ttft_ms, itls, trace_id); untraced requests are skipped."""
+    (tokens, ttft_ms, itls, trace_id[, priority]); untraced requests
+    are skipped."""
     tail = sorted(
         (e for e in completed if e[3]),
         key=lambda e: e[1], reverse=True,
     )[:n]
     return [
-        {"trace_id": t, "ttft_ms": round(float(ms), 3)}
-        for _, ms, _, t in tail
+        {"trace_id": e[3], "ttft_ms": round(float(e[1]), 3)}
+        for e in tail
     ]
+
+
+def _parse_priority_mix(spec):
+    """``high:8,batch:56`` -> ``{"high": 8, "batch": 56}``."""
+    if not spec:
+        return None
+    mix = {}
+    for part in spec.split(","):
+        cls, _, n = part.partition(":")
+        cls = cls.strip()
+        if cls not in ("high", "normal", "batch"):
+            raise SystemExit(
+                f"--priority-mix: unknown class {cls!r} "
+                "(want high/normal/batch)"
+            )
+        try:
+            count = int(n)
+        except ValueError:
+            raise SystemExit(f"--priority-mix: bad count in {part!r}")
+        if count < 1:
+            raise SystemExit(
+                f"--priority-mix: count must be >= 1 in {part!r}"
+            )
+        mix[cls] = mix.get(cls, 0) + count
+    return mix
 
 
 def _run_against_targets(args, targets, post) -> None:
@@ -955,6 +981,33 @@ def main() -> None:
                         "large sizes, so the A/B reports "
                         "greedy_token_match_rate instead of "
                         "asserting)")
+    p.add_argument("--priority-mix", default=None, metavar="CLS:N,...",
+                   help="priority-class workload mix, e.g. "
+                        "'high:8,batch:56': run exactly N requests of "
+                        "each named class (high/normal/batch), "
+                        "deterministically interleaved; overrides "
+                        "--requests with the mix total. The JSON line "
+                        "gains per-class TTFT/ITL percentiles "
+                        "(ttft_ms_by_class / itl_ms_by_class) so the "
+                        "priority scheduler's isolation under load is "
+                        "measurable. In-process / local --http only")
+    p.add_argument("--working-set-mult", type=float, default=0.0,
+                   help="graceful-degradation bench: size the prefix "
+                        "working set to K x the physical page pool — "
+                        "requests cycle through enough distinct page-"
+                        "aligned prefixes that the radix cache MUST "
+                        "evict, so revisits can only hit via host-RAM "
+                        "demote/promote (--host-tier-bytes). The JSON "
+                        "line gains host_tier_hit_rate and the "
+                        "demote/promote/preempt counters. Implies "
+                        "--kv-page-size 16 when unset; in-process "
+                        "only; 0 = off")
+    p.add_argument("--host-tier-bytes", type=int, default=0,
+                   help="host-RAM page-tier byte budget (ServingConfig."
+                        "host_tier_bytes): radix pages evicted under "
+                        "pool pressure demote to pinned host buffers "
+                        "and promote back by copy on a later "
+                        "admission instead of recomputing; 0 = off")
     p.add_argument("--min-prompt", type=int, default=16)
     p.add_argument("--max-prompt", type=int, default=128)
     p.add_argument("--new-tokens", type=int, default=64)
@@ -1054,6 +1107,21 @@ def main() -> None:
             )
         if args.kv_page_size == 0:
             args.kv_page_size = 16
+    if args.priority_mix and args.target:
+        raise SystemExit(
+            "--priority-mix drives the in-process engine (per-class "
+            "latency needs the engine's own attribution, not a remote "
+            "fleet's)"
+        )
+    if args.working_set_mult:
+        if args.target or args.http:
+            raise SystemExit(
+                "--working-set-mult is an in-process paged-engine "
+                "bench (it sizes the working set off the pool and "
+                "reads the host-tier counters directly)"
+            )
+        if args.kv_page_size == 0:
+            args.kv_page_size = 16
 
     # retry helpers are stdlib-only (serving/retry.py); the engine
     # stack — and jax — loads only when the load runs in-process
@@ -1127,6 +1195,7 @@ def main() -> None:
         kv_pool_pages=args.kv_pool_pages,
         prefix_cache=not args.no_prefix_cache,
         prefix_cache_pages=args.prefix_cache_pages,
+        host_tier_bytes=args.host_tier_bytes,
         profile_every=args.profile_every,
         profile_dir=profile_dir or "device_profiles",
         # let RoPE families roll past block_size so a full-window prompt
@@ -1174,13 +1243,54 @@ def main() -> None:
         if model_cfg.model == "diff" else model_cfg.block_size
     )
     min_prompt = min(args.min_prompt, max_prompt)
-    prompts = [
-        rng.integers(
-            0, model_cfg.vocab_size,
-            size=int(rng.integers(min_prompt, max_prompt + 1)),
-        ).tolist()
-        for _ in range(args.requests)
-    ]
+    mix = _parse_priority_mix(args.priority_mix)
+    priorities = None
+    if mix:
+        args.requests = sum(mix.values())
+        labels = [c for c, n in sorted(mix.items()) for _ in range(n)]
+        # deterministic interleave: every class arrives throughout the
+        # run (all-high-then-all-batch would never contend)
+        priorities = [labels[k] for k in rng.permutation(len(labels))]
+    V = model_cfg.vocab_size
+    ws_prefixes = 0
+    if args.working_set_mult > 0:
+        # K x the pool in distinct page-aligned prefixes, revisited
+        # round-robin: by the time a prefix comes around again the
+        # radix cache has evicted it, so the revisit can only hit via
+        # the host tier (or recompute when the tier is off/full)
+        ps = serving.kv_page_size
+        if max_prompt <= ps:
+            raise SystemExit(
+                f"--working-set-mult needs --max-prompt > the page "
+                f"size ({ps}) so a prefix page is cacheable"
+            )
+        pool_pages = engine.page_stats()["total"]
+        prefix_pages = max(1, (max_prompt - 1) // ps)
+        prefix_len = prefix_pages * ps
+        ws_prefixes = max(
+            1, -(-int(args.working_set_mult * pool_pages)
+                 // prefix_pages)
+        )
+        prefixes = [
+            [j % V] + rng.integers(0, V, size=prefix_len - 1).tolist()
+            for j in range(ws_prefixes)
+        ]
+        tail_hi = max(1, max_prompt - prefix_len)
+        prompts = [
+            prefixes[i % ws_prefixes]
+            + rng.integers(
+                0, V, size=int(rng.integers(1, tail_hi + 1)),
+            ).tolist()
+            for i in range(args.requests)
+        ]
+    else:
+        prompts = [
+            rng.integers(
+                0, V,
+                size=int(rng.integers(min_prompt, max_prompt + 1)),
+            ).tolist()
+            for _ in range(args.requests)
+        ]
 
     # warmup: compile outside the timed window. Every prefill chunk any
     # request can use is a power of two <= min(prefill_chunk, max_prompt),
@@ -1221,6 +1331,39 @@ def main() -> None:
         client.generate(fork_pref + [2, 3], max_new_tokens=2,
                         temperature=args.temperature, seed=0,
                         timeout=600)
+    if serving.tiered() and max_prompt > serving.kv_page_size:
+        # warm the page extract/inject jits too: overflow the pool with
+        # distinct cacheable prompts until a radix eviction DEMOTES to
+        # the host tier (extract), then keep overflowing and
+        # periodically revisit the first warm prompt until its
+        # admission PROMOTES back (inject) — a cold demote/promote
+        # compile inside the sentinel window would fail the bench
+        ps = serving.kv_page_size
+        wlen = min(max_prompt, 2 * ps + 1)
+        total = engine.page_stats()["total"]
+        warm_prompts, cursor = [], 0
+        for j in range(4 * total + 16):
+            prompt = (
+                [(len(ladder) + 1 + j) % V]
+                + warm_rng.integers(0, V, size=wlen - 1).tolist()
+            )
+            warm_prompts.append(prompt)
+            client.generate(prompt, max_new_tokens=2,
+                            temperature=args.temperature, seed=0,
+                            timeout=600)
+            ts = engine.tier_stats() or {}
+            if ts.get("promotions", 0) > 0:
+                break
+            if ts.get("demotions", 0) > 0 and j % 4 == 3:
+                # revisit a ROLLING old prompt (a revisit re-caches its
+                # target MRU, so hammering one prompt would pin it
+                # on-device forever); the cursor eventually lands on a
+                # prompt whose pages were evicted+demoted, and that
+                # admission promotes
+                client.generate(warm_prompts[cursor], max_new_tokens=2,
+                                temperature=args.temperature, seed=0,
+                                timeout=600)
+                cursor = min(cursor + 1, len(warm_prompts) - 1)
 
     from differential_transformer_replication_tpu.obs import trace as trace_mod
 
@@ -1277,17 +1420,21 @@ def main() -> None:
                 if i >= len(prompts):
                     return
                 next_idx[0] += 1
+            prio = priorities[i] if priorities else None
             if args.http:
+                payload = {
+                    "prompt_ids": prompts[i],
+                    "max_new_tokens": args.new_tokens,
+                    "temperature": args.temperature,
+                    "seed": args.seed + i,
+                    "timeout": 600,
+                    "traceparent": traces[i].to_traceparent(),
+                }
+                if prio:
+                    payload["priority"] = prio
                 try:
                     status, body, retries = http_post_json_with_retries(
-                        url, {
-                            "prompt_ids": prompts[i],
-                            "max_new_tokens": args.new_tokens,
-                            "temperature": args.temperature,
-                            "seed": args.seed + i,
-                            "timeout": 600,
-                            "traceparent": traces[i].to_traceparent(),
-                        },
+                        url, payload,
                         timeout=600, max_retries=args.max_retries,
                         rng=rng_w, deadline_s=args.deadline or None,
                     )
@@ -1305,7 +1452,7 @@ def main() -> None:
                         # per-token timestamps ITL needs
                         completed.append(
                             (len(body["tokens"]), body["ttft_ms"], [],
-                             body.get("trace_id"))
+                             body.get("trace_id"), prio)
                         )
                     elif status == 503:
                         _record_http_503(body)
@@ -1314,13 +1461,14 @@ def main() -> None:
                     else:
                         errors["other"] += 1
             else:
+                kw = {"priority": prio} if prio else {}
                 try:
                     out, retries = call_with_retries(
                         lambda: client.generate(
                             prompts[i], max_new_tokens=args.new_tokens,
                             temperature=args.temperature,
                             seed=args.seed + i, timeout=600,
-                            trace=traces[i],
+                            trace=traces[i], **kw,
                         ),
                         max_retries=args.max_retries,
                         retriable=(QueueFullError, EngineCrashError),
@@ -1339,7 +1487,7 @@ def main() -> None:
                     completed.append((
                         len(out.tokens), out.ttft * 1e3,
                         [itl * 1e3 for itl in out.itls],
-                        out.trace_id,
+                        out.trace_id, prio,
                     ))
 
     # the measured window is pinned recompile-free: warmup above
@@ -1354,6 +1502,7 @@ def main() -> None:
         budget=None if args.allow_recompiles < 0 else args.allow_recompiles,
         name="serve-bench-measured-window",
     )
+    tier0 = engine.tier_stats()
     with sentinel:
         t0 = time.perf_counter()
         threads = [
@@ -1365,6 +1514,7 @@ def main() -> None:
         for t in threads:
             t.join()
         wall = time.perf_counter() - t0
+    tier1 = engine.tier_stats()
     if httpd is not None:
         httpd.shutdown()
         httpd.server_close()
@@ -1392,6 +1542,8 @@ def main() -> None:
         "slow_exemplars": _slow_exemplars(completed),
         "trace_dir": args.trace_dir,
         "compiles_in_window": sentinel.count,
+        "preemptions": tier1["preemptions"] if tier1 else 0,
+        "resumes": tier1["resumes"] if tier1 else 0,
         # continuous-profiling summary (when --profile-every sampled
         # this run): parsed capture count + where the device lanes and
         # device_profile JSONL rows landed
@@ -1415,6 +1567,39 @@ def main() -> None:
         "http": bool(args.http),
         "smoke": bool(args.smoke),
     }
+    if priorities:
+        by_ttft: dict = {}
+        by_itl: dict = {}
+        for e in completed:
+            by_ttft.setdefault(e[4], []).append(e[1])
+            by_itl.setdefault(e[4], []).extend(e[2])
+        line["priority_mix"] = mix
+        line["ttft_ms_by_class"] = {
+            c: _percentiles(v) for c, v in sorted(by_ttft.items())
+        }
+        line["itl_ms_by_class"] = {
+            c: _percentiles(v) for c, v in sorted(by_itl.items())
+        }
+    if tier1 is not None:
+        # hit rate over the MEASURED window only (the tier warmup
+        # above deliberately primed hits/demotions)
+        d_hit = tier1["hits_total"] - tier0["hits_total"]
+        d_miss = tier1["misses_total"] - tier0["misses_total"]
+        line["host_tier_hit_rate"] = (
+            round(d_hit / (d_hit + d_miss), 3)
+            if (d_hit + d_miss) > 0 else None
+        )
+        line["host_tier"] = {
+            k: tier1[k]
+            for k in ("budget_bytes", "bytes", "entries",
+                      "demotions", "promotions", "fallbacks",
+                      "evictions_total", "corrupt_total",
+                      "rejected_total")
+        }
+    if args.working_set_mult:
+        line["working_set_mult"] = args.working_set_mult
+        line["working_set_prefixes"] = ws_prefixes
+        line["kv_pages"] = engine.page_stats()
     print(json.dumps(line))
     if args.out:
         with open(args.out, "a") as f:

@@ -70,12 +70,21 @@ def report_from_exposition(text: str, args) -> dict:
     """Objectives over a scraped/saved text exposition."""
     _, samples = parse_exposition(text)
     out = {}
-    for objective, hist_name, threshold in (
+    # --class narrows the latency objectives to ONE priority class by
+    # reading the engine's per-class histograms instead of the
+    # aggregates (match= filters the labeled children before summing)
+    cls = getattr(args, "priority_class", None)
+    match = {"priority": cls} if cls else None
+    latency_sources = (
+        ("ttft", "serving_class_ttft_seconds", args.ttft),
+        ("itl", "serving_class_itl_seconds", args.itl),
+    ) if cls else (
         ("ttft", "serving_ttft_seconds", args.ttft),
         ("itl", "serving_itl_seconds", args.itl),
-    ):
+    )
+    for objective, hist_name, threshold in latency_sources:
         bounds, cumulative, count = histogram_from_samples(
-            samples, hist_name
+            samples, hist_name, match=match
         )
         err = latency_error_ratio(bounds, cumulative, count, threshold)
         out[objective] = {
@@ -85,6 +94,8 @@ def report_from_exposition(text: str, args) -> dict:
             "error_ratio": err,
             "burn_rate": burn_rate(err, args.target),
         }
+        if cls:
+            out[objective]["priority_class"] = cls
     good = _counter_value(samples, "serving_requests_completed_total")
     bad = (
         _counter_value(samples, "serving_requests_rejected_total")
@@ -198,6 +209,11 @@ def main() -> int:
                    help="latency objectives' target fraction under "
                         "the bound")
     p.add_argument("--availability-target", type=float, default=0.999)
+    p.add_argument("--class", dest="priority_class", default=None,
+                   choices=("high", "normal", "batch"),
+                   help="judge ONE priority class's latency objectives "
+                        "(reads the serving_class_* histograms instead "
+                        "of the aggregates)")
     p.add_argument("--step-time-ms", type=float, default=1000.0,
                    help="step-latency bound for --from-metrics-jsonl")
     p.add_argument("--max-burn", type=float, default=1.0,
