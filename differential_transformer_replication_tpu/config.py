@@ -594,13 +594,47 @@ class RouterConfig:
     # bound. Evicting a quiet session only costs it its pin.
     affinity_max_sessions: int = 10_000
 
+    # -- fleet metrics staleness ---------------------------------------
+    # /fleet/metrics re-serves each replica's LAST probed /metrics body.
+    # Bodies older than this are EXCLUDED from the aggregation (a
+    # blackholed replica's hour-old counters must not be silently judged
+    # as current); every replica's age is stamped as a
+    # fleet_scrape_age_seconds gauge so downstream judges
+    # (tools/slo_report.py --max-scrape-age, the autoscaler) can apply
+    # their own bound. 0 = legacy unbounded behavior.
+    metrics_max_age_s: float = 10.0
+
+    # -- predictive admission (serving/admission.py) -------------------
+    # When on, the router's shed paths (no_replica, exhausted failover,
+    # proactive admission sheds) compute an HONEST Retry-After from
+    # fleet-wide capacity — backlog at-or-above the request's priority
+    # class divided by the MEASURED fleet service rate — instead of the
+    # static shed_retry_after_s. Falls back to the static value until
+    # enough traffic has been observed to measure a rate.
+    admission_predictive: bool = True
+    # EWMA halflife for the measured fleet service rate (req/s).
+    admission_rate_halflife_s: float = 10.0
+    # Cap on the computed Retry-After (a deep backlog must answer "come
+    # back in 30 s", not "come back in an hour" — clients treat large
+    # values as outages).
+    admission_max_retry_after_s: float = 30.0
+    # Proactive shedding: reject a request whose PREDICTED wait
+    # (backlog ahead of its class / service rate) exceeds this bound
+    # scaled by its class multiplier (high 2x, normal 1x, batch 0.5x —
+    # batch sheds first, high last). 0 = never shed proactively; the
+    # honest Retry-After still applies to organic sheds.
+    admission_wait_bound_s: float = 0.0
+
     def __post_init__(self):
         for name in ("probe_interval_s", "probe_timeout_s",
                      "probe_backoff_s", "probe_backoff_max_s",
                      "default_deadline_s", "retry_base_s", "retry_cap_s",
                      "retry_after_cap_s", "hedge_factor", "hedge_min_s",
                      "queue_weight", "slot_weight", "kv_weight",
-                     "wait_for_replica_s", "shed_retry_after_s"):
+                     "wait_for_replica_s", "shed_retry_after_s",
+                     "metrics_max_age_s", "admission_rate_halflife_s",
+                     "admission_max_retry_after_s",
+                     "admission_wait_bound_s"):
             if getattr(self, name) < 0:
                 raise ValueError(
                     f"{name} must be >= 0, got {getattr(self, name)}"
@@ -624,6 +658,116 @@ class RouterConfig:
             )
 
     def replace(self, **kw) -> "RouterConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class AutoscalerConfig:
+    """Fleet control-plane knobs (tools/autoscaler.py).
+
+    The autoscaler closes the loop over surfaces that already exist:
+    it polls the router's ``/fleet/metrics``, judges windowed SLO burn
+    (obs/slo.py semantics) plus queue/KV utilization, and actuates
+    replica count through tools/fleet.py's chaos-proven drain/relaunch
+    machinery. Hysteresis (sustain counts), per-direction cooldowns and
+    hard min/max bounds make the state machine immune to a flapping
+    signal by construction — tests/test_autoscaler.py drives it with
+    synthetic burn traces and the ``scale_flap`` fault.
+    """
+
+    # Seconds between /fleet/metrics polls (one control tick each).
+    poll_interval_s: float = 1.0
+    # Hard replica-count bounds. The autoscaler never drains the fleet
+    # below min_replicas (even at zero load) and never grows it past
+    # max_replicas (even at infinite burn).
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # Scale-up trigger: windowed burn rate above this (1.0 = the SLO
+    # error budget is being spent exactly as provisioned) OR
+    # utilization above util_high, sustained for scale_up_sustain
+    # consecutive ticks.
+    scale_up_burn: float = 1.0
+    # Scale-down trigger: burn below this AND utilization below
+    # util_low, sustained for scale_down_sustain consecutive ticks.
+    # The asymmetry (down needs a longer streak) is deliberate: adding
+    # capacity late sheds traffic, removing it late only costs money.
+    scale_down_burn: float = 0.5
+    scale_up_sustain: int = 3
+    scale_down_sustain: int = 6
+    # Per-direction cooldowns: after any scale action, no further
+    # action in that direction until this much time has passed (the
+    # fleet must re-equilibrate before the signal is trusted again).
+    cooldown_up_s: float = 5.0
+    cooldown_down_s: float = 15.0
+    # Utilization score thresholds: the score is the max of fleet
+    # queue-pressure (queued / total slots), mean KV utilization and
+    # mean host-tier utilization over FRESH replicas.
+    util_high: float = 0.85
+    util_low: float = 0.30
+    # Metrics bodies older than this (per-replica scrape_age_seconds)
+    # are treated as MISSING, not current — a blackholed replica must
+    # not feed the control loop hour-old numbers.
+    stale_after_s: float = 5.0
+    # SLO objective bounds used for the windowed burn computation
+    # (same semantics as tools/slo_report.py --ttft/--itl/--target).
+    ttft_threshold_s: float = 1.0
+    itl_threshold_s: float = 0.25
+    slo_target: float = 0.99
+
+    # -- canaried rollout ----------------------------------------------
+    # Traffic fraction the router splits to a designated canary
+    # replica while its window runs.
+    canary_fraction: float = 0.25
+    # Canary observation window (seconds) before the judge rules.
+    canary_window_s: float = 15.0
+    # Judge: the canary must hold windowed burn at or under this...
+    canary_max_burn: float = 1.0
+    # ...and its TTFT p95 must not exceed the control replicas' pooled
+    # p95 by more than this fraction (0.5 = +50%).
+    canary_max_regress: float = 0.5
+    # A verdict needs at least this many canary-served requests in the
+    # window; fewer is "inconclusive" and the controller ROLLS BACK
+    # (never promote on no evidence).
+    canary_min_requests: int = 8
+
+    def __post_init__(self):
+        for name in ("poll_interval_s", "scale_up_burn",
+                     "scale_down_burn", "cooldown_up_s",
+                     "cooldown_down_s", "util_high", "util_low",
+                     "stale_after_s", "ttft_threshold_s",
+                     "itl_threshold_s", "canary_window_s",
+                     "canary_max_burn", "canary_max_regress"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0, got {getattr(self, name)}"
+                )
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})"
+            )
+        if self.scale_up_sustain < 1 or self.scale_down_sustain < 1:
+            raise ValueError("sustain counts must be >= 1")
+        if not 0.0 < self.slo_target < 1.0:
+            raise ValueError(
+                f"slo_target must be in (0, 1), got {self.slo_target}"
+            )
+        if not 0.0 < self.canary_fraction < 1.0:
+            raise ValueError(
+                f"canary_fraction must be in (0, 1), got "
+                f"{self.canary_fraction}"
+            )
+        if self.canary_min_requests < 1:
+            raise ValueError(
+                f"canary_min_requests must be >= 1, got "
+                f"{self.canary_min_requests}"
+            )
+
+    def replace(self, **kw) -> "AutoscalerConfig":
         return dataclasses.replace(self, **kw)
 
 

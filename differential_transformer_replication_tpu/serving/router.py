@@ -104,6 +104,9 @@ from differential_transformer_replication_tpu.obs.trace import (
     from_payload as trace_from_payload,
     instant_args,
 )
+from differential_transformer_replication_tpu.serving.admission import (
+    AdmissionController,
+)
 from differential_transformer_replication_tpu.serving.retry import (
     backoff_delay,
 )
@@ -268,8 +271,14 @@ class Replica:
         self.last_probe_ok_t: Optional[float] = None
         # last successfully fetched /metrics body (text exposition) —
         # what GET /fleet/metrics aggregates; kept across not-ready
-        # windows so a draining replica's counters stay visible
+        # windows so a draining replica's counters stay visible —
+        # plus the monotonic stamp of WHEN it was fetched: the fleet
+        # aggregation excludes bodies older than
+        # RouterConfig.metrics_max_age_s and publishes every age as a
+        # fleet_scrape_age_seconds gauge (None = never fetched by the
+        # prober; a body injected without a stamp aggregates as legacy)
         self.metrics_text: str = ""
+        self.metrics_t: Optional[float] = None
 
     def eligible(self) -> bool:
         with self.lock:
@@ -409,6 +418,22 @@ class Router:
         # latency reservoir feeding the p99-derived hedge budget
         self._lat_lock = threading.Lock()
         self._latencies: deque = deque(maxlen=512)
+        # fleet membership changes (autoscaling, tools/autoscaler.py)
+        # serialize through this lock; readers see atomic whole-list
+        # replacement, never an in-place mutation
+        self._replicas_lock = threading.Lock()
+        # canaried rollout: at most one designated canary replica takes
+        # a fixed fraction of non-sticky traffic (set_canary)
+        self._canary_lock = threading.Lock()
+        self._canary_url: Optional[str] = None
+        self._canary_fraction = 0.0
+        # predictive admission (serving/admission.py): honest
+        # Retry-After from fleet capacity + measured service rate, fed
+        # by the probe loop's /metrics scrapes
+        self.admission: Optional[AdmissionController] = (
+            AdmissionController(self.cfg, registry=self.registry)
+            if self.cfg.admission_predictive else None
+        )
 
         reg = self.registry
         self._req_counter = reg.counter(
@@ -442,6 +467,12 @@ class Router:
             "router_shed_total",
             "Requests shed at the router (no eligible replica).",
         )
+        self._admission_shed_counter = reg.counter(
+            "router_admission_shed_total",
+            "Requests shed proactively by predictive admission "
+            "(predicted wait past the class bound), by priority.",
+            labelnames=("priority",),
+        )
         self._move_counter = reg.counter(
             "router_session_moves_total",
             "Sticky sessions re-pinned because their replica died.",
@@ -454,9 +485,10 @@ class Router:
             "router_replicas_eligible",
             "Replicas currently in rotation (state=up).",
         )
-        reg.gauge(
+        self._replicas_gauge = reg.gauge(
             "router_replicas", "Configured replica count."
-        ).set(len(self.replicas))
+        )
+        self._replicas_gauge.set(len(self.replicas))
 
     # -- lifecycle -----------------------------------------------------
 
@@ -491,6 +523,88 @@ class Router:
         # CLI closes in its finally, atexit is the safety net)
         self.tracer.flush()
         self.events.flush()
+
+    # -- fleet membership (autoscaling, tools/autoscaler.py) -----------
+
+    def add_replica(self, url: str) -> "Replica":
+        """Register a new replica (scale-up) and probe it immediately.
+        The whole list is REPLACED atomically, so pickers and the
+        probe loop racing this call see either the old or the new
+        fleet, never a half-built one."""
+        with self._replicas_lock:
+            current = self.replicas
+            if any(r.url == url.rstrip("/") for r in current):
+                raise ValueError(f"replica {url} already registered")
+            replica = Replica(url, self.cfg)
+            self.replicas = current + [replica]
+            self._replicas_gauge.set(len(self.replicas))
+        self.events.emit("replica_added", replica=replica.name)
+        self.probe(replica)
+        return replica
+
+    def remove_replica(self, url: str) -> Optional["Replica"]:
+        """Deregister a replica (scale-down, AFTER its drain): drops
+        it from rotation, from the canary designation, and from the
+        admission controller's capacity model; its affinity pins
+        re-pin on the next request. Returns the removed entry (None
+        when the URL was never registered)."""
+        url = url.rstrip("/")
+        with self._replicas_lock:
+            current = self.replicas
+            removed = next((r for r in current if r.url == url), None)
+            if removed is None:
+                return None
+            if len(current) == 1:
+                raise ValueError(
+                    "cannot remove the last replica from the router"
+                )
+            self.replicas = [r for r in current if r.url != url]
+            self._replicas_gauge.set(len(self.replicas))
+        with self._canary_lock:
+            if self._canary_url == url:
+                self._canary_url = None
+                self._canary_fraction = 0.0
+        with self._aff_lock:
+            stale = [
+                sid for sid, rep in self._affinity.items()
+                if rep is removed
+            ]
+            for sid in stale:
+                del self._affinity[sid]
+        if self.admission is not None:
+            self.admission.forget_replica(removed.name)
+        self.events.emit("replica_removed", replica=removed.name)
+        self.eligible_count()
+        return removed
+
+    def set_canary(self, url: Optional[str],
+                   fraction: float = 0.0) -> None:
+        """Designate (or clear, url=None) the canary replica: it
+        receives ``fraction`` of non-sticky picks and is EXCLUDED from
+        the ordinary p2c pool and from new affinity pins, so its
+        traffic share is the configured fraction, not fraction + its
+        p2c share. Sticky sessions already pinned to it keep their
+        pin (prefix locality); failover may still land on it when
+        nothing else is eligible (serving beats shedding)."""
+        if url is not None:
+            url = url.rstrip("/")
+            if not any(r.url == url for r in self.replicas):
+                raise ValueError(f"unknown canary url {url}")
+            if not 0.0 < fraction < 1.0:
+                raise ValueError(
+                    f"canary fraction must be in (0, 1), got {fraction}"
+                )
+        with self._canary_lock:
+            self._canary_url = url
+            self._canary_fraction = fraction if url is not None else 0.0
+        self.events.emit(
+            "canary_traffic_split",
+            canary=url, fraction=fraction if url is not None else 0.0,
+        )
+
+    def canary(self) -> Tuple[Optional[str], float]:
+        with self._canary_lock:
+            return self._canary_url, self._canary_fraction
 
     # -- probing -------------------------------------------------------
 
@@ -527,11 +641,24 @@ class Router:
                     code, text = self._http_get(
                         replica.url + "/metrics", timeout=t
                     )
-                    if code == 200:
+                    if code == 200 and not faults.consume(
+                        "router_stale_metrics"
+                    ):
+                        # (the fault point models a prober that stops
+                        # refreshing: body, stamp AND scores all stay
+                        # frozen at their last values — scrape-age
+                        # stamping is what must surface it)
                         decoded = text.decode("utf-8", "replace")
                         scores = parse_replica_scores(decoded)
                         with replica.lock:
                             replica.metrics_text = decoded
+                            replica.metrics_t = (
+                                time.monotonic() if now is None else now
+                            )
+                        if self.admission is not None:
+                            self.admission.observe_replica(
+                                replica.name, decoded
+                            )
                 except OSError:
                     pass  # scores are advisory; /ready is the contract
             replica.note_probe_success(
@@ -603,6 +730,21 @@ class Router:
                 r for r in self.replicas
                 if r.eligible() and r.url not in exclude
             ]
+            # canary split: the canary is EXCLUDED from the ordinary
+            # pool (its share is exactly the configured fraction, not
+            # fraction + a p2c share) unless it is the only eligible
+            # replica — serving beats shedding
+            canary_url, canary_frac = self.canary()
+            canary = None
+            pool = eligible
+            if canary_url is not None:
+                canary = next(
+                    (r for r in eligible if r.url == canary_url), None
+                )
+                if canary is not None:
+                    rest = [r for r in eligible if r.url != canary_url]
+                    if rest:
+                        pool = rest
             if session_id is not None and self.cfg.affinity:
                 with self._aff_lock:
                     pinned = self._affinity.get(session_id)
@@ -613,7 +755,10 @@ class Router:
                     return pinned
                 if not eligible:
                     return None
-                choice = self._p2c(eligible)
+                # new pins come from the non-canary pool: a canary must
+                # not accrete sticky sessions it keeps after promotion
+                # judgment ends (or drags through rollback)
+                choice = self._p2c(pool)
                 if pinned_alive:
                     # the pin is healthy but excluded by THIS request's
                     # failover (a transient queue_full, say): serve
@@ -632,7 +777,12 @@ class Router:
                 return choice
             if not eligible:
                 return None
-            return self._p2c(eligible)
+            if canary is not None and pool is not eligible:
+                with self._rng_lock:
+                    roll = self._rng.random()
+                if roll < canary_frac:
+                    return canary
+            return self._p2c(pool)
         finally:
             self._pick_hist.observe(time.perf_counter() - t0)
 
@@ -869,6 +1019,14 @@ class Router:
 
     # -- the request path ----------------------------------------------
 
+    def _shed_retry_after(self, priority: str = "normal") -> float:
+        """Retry-After seconds for a shed reply: the admission
+        controller's honest fleet-capacity prediction when predictive
+        admission is on, else the static configured default."""
+        if self.admission is not None:
+            return self.admission.retry_after_s(priority)
+        return self.cfg.shed_retry_after_s
+
     def handle_generate(self, payload: dict) -> Tuple[int, dict, dict]:
         """Route one /generate request; returns ``(status, body,
         headers)``. Implements admission shedding, failover across
@@ -892,8 +1050,28 @@ class Router:
                 else client_deadline
             )
         end = time.monotonic() + budget if budget > 0 else None
+        priority = str(payload.get("priority") or "normal")
+        if self.admission is not None:
+            # proactive predictive shed: when the fleet's measured
+            # service rate says this class's backlog will not clear
+            # within its bound, refuse NOW with the honest wait instead
+            # of burning failover attempts and the client's deadline
+            decision = self.admission.admit(priority)
+            if not decision.admitted:
+                self._shed_counter.inc()
+                self._admission_shed_counter.inc(priority=priority)
+                self.events.emit(
+                    "request_shed", trace_id=ctx.trace_id,
+                    reason=decision.reason, priority=priority,
+                    predicted_wait_s=decision.predicted_wait_s,
+                )
+                return 503, {
+                    "error": "admission shed: " + decision.reason,
+                    "code": "admission_shed",
+                    "trace_id": ctx.trace_id,
+                }, {"Retry-After": _fmt_secs(decision.retry_after_s)}
         shed_headers = {
-            "Retry-After": _fmt_secs(self.cfg.shed_retry_after_s)
+            "Retry-After": _fmt_secs(self._shed_retry_after(priority))
         }
         tried: List[str] = []
         last: Optional[Tuple[int, dict, dict]] = None
@@ -956,7 +1134,7 @@ class Router:
             headers = {
                 "Retry-After": _fmt_secs(
                     capped_ra if capped_ra is not None
-                    else self.cfg.shed_retry_after_s
+                    else self._shed_retry_after(priority)
                 )
             }
             last = (503 if status == -1 else status, body, headers)
@@ -997,26 +1175,50 @@ class Router:
             "replicas": [r.snapshot() for r in self.replicas],
         }
 
-    def fleet_metrics(self) -> str:
+    def fleet_metrics(self, now: Optional[float] = None) -> str:
         """One exposition for the whole fleet (``GET /fleet/metrics``):
         the replicas' last-probed ``/metrics`` bodies summed/labeled
         (see :func:`aggregate_fleet_metrics`) plus the router's own
         registry, plus a synthesized ``fleet_replica_up`` gauge from
         the health state machine — so one scrape answers both "how
-        much work is the fleet doing" and "who is in rotation"."""
+        much work is the fleet doing" and "who is in rotation".
+
+        Staleness is bounded and ADVERTISED: every probe-stamped body
+        carries a ``fleet_scrape_age_seconds{replica=...}`` gauge, and
+        bodies older than ``cfg.metrics_max_age_s`` (a blackholed or
+        wedged replica whose last scrape is ancient) are EXCLUDED from
+        the aggregate rather than silently served as current — a
+        consumer judging SLO burn must see the replica as missing, not
+        as healthy-at-its-last-good-moment. Bodies with no stamp
+        (installed out-of-band, age unknowable) stay included for
+        back-compat."""
         bodies: Dict[str, str] = {}
         up_lines = ["# TYPE fleet_replica_up gauge"]
+        age_lines = ["# TYPE fleet_scrape_age_seconds gauge"]
+        max_age = self.cfg.metrics_max_age_s
+        now = time.monotonic() if now is None else now
         for r in self.replicas:
             with r.lock:
                 text = r.metrics_text
                 state = r.state
-            if text:
+                stamped_t = r.metrics_t
+            age = None if stamped_t is None else max(0.0, now - stamped_t)
+            if age is not None:
+                age_lines.append(
+                    f'fleet_scrape_age_seconds{{replica="{r.name}"}}'
+                    f" {age:.3f}"
+                )
+            if text and (age is None or max_age <= 0 or age <= max_age):
                 bodies[r.name] = text
             up_lines.append(
                 f'fleet_replica_up{{replica="{r.name}",'
                 f'state="{state}"}} {1 if state == UP else 0}'
             )
-        own = self.registry.render() + "\n".join(up_lines) + "\n"
+        own = (
+            self.registry.render()
+            + "\n".join(up_lines) + "\n"
+            + ("\n".join(age_lines) + "\n" if len(age_lines) > 1 else "")
+        )
         return aggregate_fleet_metrics(bodies, own=own)
 
 

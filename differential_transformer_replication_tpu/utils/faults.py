@@ -172,6 +172,32 @@ tests/test_router.py against serving/router.py):
                         upcoming replica pick — an unexpected router
                         bug; must surface as a typed 500, never kill
                         the router process
+  ``router_stale_metrics`` / ``router_stale_metrics@N``
+                        SKIP the next N probe /metrics refreshes
+                        (fires through :func:`consume`, consuming one
+                        count per skipped refresh): the replica stays
+                        healthy and routable but its /fleet/metrics
+                        body goes STALE — the staleness stamping
+                        (scrape_age_seconds) must flag it and judges
+                        must treat the body as missing
+
+Control-plane fault points (tools/autoscaler.py + serving/engine.py;
+exercised by tests/test_autoscaler.py):
+
+  ``scale_flap@T`` / ``scale_flap@A-B``
+                        oscillate the autoscaler's observed capacity
+                        signal on those control TICKS (alternating
+                        extreme-high / extreme-low burn by tick
+                        parity): hysteresis + cooldowns must hold the
+                        replica count steady. NOT one-shot — arm a
+                        range for a sustained flap window.
+  ``canary_regress``    persistent per-iteration step-time penalty
+                        (``DTX_CANARY_REGRESS_S`` seconds, default
+                        0.05) injected at the top of every engine
+                        step while armed — a deliberately
+                        perf-regressed canary build; the canary judge
+                        must auto-roll-back unattended. Armed on ONE
+                        replica via its DTX_FAULTS env.
 
 Armed from the ``DTX_FAULTS`` environment variable on first use (env
 crosses the supervisor's subprocess boundary) and/or programmatically
@@ -196,6 +222,7 @@ ROUTER_HANG_ENV_VAR = "DTX_ROUTER_HANG_S"
 TRAIN_HANG_ENV_VAR = "DTX_TRAIN_HANG_S"
 SKEW_ENV_VAR = "DTX_SKEW_S"
 TIER_HANG_ENV_VAR = "DTX_TIER_HANG_S"
+CANARY_REGRESS_ENV_VAR = "DTX_CANARY_REGRESS_S"
 
 _STEP_KINDS = (
     "raise", "sigterm", "sigkill", "nan", "corrupt_params",
@@ -217,6 +244,9 @@ _STEP_KINDS = (
     # host-tier kinds (serving/host_tier.py): demotion capture failure,
     # promotion stall-then-fail, and stash corruption before swap-in
     "page_demote_fail", "page_promote_hang", "page_swap_corrupt",
+    # autoscaler kind (tools/autoscaler.py): "step" is a control TICK;
+    # armed ticks see an oscillating capacity signal (not one-shot)
+    "scale_flap",
 )
 _POINT_KINDS = (
     "ckpt_write", "ckpt_fsync", "ckpt_manifest", "ckpt_gc",
@@ -227,6 +257,12 @@ _POINT_KINDS = (
     "router_probe_fail", "router_pick_raise", "router_replica_hang",
     # constraint-compile point (serving/constrain.py:compile_constraint)
     "constrain_compile_fail",
+    # staleness point (serving/router.py): consume() skips the next N
+    # probe metrics refreshes instead of raising
+    "router_stale_metrics",
+    # persistent engine-step penalty (serve_fire): a deliberately
+    # perf-regressed canary build; membership-checked, never consumed
+    "canary_regress",
 )
 
 
@@ -317,7 +353,11 @@ def serve_fire(iteration: int) -> None:
     the top of ``ServingEngine.step``. ``serve_raise`` is one-shot (a
     supervised restart replaying the same iteration number must not
     re-crash); ``serve_hang`` stalls the step long enough for the
-    wall-time watchdog to flag the engine degraded, then disarms."""
+    wall-time watchdog to flag the engine degraded, then disarms.
+    ``canary_regress`` is deliberately PERSISTENT — every iteration
+    pays the injected step-time penalty while it stays armed (a
+    regressed build does not heal itself); the canary judge's
+    auto-rollback is what ends it."""
     p = _get()
     if iteration in p["serve_raise"]:
         p["serve_raise"].discard(iteration)
@@ -327,6 +367,8 @@ def serve_fire(iteration: int) -> None:
     if iteration in p["serve_hang"]:
         p["serve_hang"].discard(iteration)
         time.sleep(float(os.environ.get(HANG_ENV_VAR, "2.0")))
+    if "canary_regress" in p["points"]:
+        time.sleep(float(os.environ.get(CANARY_REGRESS_ENV_VAR, "0.05")))
 
 
 def serve_corrupt_at(iteration: int) -> bool:
@@ -450,6 +492,20 @@ def train_stall(step: int) -> None:
         time.sleep(float(os.environ.get(SKEW_ENV_VAR, "0.5")))
 
 
+def scale_flap_at(tick: int) -> bool:
+    """Whether the autoscaler's observed capacity signal must OSCILLATE
+    at this control tick (``scale_flap@A-B``). Deliberately NOT
+    one-shot — a flap window spans many ticks; hysteresis + cooldowns
+    are what must hold the fleet steady through it."""
+    return tick in _get()["scale_flap"]
+
+
+def canary_regress_armed() -> bool:
+    """Whether the persistent canary step-time penalty is armed (the
+    judge/test side can ask without paying the sleep)."""
+    return "canary_regress" in _get()["points"]
+
+
 def heartbeat_silenced(process_index: int) -> bool:
     """Whether heartbeat publications from this process index are muted
     (``heartbeat_silence@P``). Deliberately NOT one-shot — a partitioned
@@ -485,6 +541,21 @@ def check(point: str) -> None:
     if points[point] <= 0:
         del points[point]
         raise FaultInjected(f"injected failure at {point}")
+
+
+def consume(point: str) -> bool:
+    """Consuming call-point fault (``router_stale_metrics@N``): each
+    armed call returns True AND spends one count — the fault fires on
+    the next N calls, then disarms. The inverse budget shape from
+    :func:`check` (which fires ONCE, on the Nth call): use this for
+    "the next N occurrences misbehave" windows."""
+    points = _get()["points"]
+    if point not in points:
+        return False
+    points[point] -= 1
+    if points[point] <= 0:
+        del points[point]
+    return True
 
 
 def stall(point: str) -> None:

@@ -1,0 +1,1336 @@
+"""Autonomous fleet control plane (ISSUE 18): autoscaler, predictive
+admission, canaried rollout.
+
+The load-bearing contracts:
+
+- predictive admission computes an HONEST ``Retry-After`` — backlog
+  ahead of the request's priority class divided by the measured fleet
+  service rate — and proactively sheds classes whose predicted wait
+  exceeds their bound (batch first, high last);
+- the autoscaler's ``decide()`` is a PURE hysteresis/cooldown state
+  machine: sustained burn/utilization scales up, sustained calm drains
+  the least-loaded replica, a flapping signal (``scale_flap`` fault)
+  moves nothing, and a recorded signal trace replays to byte-identical
+  decisions with no fleet and no clock;
+- ``/fleet/metrics`` advertises per-replica scrape age and EXCLUDES
+  stale bodies from the aggregate; slo_report and the signal extractor
+  treat stale replicas as missing, never as healthy-at-last-scrape;
+- the canary judge reuses slo_report's burn gate and perf_gate's
+  regression slack, refuses to promote on thin evidence, and the
+  controller always rolls back to the exact previous argv/env;
+- ``Fleet.scale_down`` drains the least-loaded replica by the router's
+  score and RELEASES its supervision lease; ``scale_up`` mints fresh
+  slots with fresh restart budgets.
+
+Quick tier: injectable clocks/transports, canned expositions, fake
+fleets. Slow tier: diurnal trace replay + SIGKILL mid-scale-down over
+a real fleet (zero failed requests, compile pin), and a deliberately
+perf-regressed canary (``canary_regress`` fault) auto-rolling back
+unattended with zero failed requests.
+"""
+
+import importlib.util
+import json
+import math
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from differential_transformer_replication_tpu.config import (
+    AutoscalerConfig,
+    RouterConfig,
+)
+from differential_transformer_replication_tpu.obs.registry import (
+    Registry,
+    parse_exposition,
+)
+from differential_transformer_replication_tpu.serving import admission
+from differential_transformer_replication_tpu.serving.retry import (
+    http_post_json_with_retries,
+)
+from differential_transformer_replication_tpu.serving.router import (
+    DRAINING,
+    UP,
+    Router,
+    serve_router,
+)
+from differential_transformer_replication_tpu.utils import faults
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(TOOLS, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod  # dataclasses resolve __module__ via here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+autoscaler = _load_tool("autoscaler")
+slo_report = _load_tool("slo_report")
+
+
+def _cfg(**kw):
+    kw.setdefault("probe_interval_s", 0.05)
+    kw.setdefault("probe_backoff_s", 0.05)
+    kw.setdefault("probe_backoff_max_s", 0.4)
+    kw.setdefault("retry_base_s", 0.001)
+    kw.setdefault("retry_cap_s", 0.01)
+    kw.setdefault("wait_for_replica_s", 0.0)
+    return RouterConfig(**kw)
+
+
+def _scfg(**kw):
+    return AutoscalerConfig(**kw)
+
+
+class _Events:
+    """Recording event sink (obs/events.py surface)."""
+
+    def __init__(self):
+        self.rows = []
+
+    def emit(self, event, **kw):
+        self.rows.append((event, kw))
+
+    def names(self):
+        return [e for e, _ in self.rows]
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def _stepper(step=1.0):
+    """Injectable monotonic clock: advances ``step`` per call."""
+    t = {"v": 0.0}
+
+    def now():
+        t["v"] += step
+        return t["v"]
+
+    return now
+
+
+def _engine_expo(high=0, normal=0, batch=0, running=0, completed=0):
+    """One replica's /metrics body, admission-relevant gauges only."""
+    return (
+        f'serving_queue_depth_by_class{{priority="high"}} {high}\n'
+        f'serving_queue_depth_by_class{{priority="normal"}} {normal}\n'
+        f'serving_queue_depth_by_class{{priority="batch"}} {batch}\n'
+        f"serving_queue_depth {high + normal + batch}\n"
+        f"serving_slot_occupancy {running}\n"
+        f"serving_requests_completed_total {completed}\n"
+    )
+
+
+def _hist_expo(good, total, extra=""):
+    """A fleet body whose TTFT histogram has ``good`` fast requests
+    out of ``total`` (cumulative, as scrapes are)."""
+    return (
+        f'serving_ttft_seconds_bucket{{le="0.5"}} {good}\n'
+        f'serving_ttft_seconds_bucket{{le="+Inf"}} {total}\n'
+        f"serving_ttft_seconds_count {total}\n" + extra
+    )
+
+
+# -- admission math (the Retry-After oracle) -----------------------------
+
+
+class TestAdmissionMath:
+    def test_backlog_ahead_ranks_by_priority(self):
+        queued = {"high": 1.0, "normal": 3.0, "batch": 2.0}
+        assert admission.backlog_ahead(queued, 4.0, "high") == 5.0
+        assert admission.backlog_ahead(queued, 4.0, "normal") == 8.0
+        assert admission.backlog_ahead(queued, 4.0, "batch") == 10.0
+        # unknown classes rank as normal; negative gauges clamp
+        assert admission.backlog_ahead(queued, 4.0, "weird") == 8.0
+        assert admission.backlog_ahead({"normal": -5.0}, -1.0, "normal") \
+            == 0.0
+
+    def test_predicted_wait(self):
+        assert admission.predicted_wait_s(10.0, 2.0) == 5.0
+        assert admission.predicted_wait_s(10.0, None) is None
+        assert admission.predicted_wait_s(10.0, 0.0) is None
+        assert admission.predicted_wait_s(-3.0, 2.0) == 0.0
+
+    def test_honest_retry_after_floor_cap_fallback(self):
+        # unmeasured fleet: static fallback (still floored at 1)
+        assert admission.honest_retry_after(None, 3.0, 30.0) == 3.0
+        assert admission.honest_retry_after(None, 0.2, 30.0) == 1.0
+        # measured: floored at 1 s, capped at cap_s
+        assert admission.honest_retry_after(0.1, 3.0, 30.0) == 1.0
+        assert admission.honest_retry_after(12.5, 3.0, 30.0) == 12.5
+        assert admission.honest_retry_after(1000.0, 3.0, 30.0) == 30.0
+
+
+class TestAdmissionController:
+    def _fed(self, **cfg_kw):
+        ac = admission.AdmissionController(_cfg(**cfg_kw))
+        ac.observe_replica("r0", _engine_expo(completed=0), now=0.0)
+        ac.observe_replica(
+            "r0",
+            _engine_expo(high=1, normal=3, batch=2, running=4,
+                         completed=20),
+            now=10.0,
+        )
+        return ac
+
+    def test_rate_and_predicted_wait_from_expositions(self):
+        ac = self._fed()
+        # 20 completions over 10 s -> 2/s (first measured rate seeds
+        # the EWMA directly)
+        assert ac.service_rate() == pytest.approx(2.0)
+        assert ac.predicted_wait("normal") == pytest.approx(4.0)
+        assert ac.predicted_wait("high") == pytest.approx(2.5)
+        assert ac.predicted_wait("batch") == pytest.approx(5.0)
+        assert ac.retry_after_s("normal") == pytest.approx(4.0)
+
+    def test_admit_sheds_by_class_bound(self):
+        ac = self._fed(admission_wait_bound_s=2.0)
+        # normal bound 2.0 < wait 4.0 -> shed with the honest header
+        d = ac.admit("normal")
+        assert not d.admitted
+        assert d.retry_after_s == pytest.approx(4.0)
+        assert "normal" in d.reason
+        # high tolerates 2x the bound: wait 2.5 <= 4.0 -> admitted
+        assert ac.admit("high").admitted
+        # batch tolerates half: wait 5.0 > 1.0 -> shed first
+        assert not ac.admit("batch").admitted
+
+    def test_unmeasured_fleet_admits(self):
+        ac = admission.AdmissionController(
+            _cfg(admission_wait_bound_s=0.5)
+        )
+        ac.observe_replica(
+            "r0", _engine_expo(normal=50, running=8), now=0.0
+        )
+        d = ac.admit("normal")  # no rate yet: not evidence to shed on
+        assert d.admitted and d.predicted_wait_s is None
+
+    def test_restart_safe_counter_and_forget(self):
+        ac = self._fed()
+        # replica relaunch: completed counter goes 20 -> 5; the delta
+        # contributes zero, never a negative rate
+        ac.observe_replica("r0", _engine_expo(completed=5), now=20.0)
+        rate = ac.service_rate()
+        assert rate is not None and 0.0 <= rate < 2.0
+        # scaled-away replica leaves the backlog model entirely
+        ac.forget_replica("r0")
+        assert ac.predicted_wait("batch") == pytest.approx(0.0)
+
+
+# -- router integration: proactive shed, canary split, membership --------
+
+
+class TestRouterAdmission:
+    def _router(self, n=1, cfg=None):
+        return Router(
+            [f"http://127.0.0.1:{19000 + i}" for i in range(n)],
+            cfg or _cfg(), rng=random.Random(0),
+        )
+
+    def _feed(self, router):
+        router.admission.observe_replica(
+            "r", _engine_expo(completed=0), now=0.0
+        )
+        router.admission.observe_replica(
+            "r", _engine_expo(normal=8, running=2, completed=10),
+            now=10.0,
+        )  # rate 1/s; wait: normal 10 s, high 2 s
+
+    def test_proactive_shed_with_honest_retry_after(self):
+        router = self._router(cfg=_cfg(admission_wait_bound_s=2.5))
+        self._feed(router)
+        status, body, headers = router.handle_generate(
+            {"prompt_ids": [1], "priority": "normal"}
+        )
+        assert status == 503
+        assert body["code"] == "admission_shed"
+        assert "trace_id" in body
+        assert headers["Retry-After"] == "10"
+        reg = router.registry.render()
+        assert 'router_admission_shed_total{priority="normal"} 1' in reg
+
+    def test_admitted_class_sheds_honest_on_no_replica(self):
+        router = self._router(cfg=_cfg(admission_wait_bound_s=2.5))
+        self._feed(router)
+        # high's bound is 5 s > its 2 s wait: admitted past the gate,
+        # but nothing is eligible -> no_replica shed STILL carries the
+        # honest per-class header, not the static default
+        status, body, headers = router.handle_generate(
+            {"prompt_ids": [1], "priority": "high"}
+        )
+        assert status == 503
+        assert body["code"] == "no_replica"
+        assert headers["Retry-After"] == "2"
+
+    def test_admission_off_restores_static_header(self):
+        router = self._router(
+            cfg=_cfg(admission_predictive=False, shed_retry_after_s=7.0)
+        )
+        assert router.admission is None
+        status, body, headers = router.handle_generate(
+            {"prompt_ids": [1]}
+        )
+        assert status == 503 and body["code"] == "no_replica"
+        assert headers["Retry-After"] == "7"
+
+
+class TestRouterCanary:
+    def _up_router(self, n=2):
+        router = Router(
+            [f"http://127.0.0.1:{19000 + i}" for i in range(n)],
+            _cfg(), rng=random.Random(0),
+        )
+        for r in router.replicas:
+            r.note_probe_success(True, "healthy", {}, now=0.0)
+        return router
+
+    def test_split_fraction_and_pool_exclusion(self):
+        router = self._up_router()
+        a, b = router.replicas
+        router.set_canary(b.url, 0.25)
+        assert router.canary() == (b.url, 0.25)
+        picks = [router.pick() for _ in range(2000)]
+        frac = sum(1 for p in picks if p is b) / len(picks)
+        # the canary's share is the configured fraction, NOT
+        # fraction + a p2c share; everything else goes to the pool
+        assert 0.18 < frac < 0.32
+        assert all(p is a or p is b for p in picks)
+
+    def test_new_pins_avoid_canary_and_clear_restores(self):
+        router = self._up_router()
+        a, b = router.replicas
+        router.set_canary(b.url, 0.4)
+        for i in range(20):
+            assert router.pick(session_id=f"s{i}") is a
+        router.set_canary(None)
+        assert router.canary() == (None, 0.0)
+        picks = [router.pick() for _ in range(200)]
+        assert any(p is b for p in picks)  # back in the pool
+
+    def test_canary_serves_when_alone(self):
+        router = self._up_router()
+        a, b = router.replicas
+        router.set_canary(b.url, 0.1)
+        with a.lock:
+            a.state = DRAINING
+        # serving beats shedding: the canary takes 100% when it is the
+        # only eligible replica
+        assert router.pick() is b
+
+    def test_set_canary_validation(self):
+        router = self._up_router()
+        a, _b = router.replicas
+        with pytest.raises(ValueError):
+            router.set_canary("http://127.0.0.1:9999", 0.5)
+        with pytest.raises(ValueError):
+            router.set_canary(a.url, 0.0)
+        with pytest.raises(ValueError):
+            router.set_canary(a.url, 1.0)
+
+
+class TestRouterMembership:
+    def test_add_remove_replica_lifecycle(self):
+        events = _Events()
+        router = Router(
+            ["http://127.0.0.1:19000", "http://127.0.0.1:19001"],
+            _cfg(), rng=random.Random(0), events=events,
+        )
+        a, b = router.replicas
+        rep = router.add_replica("http://127.0.0.1:19002")
+        assert len(router.replicas) == 3
+        with pytest.raises(ValueError):
+            router.add_replica("http://127.0.0.1:19002")
+        # pin a session to the new replica, then remove it: the pin
+        # must not dangle
+        rep.note_probe_success(True, "healthy", {}, now=0.0)
+        assert router.pick(session_id="sess") is rep
+        removed = router.remove_replica(rep.url)
+        assert removed is rep
+        assert len(router.replicas) == 2
+        assert "sess" not in router._affinity
+        # removing the canary clears the designation
+        router.set_canary(b.url, 0.5)
+        router.remove_replica(b.url)
+        assert router.canary() == (None, 0.0)
+        # the fleet never shrinks to zero through the router
+        with pytest.raises(ValueError):
+            router.remove_replica(a.url)
+        assert router.remove_replica("http://127.0.0.1:9999") is None
+        for name in ("replica_added", "replica_removed",
+                     "canary_traffic_split"):
+            assert name in events.names()
+
+    def test_replicas_gauge_tracks_membership(self):
+        router = Router(
+            ["http://127.0.0.1:19000", "http://127.0.0.1:19001"],
+            _cfg(), rng=random.Random(0),
+        )
+        router.add_replica("http://127.0.0.1:19002")
+        assert "router_replicas 3" in router.registry.render()
+        router.remove_replica("http://127.0.0.1:19002")
+        assert "router_replicas 2" in router.registry.render()
+
+
+# -- /fleet/metrics staleness (satellite c) ------------------------------
+
+
+class TestFleetMetricsStaleness:
+    def _queue_by_replica(self, text):
+        _, samples = parse_exposition(text)
+        return {
+            labels["replica"]: v
+            for n, labels, v in samples
+            if n == "serving_queue_depth" and "replica" in labels
+        }
+
+    def test_stale_bodies_excluded_and_age_advertised(self):
+        router = Router(
+            ["http://127.0.0.1:19000", "http://127.0.0.1:19001"],
+            _cfg(metrics_max_age_s=10.0), rng=random.Random(0),
+        )
+        r0, r1 = router.replicas
+        with r0.lock:
+            r0.metrics_text = "serving_queue_depth 5\n"  # no stamp
+        with r1.lock:
+            r1.metrics_text = "serving_queue_depth 7\n"
+            r1.metrics_t = 100.0
+        text = router.fleet_metrics(now=250.0)
+        # the stale body is EXCLUDED from the aggregate, and its age
+        # is advertised so downstream judges see it as missing
+        per = self._queue_by_replica(text)
+        assert per.get(r0.name) == 5.0  # unstamped: back-compat, kept
+        assert r1.name not in per
+        _, samples = parse_exposition(text)
+        ages = {
+            labels["replica"]: v for n, labels, v in samples
+            if n == "fleet_scrape_age_seconds"
+        }
+        assert ages == {r1.name: pytest.approx(150.0)}
+        # the unstamped replica advertises NO age (unknowable), so
+        # downstream age gates never misjudge it
+        # a fresh stamp re-admits the body
+        with r1.lock:
+            r1.metrics_t = 245.0
+        per = self._queue_by_replica(router.fleet_metrics(now=250.0))
+        assert per.get(r1.name) == 7.0
+
+    def test_max_age_zero_disables_exclusion(self):
+        router = Router(
+            ["http://127.0.0.1:19000"], _cfg(metrics_max_age_s=0.0),
+            rng=random.Random(0),
+        )
+        (r0,) = router.replicas
+        with r0.lock:
+            r0.metrics_text = "serving_queue_depth 3\n"
+            r0.metrics_t = 0.0
+        per = self._queue_by_replica(router.fleet_metrics(now=9999.0))
+        assert per.get(r0.name) == 3.0
+
+
+class TestSloReportStaleness:
+    def _args(self, max_scrape_age=5.0):
+        return SimpleNamespace(
+            ttft=1.0, itl=0.25, target=0.99, availability_target=0.999,
+            priority_class=None, max_scrape_age=max_scrape_age,
+            max_burn=1.0, require_traffic=False,
+        )
+
+    TEXT = (
+        'fleet_scrape_age_seconds{replica="a"} 100\n'
+        'fleet_scrape_age_seconds{replica="b"} 1\n'
+        'slo_burn_rate{objective="ttft",replica="a"} 5.0\n'
+        'slo_burn_rate{objective="ttft",replica="b"} 0.2\n'
+    )
+
+    def test_stale_replicas_listed_and_gauges_dropped(self):
+        rep = slo_report.report_from_exposition(self.TEXT, self._args())
+        assert rep["stale_replicas"] == ["a"]
+        assert rep["scrape_age_seconds"] == {"a": 100, "b": 1}
+        live = rep["server_reported_burn_rates"]
+        assert "ttft@b" in live and "ttft@a" not in live
+        violations = slo_report.check(rep, self._args())
+        assert violations and "stale" in violations[0]
+
+    def test_age_gate_off_by_default(self):
+        rep = slo_report.report_from_exposition(
+            self.TEXT, self._args(max_scrape_age=0.0)
+        )
+        assert "stale_replicas" not in rep
+        assert "ttft@a" in rep["server_reported_burn_rates"]
+        assert not slo_report.check(rep, self._args(max_scrape_age=0.0))
+
+
+# -- signal extraction ---------------------------------------------------
+
+
+class TestSignalExtractor:
+    def test_windowed_burn_and_util_scores(self):
+        cfg = _scfg(ttft_threshold_s=0.5, slo_target=0.9,
+                    stale_after_s=5.0)
+        ex = autoscaler.SignalExtractor(cfg)
+        gauges = (
+            'serving_slots{replica="a"} 4\n'
+            'serving_slot_occupancy{replica="a"} 2\n'
+            'serving_queue_depth{replica="a"} 8\n'
+            'serving_kv_utilization{replica="a"} 0.3\n'
+            'fleet_replica_up{replica="a",state="up"} 1\n'
+        )
+        sig1 = ex.extract(_hist_expo(10, 10, gauges))
+        assert sig1.ok and sig1.burn == pytest.approx(0.0)
+        assert sig1.util == pytest.approx(1.0)  # queue 8 / 4 slots
+        assert sig1.queue_depth == 8.0
+        assert sig1.replicas_up == 1 and sig1.stale_replicas == 0
+        # next poll: 10 new requests, all slow -> window err 1.0,
+        # burn 1.0 / (1 - 0.9) = 10
+        sig2 = ex.extract(_hist_expo(10, 20, gauges))
+        assert sig2.burn == pytest.approx(10.0)
+
+    def test_stale_replica_dropped_from_util(self):
+        cfg = _scfg(stale_after_s=5.0)
+        ex = autoscaler.SignalExtractor(cfg)
+        sig = ex.extract(
+            'serving_slots{replica="a"} 4\n'
+            'serving_queue_depth{replica="a"} 8\n'
+            'fleet_scrape_age_seconds{replica="a"} 10\n'
+        )
+        assert sig.stale_replicas == 1
+        assert sig.util == 0.0 and sig.queue_depth == 0.0
+
+    def test_shrinking_fleet_resets_window(self):
+        ex = autoscaler.SignalExtractor(_scfg())
+        ex.extract(_hist_expo(0, 30))
+        # a replica left the aggregate: cumulative counts step BACK;
+        # the window resets instead of reporting negative traffic
+        sig = ex.extract(_hist_expo(0, 5))
+        assert sig.burn is None
+
+    def test_replica_utils_pressure_sources(self):
+        utils = autoscaler._replica_utils({
+            "serving_slots": 4.0,
+            "serving_slot_occupancy": 2.0,
+            "serving_queue_depth": 1.0,
+            "serving_kv_utilization": 0.7,
+            "serving_kv_pages_total": 100.0,
+            "serving_kv_pages_free": 25.0,
+            "serving_host_tier_budget_bytes": 1000.0,
+            "serving_host_tier_bytes": 900.0,
+        })
+        assert utils == pytest.approx([0.5, 0.25, 0.7, 0.75, 0.9])
+
+
+# -- the decision state machine ------------------------------------------
+
+
+HIGH = dict(ok=True, burn=5.0, util=0.2)
+LOW = dict(ok=True, burn=0.0, util=0.0)
+
+
+def _sig(**kw):
+    return autoscaler.Signals(**kw)
+
+
+class TestAutoscalerDecide:
+    def _scaler(self, **kw):
+        cfg = _scfg(
+            min_replicas=1, max_replicas=3, scale_up_burn=1.0,
+            scale_down_burn=0.5, scale_up_sustain=3,
+            scale_down_sustain=4, cooldown_up_s=5.0,
+            cooldown_down_s=10.0, util_high=0.85, util_low=0.3, **kw,
+        )
+        return autoscaler.Autoscaler(cfg, initial_replicas=1)
+
+    def test_hysteresis_needs_sustained_pressure(self):
+        sc = self._scaler()
+        assert sc.decide(_sig(**HIGH), 0.0).action == "hold"
+        assert sc.decide(_sig(**HIGH), 1.0).action == "hold"
+        d = sc.decide(_sig(**HIGH), 2.0)
+        assert d.action == "up" and d.target == 2
+
+    def test_cooldown_gates_consecutive_scale_ups(self):
+        sc = self._scaler()
+        for t in range(3):
+            sc.decide(_sig(**HIGH), float(t))  # up at t=2
+        assert sc.current == 2
+        for t in (3.0, 4.0, 5.0, 6.0):
+            d = sc.decide(_sig(**HIGH), t)
+            assert d.action == "hold"
+        assert "cooldown" in d.reason
+        d = sc.decide(_sig(**HIGH), 7.0)  # 5 s since t=2: allowed
+        assert d.action == "up" and d.target == 3
+
+    def test_bounds_hold_at_max_and_min(self):
+        sc = self._scaler()
+        for t in range(3):
+            sc.decide(_sig(**HIGH), float(t))
+        for t in (7.0, 8.0, 9.0):
+            sc.decide(_sig(**HIGH), t)  # second up at t=9
+        assert sc.current == 3
+        for t in (15.0, 16.0, 17.0):
+            d = sc.decide(_sig(**HIGH), t)
+        assert d.action == "hold" and "max_replicas" in d.reason
+        # calm: down twice (cooldown-gated), then pinned at min
+        t = 30.0
+        downs = 0
+        for _ in range(40):
+            d = sc.decide(_sig(**LOW), t)
+            downs += d.action == "down"
+            t += 1.0
+        assert downs == 2 and sc.current == 1
+        assert "min_replicas" in d.reason
+
+    def test_util_alone_triggers_and_burn_none_is_calm(self):
+        sc = self._scaler()
+        for t in range(3):
+            d = sc.decide(_sig(ok=True, burn=None, util=0.95), float(t))
+        assert d.action == "up"  # util pressure, no latency traffic
+        sc2 = self._scaler()
+        sc2.current = 2
+        t = 0.0
+        for _ in range(4):
+            d = sc2.decide(_sig(ok=True, burn=None, util=0.0), t)
+            t += 1.0
+        assert d.action == "down"  # no traffic at all reads as calm
+
+    def test_interleaved_signal_resets_streak(self):
+        sc = self._scaler()
+        sc.decide(_sig(**HIGH), 0.0)
+        sc.decide(_sig(**HIGH), 1.0)
+        sc.decide(_sig(ok=True, burn=0.7, util=0.5), 2.0)  # neither
+        d = sc.decide(_sig(**HIGH), 3.0)
+        assert d.action == "hold" and sc.current == 1
+
+    def test_poll_failure_holds_and_freezes_streaks(self):
+        sc = self._scaler()
+        sc.decide(_sig(**HIGH), 0.0)
+        sc.decide(_sig(**HIGH), 1.0)
+        d = sc.decide(_sig(ok=False), 2.0)
+        assert d.action == "hold" and "poll failed" in d.reason
+        # the streak FROZE (a blackhole is not evidence of calm):
+        # the next high tick completes the sustain
+        d = sc.decide(_sig(**HIGH), 3.0)
+        assert d.action == "up" and d.target == 2
+
+
+class TestAutoscalerTick:
+    def test_flap_fault_absorbed_by_hysteresis(self):
+        faults.arm("scale_flap@0-19")
+        events = _Events()
+        sc = autoscaler.Autoscaler(
+            _scfg(min_replicas=1, max_replicas=4, scale_up_sustain=2,
+                  scale_down_sustain=2),
+            poll=lambda: "", events=events, now_fn=_stepper(),
+            initial_replicas=2,
+        )
+        decisions = [sc.tick() for _ in range(20)]
+        # the injected oscillation (saturated <-> idle every tick)
+        # never sustains either way: the fleet does not move
+        assert all(d.action == "hold" for d in decisions)
+        assert sc.current == 2
+        assert events.names().count("autoscaler_decision") == 20
+
+    def test_tick_records_and_replay_is_bit_identical(self, tmp_path):
+        record = tmp_path / "scaler.jsonl"
+        bodies = [
+            _hist_expo(0, 10), _hist_expo(0, 20), _hist_expo(0, 30),
+        ] + [_hist_expo(10 * k, 30 + 10 * k) for k in range(1, 9)]
+        it = iter(bodies)
+        cfg = _scfg(
+            min_replicas=1, max_replicas=4, scale_up_sustain=2,
+            scale_down_sustain=3, cooldown_up_s=1.0,
+            cooldown_down_s=2.0, ttft_threshold_s=0.5, slo_target=0.9,
+        )
+        registry = Registry()
+        sc = autoscaler.Autoscaler(
+            cfg, poll=lambda: next(it), registry=registry,
+            now_fn=_stepper(), record_path=str(record),
+            initial_replicas=1,
+        )
+        live = [sc.tick() for _ in range(len(bodies))]
+        sc.close()
+        actions = [d.action for d in live]
+        assert "up" in actions and "down" in actions
+        rows = [
+            json.loads(line)
+            for line in record.read_text().splitlines() if line
+        ]
+        assert len(rows) == len(bodies)
+        # the reproducibility contract: the recorded signal trace
+        # replays through the pure state machine to BYTE-identical
+        # decisions — no fleet, no clock, no poller
+        replayed = autoscaler.replay(rows, cfg, initial_replicas=1)
+        assert [d.to_row() for d in replayed] \
+            == [row["decision"] for row in rows]
+        reg = registry.render()
+        assert "autoscaler_replicas_target" in reg
+        assert 'autoscaler_decisions_total{action="up"} 1' in reg
+        assert "autoscaler_burn_observed" in reg
+
+    def test_actuation_failure_reverts_target(self):
+        class _Failing:
+            def replicas(self):
+                return 1
+
+            def scale_up(self, n=1):
+                raise RuntimeError("SIGKILL mid-scale")
+
+            def scale_down(self):
+                raise RuntimeError("nope")
+
+        events = _Events()
+        sc = autoscaler.Autoscaler(
+            _scfg(scale_up_sustain=1), poll=lambda: _hist_expo(0, 10),
+            actuator=_Failing(), events=events, now_fn=_stepper(),
+        )
+        ex = autoscaler.SignalExtractor(sc.cfg)
+        del ex
+        d = sc.tick()
+        assert d.action == "up" and d.target == 2
+        # the scale never took: the target reverts so the state
+        # machine must re-earn the decision next window
+        assert sc.current == 1
+        assert "autoscaler_scale_failed" in events.names()
+
+
+class TestFleetActuator:
+    def test_scale_paths_wire_fleet_and_router(self):
+        calls = []
+
+        class _F:
+            replicas = [1, 2]
+
+            def scale_up(self, n=1):
+                calls.append(("fleet_up", n))
+                return ["http://127.0.0.1:19007"]
+
+            def scale_down(self, score_of=None):
+                # the canary must be invisible to victim selection
+                assert score_of("http://c") is None
+                assert score_of("http://a") == 0.25
+                calls.append(("fleet_down",))
+                return "http://a"
+
+        class _R:
+            replicas = [
+                SimpleNamespace(url="http://a", score=lambda: 0.25),
+                SimpleNamespace(url="http://c", score=lambda: 0.0),
+            ]
+
+            def canary(self):
+                return "http://c", 0.3
+
+            def add_replica(self, url):
+                calls.append(("router_add", url))
+
+            def remove_replica(self, url):
+                calls.append(("router_remove", url))
+
+        act = autoscaler.FleetActuator(_F(), _R())
+        assert act.replicas() == 2
+        act.scale_up()
+        act.scale_down()
+        assert calls == [
+            ("fleet_up", 1),
+            ("router_add", "http://127.0.0.1:19007"),
+            ("fleet_down",),
+            ("router_remove", "http://a"),
+        ]
+
+
+# -- canary judgment -----------------------------------------------------
+
+
+def _stats(**kw):
+    base = {"count": 20.0, "error_ratio": 0.0, "burn_rate": 0.0,
+            "target": 0.99, "p95_ttft_s": 0.5}
+    base.update(kw)
+    return base
+
+
+class TestCanaryJudge:
+    CFG = AutoscalerConfig(canary_min_requests=8, canary_max_burn=1.0,
+                           canary_max_regress=0.5)
+
+    def test_histogram_quantile(self):
+        assert autoscaler.histogram_quantile([], [], 0, 0.95) is None
+        assert autoscaler.histogram_quantile(
+            [0.1, 0.5, 1.0], [5, 9, 10], 10, 0.5
+        ) == 0.1
+        assert autoscaler.histogram_quantile(
+            [0.1, 0.5, 1.0], [5, 9, 10], 10, 0.95
+        ) == 1.0
+        assert autoscaler.histogram_quantile(
+            [0.1], [1], 10, 0.95
+        ) == math.inf
+
+    def test_window_stats_deltas_and_restart_clamp(self):
+        before = _hist_expo(5, 5)
+        after = _hist_expo(14, 15)
+        ws = autoscaler.window_stats([(before, after)], 0.5, 0.9)
+        assert ws["count"] == 10.0
+        assert ws["error_ratio"] == pytest.approx(0.1)
+        assert ws["burn_rate"] == pytest.approx(1.0)
+        assert ws["p95_ttft_s"] == math.inf  # the slow one is beyond
+        # restarted replica: counters stepped back -> empty window,
+        # never negative counts
+        ws = autoscaler.window_stats([(after, before)], 0.5, 0.9)
+        assert ws["count"] == 0.0 and ws["burn_rate"] is None
+        ws = autoscaler.window_stats([("", "")], 0.5, 0.9)
+        assert ws["count"] == 0.0
+
+    def test_thin_evidence_rolls_back(self):
+        verdict, reason = autoscaler.judge_canary(
+            _stats(count=3.0), _stats(), self.CFG
+        )
+        assert verdict == "rollback" and "inconclusive" in reason
+
+    def test_burn_violation_rolls_back(self):
+        verdict, reason = autoscaler.judge_canary(
+            _stats(burn_rate=5.0, error_ratio=0.05), _stats(), self.CFG
+        )
+        assert verdict == "rollback" and "burn rate" in reason
+
+    def test_p95_regression_rolls_back(self):
+        # control 0.5 s, 50% slack -> 0.75 s allowed; canary 1.0 s
+        verdict, reason = autoscaler.judge_canary(
+            _stats(p95_ttft_s=1.0), _stats(p95_ttft_s=0.5), self.CFG
+        )
+        assert verdict == "rollback" and "p95" in reason
+        # within slack: promoted
+        verdict, _ = autoscaler.judge_canary(
+            _stats(p95_ttft_s=0.7), _stats(p95_ttft_s=0.5), self.CFG
+        )
+        assert verdict == "promote"
+
+    def test_unbounded_canary_p95_rolls_back(self):
+        verdict, reason = autoscaler.judge_canary(
+            _stats(p95_ttft_s=math.inf), _stats(p95_ttft_s=0.5),
+            self.CFG,
+        )
+        assert verdict == "rollback" and "histogram range" in reason
+
+    def test_idle_control_skips_regression_gate(self):
+        verdict, _ = autoscaler.judge_canary(
+            _stats(p95_ttft_s=2.0), _stats(p95_ttft_s=None, count=0.0),
+            self.CFG,
+        )
+        assert verdict == "promote"
+
+
+class _FakeCanaryFleet:
+    def __init__(self):
+        self.replicas = [
+            SimpleNamespace(index=0, url="http://c0"),
+            SimpleNamespace(index=1, url="http://c1"),
+        ]
+        self.relaunches = []
+
+    def relaunch_replica(self, index, server_args=None, extra_env=None,
+                         argv=None, env=None, ready_check=None):
+        self.relaunches.append({
+            "index": index, "server_args": server_args,
+            "extra_env": extra_env, "argv": argv, "env": env,
+        })
+        return ["old", "argv"], {"OLD": "1"}
+
+
+class _FakeCanaryRouter:
+    def __init__(self):
+        self.calls = []
+        self.replicas = []
+
+    def set_canary(self, url, fraction=0.0):
+        self.calls.append((url, fraction))
+
+
+class TestCanaryController:
+    def _run(self, canary_after, control_after):
+        fleet = _FakeCanaryFleet()
+        router = _FakeCanaryRouter()
+        events = _Events()
+        phase = {"v": "before"}
+        expos = {
+            ("http://c1", "before"): _hist_expo(0, 0),
+            ("http://c1", "after"): canary_after,
+            ("http://c0", "before"): _hist_expo(0, 0),
+            ("http://c0", "after"): control_after,
+        }
+        cc = autoscaler.CanaryController(
+            fleet, router,
+            _scfg(canary_fraction=0.25, canary_window_s=0.5,
+                  canary_min_requests=8, ttft_threshold_s=0.5,
+                  slo_target=0.9),
+            events=events,
+            sleep_fn=lambda s: phase.__setitem__("v", "after"),
+            fetch=lambda u: expos[(u, phase["v"])],
+        )
+        record = cc.run(server_args=["--model", "new"], index=1)
+        return record, fleet, router, events
+
+    def test_regressed_canary_rolls_back_to_old_argv(self):
+        record, fleet, router, events = self._run(
+            canary_after=_hist_expo(0, 20),     # 20 reqs, all slow
+            control_after=_hist_expo(20, 20),   # 20 reqs, all fast
+        )
+        assert record["verdict"] == "rollback"
+        assert len(fleet.relaunches) == 2
+        assert fleet.relaunches[0]["server_args"] == ["--model", "new"]
+        # the rollback relaunch passes back EXACTLY what the first
+        # relaunch returned
+        assert fleet.relaunches[1]["argv"] == ["old", "argv"]
+        assert fleet.relaunches[1]["env"] == {"OLD": "1"}
+        # the split always clears, promoted or not
+        assert router.calls == [("http://c1", 0.25), (None, 0.0)]
+        names = events.names()
+        assert names.index("canary_started") \
+            < names.index("canary_judged") \
+            < names.index("canary_rolled_back")
+
+    def test_healthy_canary_promotes_without_relaunch(self):
+        record, fleet, router, events = self._run(
+            canary_after=_hist_expo(20, 20),
+            control_after=_hist_expo(20, 20),
+        )
+        assert record["verdict"] == "promote"
+        assert len(fleet.relaunches) == 1  # no rollback relaunch
+        assert router.calls == [("http://c1", 0.25), (None, 0.0)]
+        assert "canary_promoted" in events.names()
+
+
+# -- fault points (satellite b) ------------------------------------------
+
+
+class TestControlPlaneFaults:
+    def test_scale_flap_is_a_tick_window(self):
+        faults.arm("scale_flap@2-4")
+        assert not faults.scale_flap_at(1)
+        assert all(faults.scale_flap_at(t) for t in (2, 3, 4))
+        assert not faults.scale_flap_at(5)
+        # NOT one-shot: the window persists across queries
+        assert faults.scale_flap_at(3)
+
+    def test_router_stale_metrics_consumes_n(self):
+        faults.arm("router_stale_metrics@2")
+        assert faults.consume("router_stale_metrics")
+        assert faults.consume("router_stale_metrics")
+        assert not faults.consume("router_stale_metrics")
+
+    def test_canary_regress_is_persistent(self, monkeypatch):
+        monkeypatch.setenv(faults.CANARY_REGRESS_ENV_VAR, "0.02")
+        faults.arm("canary_regress")
+        assert faults.canary_regress_armed()
+        for _ in range(2):  # persistent: fires every iteration
+            t0 = time.perf_counter()
+            faults.serve_fire(0)
+            assert time.perf_counter() - t0 >= 0.015
+        assert faults.canary_regress_armed()
+
+    def test_stale_metrics_fault_freezes_probe_body(self):
+        faults.arm("router_stale_metrics@1000000")
+        replies = {
+            "/ready": (200, json.dumps(
+                {"ready": True, "status": "healthy"}
+            ).encode()),
+            "/metrics": (200, b"serving_queue_depth 1\n"),
+        }
+        router = Router(
+            ["http://127.0.0.1:19000"], _cfg(metrics_max_age_s=5.0),
+            rng=random.Random(0),
+        )
+        router._http_get = lambda url, timeout: replies[
+            "/" + url.rsplit("/", 1)[1]
+        ]
+        (rep,) = router.replicas
+        router.probe(rep, now=0.0)
+        # the blackholed scrape never lands: no body, no stamp
+        assert rep.metrics_text == "" and rep.metrics_t is None
+        faults.reset()
+        router.probe(rep, now=1.0)
+        assert rep.metrics_text and rep.metrics_t == 1.0
+
+
+# -- fleet scale surface (satellite d) -----------------------------------
+
+
+def _load_fleet():
+    spec = importlib.util.spec_from_file_location(
+        "fleet", os.path.join(TOOLS, "fleet.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestFleetScaling:
+    def test_scale_down_drains_least_loaded_and_releases_lease(self):
+        fleet_mod = _load_fleet()
+        fleet = fleet_mod.Fleet(3, ports=[28100, 28101, 28102])
+        scores = {
+            fleet.replicas[0].url: 2.0,
+            fleet.replicas[1].url: 0.5,
+            fleet.replicas[2].url: 1.0,
+        }
+        fleet._relaunch_at[1] = 999.0  # pretend a relaunch is pending
+        url = fleet.scale_down(score_of=scores.get)
+        # least-loaded by the router's score, and its supervision
+        # lease (pending relaunch) is RELEASED with the slot
+        assert url.endswith(":28101")
+        assert [r.index for r in fleet.replicas] == [0, 2]
+        assert 1 not in fleet._relaunch_at
+        # no scores at all: fall back to the highest index
+        assert fleet.scale_down().endswith(":28102")
+        with pytest.raises(ValueError):
+            fleet.scale_down()  # never below one replica
+
+    def test_scale_down_explicit_index(self):
+        fleet_mod = _load_fleet()
+        fleet = fleet_mod.Fleet(2, ports=[28105, 28106])
+        assert fleet.scale_down(index=0).endswith(":28105")
+        with pytest.raises(ValueError):
+            fleet.scale_down(index=99)
+
+    def test_scale_up_mints_fresh_slots(self):
+        fleet_mod = _load_fleet()
+        fleet = fleet_mod.Fleet(
+            2, ports=[28110, 28111],
+            server_args=["--event-log", "ev-{replica}.jsonl"],
+        )
+        launched = []
+        fleet._launch = launched.append
+        urls = fleet.scale_up(2, wait_ready=False)
+        assert len(urls) == 2 and len(fleet.replicas) == 4
+        assert [r.index for r in launched] == [2, 3]
+        r2 = launched[0]
+        assert r2.restarts == 0 and not r2.gave_up  # fresh budget
+        assert "ev-2.jsonl" in r2.argv  # per-replica templating holds
+        # indices are never reused: a scar on slot 3 cannot haunt a
+        # future scale-up
+        fleet.scale_down(index=3)
+        assert fleet.scale_up(1, wait_ready=False)
+        assert fleet.replicas[-1].index == 4
+
+    def test_relaunch_replica_overrides_and_restores(self):
+        fleet_mod = _load_fleet()
+        fleet = fleet_mod.Fleet(
+            1, ports=[28120], server_args=["--model", "base"]
+        )
+        fleet._restart_one = lambda r, ready_check=None: None
+        old = fleet.relaunch_replica(
+            0, server_args=["--model", "canary"],
+            extra_env={"DTX_FAULTS": "canary_regress"},
+        )
+        r = fleet.replicas[0]
+        assert "canary" in r.argv and "base" not in r.argv
+        assert r.env["DTX_FAULTS"] == "canary_regress"
+        assert "base" in old[0] and old[1] is None
+        # rollback: pass back exactly what relaunch returned
+        fleet.relaunch_replica(0, argv=old[0], env=old[1])
+        assert r.argv == old[0] and r.env is None
+        with pytest.raises(ValueError):
+            fleet.relaunch_replica(99)
+
+
+# -- serve_bench trace replay schedules (satellite a) --------------------
+
+
+class TestTraceSchedules:
+    @pytest.fixture(scope="class")
+    def sb(self):
+        return _load_tool("serve_bench")
+
+    def test_diurnal_schedule_shape(self, sb):
+        sched = sb.make_diurnal_schedule(60.0, 1.0, 5.0)
+        assert sched == sorted(sched)
+        assert all(0 < t < 60.0 for t in sched)
+        assert len(sched) >= 60  # at least the low rate throughout
+        # the peak half carries more arrivals than the edges
+        mid = sum(1 for t in sched if 20.0 <= t < 40.0)
+        edges = sum(1 for t in sched if t < 10.0 or t >= 50.0)
+        assert mid > edges
+        with pytest.raises(ValueError):
+            sb.make_diurnal_schedule(0.0, 1.0, 5.0)
+        with pytest.raises(ValueError):
+            sb.make_diurnal_schedule(10.0, 5.0, 1.0)
+
+    def test_trace_spec_parsing(self, sb, tmp_path):
+        assert sb.load_trace_schedule("diurnal:60:1:5") \
+            == sb.make_diurnal_schedule(60.0, 1.0, 5.0)
+        with pytest.raises(SystemExit):
+            sb.load_trace_schedule("diurnal:60:1")
+        trace = tmp_path / "trace.jsonl"
+        trace.write_text(
+            '{"t": 2.0}\n{"t": 1.0, "x": 9}\nnot json\n{"no_t": 3}\n'
+        )
+        assert sb.load_trace_schedule(str(trace)) == [1.0, 2.0]
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("\n")
+        with pytest.raises(SystemExit):
+            sb.load_trace_schedule(str(empty))
+
+
+# -- chaos (slow tier) ---------------------------------------------------
+
+
+def _chaos_fleet(fleet_mod, n=2):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    return fleet_mod.Fleet(
+        n,
+        server_args=["--num-slots", "2", "--prefill-chunk", "16",
+                     "--prefill-budget", "32", "--drain-timeout", "60",
+                     "--max-queue-len", "0"],
+        env=env, max_restarts=3, backoff_base=0.2, backoff_max=2.0,
+        ready_timeout_s=240.0,
+    )
+
+
+def _warm_ladder(url):
+    for n in (1, 2, 4, 8, 16):
+        status, body, _ = http_post_json_with_retries(
+            url + "/generate",
+            {"prompt_ids": [1] * n, "max_new_tokens": 2,
+             "temperature": 0.0, "seed": 0},
+            timeout=240, max_retries=2,
+        )
+        assert status == 200, (url, n, body)
+
+
+def _chaos_router_cfg():
+    return RouterConfig(
+        probe_interval_s=0.05, probe_backoff_s=0.05,
+        probe_backoff_max_s=0.5, eject_after=2, readmit_after=2,
+        max_attempts=4, retry_base_s=0.02, retry_cap_s=0.2,
+        default_deadline_s=120.0, wait_for_replica_s=5.0,
+    )
+
+
+@pytest.mark.slow
+def test_chaos_trace_replay_sigkill_mid_scale_down_zero_loss(tmp_path):
+    """Acceptance pin: a diurnal load trace replays through the router
+    while the fleet scales 2->3->2, with the scale-down victim
+    SIGKILLed MID-DRAIN — zero failed client requests (every arrival
+    in the bench's out JSON served, none shed), the replica-hours and
+    burn timelines land in the bench record, and every surviving
+    replica's decode compile count stays pinned at 1 (scaling added no
+    new shapes)."""
+    fleet_mod = _load_fleet()
+    fleet = _chaos_fleet(fleet_mod, 2)
+    router = None
+    httpd = None
+    bench = None
+    out = tmp_path / "trace_bench.jsonl"
+    try:
+        fleet.start()
+        for url in fleet.urls:
+            _warm_ladder(url)
+        router = Router(fleet.urls, _chaos_router_cfg()).start()
+        httpd = serve_router(router, port=0)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        actuator = autoscaler.FleetActuator(fleet, router)
+
+        # scale 2 -> 3 BEFORE the trace: the chaos under load is the
+        # scale-DOWN (the drain path is what must be zero-loss)
+        (new_url,) = actuator.scale_up()
+        by_url = {r.url: r for r in router.replicas}
+        deadline = time.time() + 240
+        while time.time() < deadline and not by_url[new_url].eligible():
+            time.sleep(0.05)
+        assert by_url[new_url].eligible(), "router never admitted"
+        _warm_ladder(new_url)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        bench = subprocess.Popen(
+            [sys.executable, os.path.join(TOOLS, "serve_bench.py"),
+             "--trace", "diurnal:24:1:3", "--trace-window", "6",
+             "--ttft-slo", "60", "--slo-target", "0.9",
+             "--target", f"http://127.0.0.1:{port}/generate",
+             "--clients", "4", "--new-tokens", "2", "--min-prompt", "4",
+             "--max-prompt", "16", "--prefill-chunk", "16",
+             "--vocab-size", "32", "--max-retries", "3", "--seed", "0",
+             "--out", str(out)],
+            env=env,
+        )
+        time.sleep(4.0)  # let the trace ramp onto the 3-wide fleet
+
+        # scale 3 -> 2 under load, and SIGKILL the draining victim
+        down_url = []
+        th = threading.Thread(
+            target=lambda: down_url.append(actuator.scale_down())
+        )
+        th.start()
+        kill_deadline = time.time() + 30
+        victim = None
+        while victim is None and time.time() < kill_deadline:
+            victim = next(
+                (r for r in fleet.replicas if r.expected_exit), None
+            )
+            time.sleep(0.02)
+        assert victim is not None, "scale_down never picked a victim"
+        time.sleep(0.2)  # let the drain actually begin
+        if victim.alive():  # SIGKILL mid-drain (uncatchable)
+            victim.proc.send_signal(fleet_mod.signal.SIGKILL)
+        th.join(120)
+        assert not th.is_alive(), "scale_down hung"
+        assert down_url and down_url[0] == victim.url
+        assert len(fleet.replicas) == 2
+        assert len(router.replicas) == 2
+        assert victim.index not in [r.index for r in fleet.replicas]
+
+        assert bench.wait(timeout=300) == 0
+        rec = json.loads(out.read_text().splitlines()[-1])
+        assert rec["metric"] == "serving_trace_replay"
+        # ZERO failed client requests through the whole dance
+        assert rec["shed"] == 0, rec
+        assert rec["served"] == rec["offered"] > 0
+        assert rec["violating_windows"] == 0
+        assert rec["replica_seconds"] > 0
+        assert len(rec["burn_timeline"]) == len(rec["windows"]) > 0
+        assert any(n >= 2 for _, n in rec["replica_timeline"])
+
+        # compile pin: scaling + the kill added no decode shapes
+        for url in fleet.urls:
+            _warm_ladder(url)
+            with urllib.request.urlopen(url + "/health",
+                                        timeout=30) as r:
+                health = json.load(r)
+            assert health["compiles"]["decode"] == 1, (url, health)
+    finally:
+        if bench is not None and bench.poll() is None:
+            bench.kill()
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if router is not None:
+            router.close()
+        fleet.stop()
+
+
+@pytest.mark.slow
+def test_chaos_canary_regress_auto_rollback_zero_loss():
+    """Acceptance pin: a deliberately perf-regressed canary (the
+    ``canary_regress`` fault armed via env on ONE relaunched replica)
+    is judged and auto-rolled-back UNATTENDED — verdict rollback, the
+    replica comes back on its original argv/env, and zero client
+    requests fail across the relaunch/split/rollback dance."""
+    fleet_mod = _load_fleet()
+    fleet = _chaos_fleet(fleet_mod, 2)
+    router = None
+    httpd = None
+    try:
+        fleet.start()
+        for url in fleet.urls:
+            _warm_ladder(url)
+        router = Router(fleet.urls, _chaos_router_cfg()).start()
+        httpd = serve_router(router, port=0)
+        gen_url = (
+            f"http://127.0.0.1:{httpd.server_address[1]}/generate"
+        )
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+        results = []
+        results_lock = threading.Lock()
+        stop = threading.Event()
+
+        def client(wid):
+            k = 0
+            while not stop.is_set():
+                k += 1
+                req = urllib.request.Request(
+                    gen_url,
+                    data=json.dumps({
+                        "prompt_ids": [1 + (wid + k) % 7] * (1 + k % 12),
+                        "max_new_tokens": 2, "temperature": 0.0,
+                        "seed": wid * 1000 + k, "timeout": 60,
+                    }).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=90) as r:
+                        rec = (r.status, json.load(r))
+                except urllib.error.HTTPError as e:
+                    rec = (e.code, json.loads(e.read() or b"{}"))
+                except OSError as e:
+                    rec = (-1, {"error": repr(e)})
+                with results_lock:
+                    results.append(rec)
+
+        workers = [
+            threading.Thread(target=client, args=(w,)) for w in range(6)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            time.sleep(1.0)
+            original_argv = list(fleet.replicas[1].argv)
+            # the stall (every engine iteration sleeps 0.75 s) puts
+            # every canary TTFT far past the 0.5 s objective while
+            # still letting several requests finish inside the window
+            # — the judge must convict on the BURN gate, not on thin
+            # evidence
+            cc = autoscaler.CanaryController(
+                fleet, router,
+                AutoscalerConfig(
+                    canary_fraction=0.5, canary_window_s=12.0,
+                    canary_min_requests=2, ttft_threshold_s=0.5,
+                    slo_target=0.9, canary_max_burn=1.0,
+                ),
+            )
+            record = cc.run(
+                index=1,
+                extra_env={"DTX_FAULTS": "canary_regress",
+                           "DTX_CANARY_REGRESS_S": "0.75"},
+            )
+            time.sleep(1.0)  # serve a little while fully healed
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=180)
+                assert not w.is_alive(), "client hung"
+
+        # the regressed canary rolled back, unattended, convicted by
+        # the burn gate on real evidence
+        assert record["verdict"] == "rollback", record
+        assert record["canary"]["count"] >= 2, record
+        assert record["canary"]["burn_rate"] > 1.0, record
+        # ...onto its ORIGINAL command line, faults gone
+        assert fleet.replicas[1].argv == original_argv
+        env1 = fleet.replicas[1].env or {}
+        assert "DTX_FAULTS" not in env1
+        # the split is off and the fleet is whole
+        assert router.canary() == (None, 0.0)
+        assert len(fleet.replicas) == 2
+        # ZERO failed client requests through relaunch + rollback
+        bad = [(s, b) for s, b in results if s != 200]
+        assert not bad, f"{len(bad)} failed requests, first: {bad[:3]}"
+        assert len(results) >= 10
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if router is not None:
+            router.close()
+        fleet.stop()

@@ -158,41 +158,57 @@ class Fleet:
             raise ValueError(
                 f"{num_replicas} replicas but {len(ports)} ports"
             )
-        extra = list(server_args or [])
-
-        def _render(arg: str, i: int, port: int) -> str:
-            # per-replica templating: shared server_args naming a file
-            # path ("--trace-path", "--event-log") must not make N
-            # replicas clobber one file — "{replica}"/"{port}" expand
-            # per process
-            return (arg.replace("{replica}", str(i))
-                       .replace("{port}", str(port)))
-
-        def _env_for(i: int) -> Optional[dict]:
-            # per-replica env overrides (chaos tests arm DTX_FAULTS on
-            # ONE replica; the others must stay healthy)
-            base = dict(env) if env is not None else None
-            override = (replica_env or {}).get(i)
-            if override:
-                base = dict(os.environ) if base is None else base
-                base.update(override)
-            return base
-
+        # kept as templates so scale_up()/relaunch_replica() can mint
+        # NEW replica slots long after __init__
+        self._python = python
+        self._server_args = list(server_args or [])
+        self._base_env = dict(env) if env is not None else None
+        self._replica_env = dict(replica_env or {})
+        self._next_index = num_replicas
         self.replicas = [
-            ReplicaProc(
-                i, host, port,
-                [python, "-m", SERVER_MODULE,
-                 "--host", host, "--port", str(port)]
-                + [_render(a, i, port) for a in extra],
-                env=_env_for(i),
-            )
-            for i, port in enumerate(ports)
+            self._make_replica(i, port) for i, port in enumerate(ports)
         ]
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._supervisor: Optional[threading.Thread] = None
         # restart relaunch deadlines (monotonic ts), per replica index
         self._relaunch_at: Dict[int, float] = {}
+
+    def _make_replica(self, index: int, port: int,
+                      server_args: Optional[Sequence[str]] = None,
+                      extra_env: Optional[dict] = None) -> ReplicaProc:
+        """Build one replica slot from the fleet's templates.
+        ``server_args`` replaces the shared extra args for this slot
+        (canary: new checkpoint/config); ``extra_env`` layers on top of
+        the per-index env overrides (canary: arm a fault on one
+        replica)."""
+        extra = (self._server_args if server_args is None
+                 else list(server_args))
+
+        def _render(arg: str) -> str:
+            # per-replica templating: shared server_args naming a file
+            # path ("--trace-path", "--event-log") must not make N
+            # replicas clobber one file — "{replica}"/"{port}" expand
+            # per process
+            return (arg.replace("{replica}", str(index))
+                       .replace("{port}", str(port)))
+
+        # per-replica env overrides (chaos tests arm DTX_FAULTS on
+        # ONE replica; the others must stay healthy)
+        base = dict(self._base_env) if self._base_env is not None else None
+        override = dict(self._replica_env.get(index) or {})
+        if extra_env:
+            override.update(extra_env)
+        if override:
+            base = dict(os.environ) if base is None else base
+            base.update(override)
+        return ReplicaProc(
+            index, self.host, port,
+            [self._python, "-m", SERVER_MODULE,
+             "--host", self.host, "--port", str(port)]
+            + [_render(a) for a in extra],
+            env=base,
+        )
 
     # -- observability -------------------------------------------------
 
@@ -286,7 +302,60 @@ class Fleet:
             self.ready_timeout_s if timeout_s is None else timeout_s,
         )
 
-    # -- rolling restart ----------------------------------------------
+    # -- rolling restart / scaling ------------------------------------
+
+    def _drain_exit(self, r: ReplicaProc) -> None:
+        """SIGTERM (the server drains: admission stops, in-flight
+        requests finish), wait for exit, escalate to SIGKILL on a
+        wedged straggler."""
+        if r.alive():
+            r.proc.send_signal(signal.SIGTERM)
+            try:
+                r.proc.wait(self.drain_exit_timeout_s)
+            except subprocess.TimeoutExpired:
+                self._log({"event": "drain_timeout_kill",
+                           "replica": r.index})
+                r.proc.kill()
+                r.proc.wait(10)
+
+    def _restart_one(self, r: ReplicaProc, ready_check=None) -> None:
+        """Drain one replica, relaunch it (on whatever argv/env the
+        slot now carries), wait for /ready and the optional
+        ``ready_check`` gate, then grant a fresh supervision lease."""
+        with self._lock:
+            r.expected_exit = True  # supervisor: hands off
+            self._relaunch_at.pop(r.index, None)
+        try:
+            self._log({"event": "rolling_drain", "replica": r.index})
+            self._drain_exit(r)
+            self._launch(r)
+            if not wait_http_ready(r.url, self.ready_timeout_s):
+                raise RuntimeError(
+                    f"replica {r.index} ({r.url}) did not come back "
+                    f"within {self.ready_timeout_s}s after rolling "
+                    "restart"
+                )
+            if ready_check is not None:
+                end = time.monotonic() + self.ready_timeout_s
+                while not ready_check(r):
+                    if time.monotonic() >= end:
+                        raise RuntimeError(
+                            f"replica {r.index} ({r.url}) ready but "
+                            "not re-admitted (ready_check) within "
+                            f"{self.ready_timeout_s}s"
+                        )
+                    time.sleep(0.05)
+            with self._lock:
+                # a deliberate operator restart grants a fresh
+                # supervision lease — without this, a replica that
+                # had exhausted its budget (or exited cleanly once)
+                # would be revived yet silently unsupervised
+                r.gave_up = False
+                r.restarts = 0
+            self._log({"event": "rolling_done", "replica": r.index})
+        finally:
+            with self._lock:
+                r.expected_exit = False
 
     def rolling_restart(self, ready_check=None) -> None:
         """Drain-aware, one replica at a time; see module docstring.
@@ -299,49 +368,118 @@ class Fleet:
         the restart never drains replica k+1 while the router is still
         slow-re-admitting replica k — the zero-eligible window that
         would shed requests."""
-        for r in self.replicas:
-            with self._lock:
-                r.expected_exit = True  # supervisor: hands off
-                self._relaunch_at.pop(r.index, None)
-            try:
-                self._log({"event": "rolling_drain", "replica": r.index})
-                if r.alive():
-                    r.proc.send_signal(signal.SIGTERM)
-                    try:
-                        r.proc.wait(self.drain_exit_timeout_s)
-                    except subprocess.TimeoutExpired:
-                        self._log({"event": "drain_timeout_kill",
-                                   "replica": r.index})
-                        r.proc.kill()
-                        r.proc.wait(10)
-                self._launch(r)
+        for r in list(self.replicas):
+            self._restart_one(r, ready_check=ready_check)
+
+    def relaunch_replica(self, index: int,
+                         server_args: Optional[Sequence[str]] = None,
+                         extra_env: Optional[dict] = None,
+                         argv: Optional[List[str]] = None,
+                         env: Optional[dict] = None,
+                         ready_check=None):
+        """Drain ONE replica and relaunch it on a different command
+        line — the canary-rollout primitive. ``server_args`` replaces
+        the fleet's shared extra args for this slot (new checkpoint /
+        config) and ``extra_env`` layers env on top; ``argv``/``env``
+        override verbatim instead (rollback passes back exactly what
+        this method returned). Returns the PREVIOUS ``(argv, env)``.
+        """
+        r = next((x for x in self.replicas if x.index == index), None)
+        if r is None:
+            raise ValueError(f"no replica with index {index}")
+        old = (list(r.argv),
+               dict(r.env) if r.env is not None else None)
+        if argv is not None:
+            r.argv = list(argv)
+            r.env = dict(env) if env is not None else None
+        elif server_args is not None or extra_env:
+            fresh = self._make_replica(
+                index, r.port, server_args=server_args,
+                extra_env=extra_env,
+            )
+            r.argv, r.env = fresh.argv, fresh.env
+        self._restart_one(r, ready_check=ready_check)
+        return old
+
+    def scale_up(self, n: int = 1, wait_ready: bool = True) -> List[str]:
+        """Launch ``n`` NEW replica slots (fresh indices, fresh restart
+        budgets, OS-assigned ports) and hand them to supervision.
+        Returns their URLs (register them with the router next)."""
+        if n < 1:
+            raise ValueError(f"scale_up needs n >= 1, got {n}")
+        with self._lock:
+            added = []
+            for _ in range(n):
+                idx = self._next_index
+                self._next_index += 1
+                added.append(
+                    self._make_replica(idx, pick_free_port(self.host))
+                )
+            # publish before launching: the supervisor skips slots with
+            # no process, so a half-launched batch is never relaunched
+            self.replicas = self.replicas + added
+        for r in added:
+            self._launch(r)
+        self._log({"event": "scale_up", "n": n,
+                   "replicas": [r.index for r in added],
+                   "fleet_size": len(self.replicas)})
+        if wait_ready:
+            for r in added:
                 if not wait_http_ready(r.url, self.ready_timeout_s):
                     raise RuntimeError(
-                        f"replica {r.index} ({r.url}) did not come back "
-                        f"within {self.ready_timeout_s}s after rolling "
-                        "restart"
+                        f"scaled-up replica {r.index} ({r.url}) not "
+                        f"ready within {self.ready_timeout_s}s"
                     )
-                if ready_check is not None:
-                    end = time.monotonic() + self.ready_timeout_s
-                    while not ready_check(r):
-                        if time.monotonic() >= end:
-                            raise RuntimeError(
-                                f"replica {r.index} ({r.url}) ready but "
-                                "not re-admitted (ready_check) within "
-                                f"{self.ready_timeout_s}s"
-                            )
-                        time.sleep(0.05)
-                with self._lock:
-                    # a deliberate operator restart grants a fresh
-                    # supervision lease — without this, a replica that
-                    # had exhausted its budget (or exited cleanly once)
-                    # would be revived yet silently unsupervised
-                    r.gave_up = False
-                    r.restarts = 0
-                self._log({"event": "rolling_done", "replica": r.index})
-            finally:
-                with self._lock:
-                    r.expected_exit = False
+        return [r.url for r in added]
+
+    def scale_down(self, index: Optional[int] = None,
+                   score_of=None) -> str:
+        """Drain ONE replica out of the fleet, zero-loss, and RELEASE
+        its supervision lease (slot removed, pending relaunch
+        cancelled) — a later scale_up mints a fresh slot with a fresh
+        restart budget instead of inheriting this one's scars.
+
+        Victim selection: explicit ``index`` wins; else the
+        LEAST-LOADED replica by ``score_of(url)`` (pass the router's
+        load score — draining the busiest replica would orphan the
+        most in-flight work onto its siblings); else the highest
+        index. Returns the removed replica's URL."""
+        with self._lock:
+            candidates = [r for r in self.replicas if not r.expected_exit]
+            if len(self.replicas) <= 1 or not candidates:
+                raise ValueError("cannot scale below one replica")
+            if index is not None:
+                victim = next(
+                    (r for r in candidates if r.index == index), None
+                )
+                if victim is None:
+                    raise ValueError(f"no replica with index {index}")
+            else:
+                victim = None
+                if score_of is not None:
+                    scored = []
+                    for r in candidates:
+                        s = score_of(r.url)
+                        if s is not None:
+                            scored.append((s, r.index, r))
+                    if scored:
+                        victim = min(scored)[2]
+                if victim is None:
+                    victim = max(candidates, key=lambda r: r.index)
+            victim.expected_exit = True  # supervisor hands off
+            self._relaunch_at.pop(victim.index, None)
+        self._log({"event": "scale_down_drain", "replica": victim.index,
+                   "fleet_size": len(self.replicas)})
+        self._drain_exit(victim)
+        with self._lock:
+            self.replicas = [r for r in self.replicas if r is not victim]
+            self._relaunch_at.pop(victim.index, None)
+        self._log({
+            "event": "scale_down_done", "replica": victim.index,
+            "rc": victim.proc.returncode if victim.proc else None,
+            "fleet_size": len(self.replicas),
+        })
+        return victim.url
 
     # -- shutdown ------------------------------------------------------
 

@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import tempfile
@@ -302,6 +303,273 @@ def _run_against_targets(args, targets, post) -> None:
     )
     assert len(completed) + n_failed == args.requests, \
         "some requests neither completed nor failed"
+
+
+def make_diurnal_schedule(duration_s: float, low_rps: float,
+                          high_rps: float) -> list:
+    """Arrival offsets (seconds from start) over ONE diurnal cycle:
+    the instantaneous rate follows a raised cosine from ``low_rps``
+    (t=0) up to ``high_rps`` (t=duration/2) and back down, with
+    arrivals stepped deterministically at 1/rate(t) — the same
+    schedule every run, no sampling noise."""
+    if duration_s <= 0:
+        raise ValueError(f"duration must be > 0, got {duration_s}")
+    if low_rps < 0 or high_rps < low_rps:
+        raise ValueError(
+            f"want 0 <= low <= high, got {low_rps}..{high_rps}"
+        )
+    out: list = []
+    t = 0.0
+    while True:
+        rate = low_rps + (high_rps - low_rps) * 0.5 * (
+            1.0 - math.cos(2.0 * math.pi * t / duration_s)
+        )
+        t += 1.0 / max(rate, 1e-3)
+        if t >= duration_s:
+            return out
+        out.append(t)
+
+
+def load_trace_schedule(spec: str) -> list:
+    """``--trace`` input: ``diurnal:DURATION:LOW:HIGH`` synthesizes one
+    cosine cycle; anything else is a JSONL file of ``{"t": <seconds
+    from start>}`` rows (extra fields ignored, torn lines skipped),
+    sorted defensively so a hand-edited trace still replays in
+    order."""
+    if spec.startswith("diurnal:"):
+        parts = spec.split(":")
+        if len(parts) != 4:
+            raise SystemExit(
+                f"--trace: want diurnal:DURATION:LOW:HIGH, got {spec!r}"
+            )
+        try:
+            return make_diurnal_schedule(
+                float(parts[1]), float(parts[2]), float(parts[3])
+            )
+        except ValueError as e:
+            raise SystemExit(f"--trace: {e}")
+    sched = []
+    with open(spec, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict) and "t" in row:
+                sched.append(float(row["t"]))
+    if not sched:
+        raise SystemExit(f"--trace {spec}: no timestamped rows")
+    return sorted(sched)
+
+
+def _run_trace_replay(args, targets, post) -> None:
+    """Open-loop timestamped replay (``--trace``) against a live
+    fleet: each request fires AT its scheduled instant whether or not
+    earlier ones finished (a closed loop hides overload by slowing its
+    own offered rate — useless for judging shedding or autoscaling).
+    Reports per-window offered/served/shed rates and TTFT SLO burn,
+    plus the replicas-in-rotation timeline polled from the router's
+    ``/health`` — the replica-hours integral the autoscaler acceptance
+    compares against a static fleet. With ``--clients`` workers, an
+    overloaded fleet delays arrivals rather than dropping them
+    (bounded open loop); sheds and transport failures count as SLO-bad
+    in their scheduled window."""
+    import random as _random
+    import urllib.request
+
+    schedule = load_trace_schedule(args.trace)
+    rng = np.random.default_rng(args.seed)
+    max_prompt = max(1, args.max_prompt)
+    min_prompt = min(args.min_prompt, max_prompt)
+    prompts = [
+        rng.integers(
+            0, args.vocab_size,
+            size=int(rng.integers(min_prompt, max_prompt + 1)),
+        ).tolist()
+        for _ in range(len(schedule))
+    ]
+
+    ladder, size = [], 1
+    while size <= min(args.prefill_chunk, max_prompt):
+        ladder.append(size)
+        size *= 2
+    for url in targets:
+        for n in ladder:
+            try:
+                post(url, {"prompt_ids": [1] * n, "max_new_tokens": 2,
+                           "temperature": args.temperature, "seed": 0},
+                     timeout=600, max_retries=args.max_retries)
+            except (OSError, ValueError) as e:
+                print(f"[serve_bench] warmup against {url} failed: "
+                      f"{e!r}", file=sys.stderr)
+
+    results = []  # (scheduled_t, "ok" | "shed", ttft_ms | None)
+    lock = threading.Lock()
+    next_idx = [0]
+    stop = threading.Event()
+    # replicas-in-rotation timeline: the router's /health (eligible
+    # count) sampled through the run; replica_seconds integrates it
+    health_url = targets[0][: -len("/generate")] + "/health"
+    timeline = []  # (t_offset_s, eligible | -1 for a failed sample)
+    t0 = time.perf_counter()
+
+    def poll_replicas():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(health_url, timeout=2) as r:
+                    h = json.load(r)
+                eligible = int(h.get("eligible", 0))
+            except (OSError, ValueError):
+                eligible = -1
+            timeline.append(
+                (round(time.perf_counter() - t0, 3), eligible)
+            )
+            stop.wait(0.5)
+
+    def worker(wid):
+        rng_w = _random.Random(args.seed * 1000 + wid)
+        while True:
+            with lock:
+                i = next_idx[0]
+                if i >= len(schedule):
+                    return
+                next_idx[0] += 1
+            delay = (t0 + schedule[i]) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            payload = {
+                "prompt_ids": prompts[i],
+                "max_new_tokens": args.new_tokens,
+                "temperature": args.temperature,
+                "seed": args.seed + i,
+                "timeout": 600,
+            }
+            if args.deadline:
+                payload["deadline_s"] = args.deadline
+            try:
+                status, body, _retries = post(
+                    targets[i % len(targets)], payload, timeout=600,
+                    max_retries=args.max_retries, rng=rng_w,
+                    deadline_s=args.deadline or None,
+                )
+            except (OSError, ValueError):
+                with lock:
+                    results.append((schedule[i], "shed", None))
+                continue
+            with lock:
+                if status == 200:
+                    results.append(
+                        (schedule[i], "ok", body["ttft_ms"])
+                    )
+                else:
+                    results.append((schedule[i], "shed", None))
+
+    poller = threading.Thread(target=poll_replicas, daemon=True)
+    poller.start()
+    threads = [
+        threading.Thread(target=worker, args=(w,))
+        for w in range(args.clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    stop.set()
+    poller.join(3.0)
+
+    # windowed judgment: a shed or transport failure is SLO-BAD in its
+    # scheduled window (honest backpressure still spent error budget)
+    window_s = max(0.1, args.trace_window)
+    duration = schedule[-1] if schedule else 0.0
+    n_windows = int(duration // window_s) + 1
+    ttft_bound_ms = args.ttft_slo * 1000.0
+    budget = max(1e-9, 1.0 - args.slo_target)
+    windows = []
+    for w in range(n_windows):
+        windows.append({
+            "t_start": round(w * window_s, 3),
+            "t_end": round((w + 1) * window_s, 3),
+            "offered": 0, "served": 0, "shed": 0, "_ttfts": [],
+        })
+    for sched_t, kind, ttft in results:
+        w = windows[min(n_windows - 1, int(sched_t // window_s))]
+        w["offered"] += 1
+        if kind == "ok":
+            w["served"] += 1
+            w["_ttfts"].append(ttft)
+        else:
+            w["shed"] += 1
+    violating = 0
+    burn_timeline = []
+    for w in windows:
+        ttfts = w.pop("_ttfts")
+        slow = sum(1 for v in ttfts if v > ttft_bound_ms)
+        w["req_per_s"] = round(w["offered"] / window_s, 3)
+        w["shed_rate"] = (
+            None if w["offered"] == 0
+            else round(w["shed"] / w["offered"], 4)
+        )
+        w["ttft_p95_ms"] = _percentiles(ttfts)["p95"]
+        err = (
+            None if w["offered"] == 0
+            else (slow + w["shed"]) / w["offered"]
+        )
+        w["burn"] = None if err is None else round(err / budget, 3)
+        if w["burn"] is not None and w["burn"] > 1.0:
+            violating += 1
+        burn_timeline.append((w["t_start"], w["burn"]))
+    good_samples = [
+        (t, n) for t, n in timeline if n >= 0
+    ]
+    replica_seconds = 0.0
+    for j, (t, n) in enumerate(good_samples):
+        t_next = (
+            good_samples[j + 1][0] if j + 1 < len(good_samples)
+            else wall
+        )
+        replica_seconds += n * max(0.0, t_next - t)
+    served = sum(w["served"] for w in windows)
+    shed = sum(w["shed"] for w in windows)
+    offered = sum(w["offered"] for w in windows)
+    line = {
+        "metric": "serving_trace_replay",
+        "value": round(replica_seconds / 3600.0, 6),
+        "unit": "replica_hours",
+        "replica_seconds": round(replica_seconds, 3),
+        "offered": offered,
+        "served": served,
+        "shed": shed,
+        "shed_rate": None if not offered else round(shed / offered, 4),
+        "violating_windows": violating,
+        "windows": windows,
+        "burn_timeline": burn_timeline,
+        "replica_timeline": timeline,
+        "ttft_slo_s": args.ttft_slo,
+        "slo_target": args.slo_target,
+        "window_s": window_s,
+        "trace": args.trace,
+        "wall_s": round(wall, 3),
+        "targets": targets,
+        "clients": args.clients,
+        "http": True,
+        "smoke": bool(args.smoke),
+    }
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    print(
+        f"[serve_bench] trace replay: offered={offered} served={served} "
+        f"shed={shed} violating_windows={violating}/{n_windows} "
+        f"replica_hours={line['value']} wall={wall:.2f}s",
+        file=sys.stderr,
+    )
+    assert served + shed == offered == len(schedule), \
+        "some scheduled requests neither completed nor failed"
 
 
 def _run_shared_prefix(args, client, engine, serving, model_cfg,
@@ -1037,6 +1305,20 @@ def main() -> None:
     p.add_argument("--deadline", type=float, default=0.0,
                    help="server-side per-request deadline in seconds; "
                         "0 = none")
+    p.add_argument("--trace", default=None,
+                   help="open-loop load-trace replay against --target: "
+                        "a JSONL file of {\"t\": seconds} arrival rows, "
+                        "or diurnal:DURATION:LOW:HIGH to synthesize one "
+                        "cosine day (reports per-window req/s, shed "
+                        "rate, TTFT burn, and the replica-hours "
+                        "integral from the router's /health)")
+    p.add_argument("--trace-window", type=float, default=5.0,
+                   help="trace-replay reporting window in seconds")
+    p.add_argument("--ttft-slo", type=float, default=1.0,
+                   help="trace-replay TTFT objective bound in seconds")
+    p.add_argument("--slo-target", type=float, default=0.99,
+                   help="trace-replay fraction of requests that must "
+                        "be good (served AND under --ttft-slo)")
     p.add_argument("--out", default=None,
                    help="also append the JSON line to this file")
     p.add_argument("--profile-every", type=int, default=0,
@@ -1134,6 +1416,15 @@ def main() -> None:
         t if t.endswith("/generate") else t.rstrip("/") + "/generate"
         for t in (args.target or [])
     ]
+    if args.trace:
+        if not targets:
+            raise SystemExit(
+                "--trace needs --target (replay drives a live "
+                "fleet/router over HTTP)"
+            )
+        args.http = True
+        _run_trace_replay(args, targets, http_post_json_with_retries)
+        return
     if targets:
         args.http = True
         _run_against_targets(args, targets,

@@ -111,6 +111,25 @@ def report_from_exposition(text: str, args) -> dict:
         "error_ratio": err,
         "burn_rate": burn_rate(err, args.availability_target),
     }
+    # staleness honesty: a fleet body stamps each replica's last-scrape
+    # age (router probe loop, fleet_scrape_age_seconds). Replicas whose
+    # stamp exceeds --max-scrape-age are reported as STALE and their
+    # server-reported burn gauges dropped — judging a blackholed
+    # replica by its last good scrape is how outages hide
+    ages = {
+        labels.get("replica", "unknown"): v
+        for n, labels, v in samples
+        if n == "fleet_scrape_age_seconds"
+    }
+    max_age = getattr(args, "max_scrape_age", 0.0) or 0.0
+    stale = sorted(r for r, age in ages.items()
+                   if max_age > 0 and age > max_age)
+    if ages:
+        out["scrape_age_seconds"] = {
+            r: round(age, 3) for r, age in sorted(ages.items())
+        }
+    if stale:
+        out["stale_replicas"] = stale
     # pre-computed burn gauges (obs/slo.py via each server) ride along
     # verbatim when present, so the report shows the servers' own view
     # — keyed per replica on a fleet body (aggregate_fleet_metrics
@@ -122,6 +141,8 @@ def report_from_exposition(text: str, args) -> dict:
             continue
         key = labels.get("objective", "unknown")
         if labels.get("replica"):
+            if labels["replica"] in stale:
+                continue  # stale body: treat its gauges as missing
             key = f'{key}@{labels["replica"]}'
         live[key] = v
     if live:
@@ -175,6 +196,13 @@ def report_from_jsonl(path: str, args) -> dict:
 def check(objectives: dict, args) -> list:
     """Gate violations; empty = inside every error budget."""
     bad = []
+    stale = objectives.get("stale_replicas")
+    if stale:
+        bad.append(
+            "stale replica metrics (scrape age > "
+            f"{getattr(args, 'max_scrape_age', 0.0)}s): "
+            + ", ".join(stale)
+        )
     for name, o in objectives.items():
         if not isinstance(o, dict) or "burn_rate" not in o:
             continue
@@ -216,6 +244,13 @@ def main() -> int:
                         "of the aggregates)")
     p.add_argument("--step-time-ms", type=float, default=1000.0,
                    help="step-latency bound for --from-metrics-jsonl")
+    p.add_argument("--max-scrape-age", type=float, default=0.0,
+                   help="treat fleet replicas whose last /metrics "
+                        "scrape is older than this (seconds, per the "
+                        "router's fleet_scrape_age_seconds stamps) as "
+                        "MISSING: list them as stale_replicas, drop "
+                        "their burn gauges, and fail --check "
+                        "(0 = off)")
     p.add_argument("--max-burn", type=float, default=1.0,
                    help="gate: fail --check when any burn rate "
                         "exceeds this")
