@@ -351,6 +351,23 @@ class ServingConfig:
     # admitting until one of its slots retires — e.g. "batch:2" keeps
     # bulk traffic from occupying the whole pool. "" = no bounds.
     priority_max_slots: str = ""
+    # Model-quality telemetry (obs/quality.py). When on, the jitted
+    # sample/verify steps append a fixed-shape per-slot quality vector
+    # (sampled-distribution entropy, top-1 logit margin, repetition
+    # flag — models/decode.py:quality_vector) to their packed outputs:
+    # runtime arrays only, so the decode compile count stays 1 and
+    # telemetry-OFF output stays bit-identical to the pre-quality
+    # layout. The engine folds the signals into serving_token_entropy/
+    # serving_logit_margin histograms, per-request
+    # RequestOutput.quality stats, per-layer serving_lambda_mean
+    # gauges (ops/lambdas.py path), and the serving_quality_drift
+    # gauge vs the reference fingerprint below.
+    quality_telemetry: bool = False
+    # Path to a reference quality fingerprint JSON (recorded from a
+    # known-good window via ``--quality-record``): live entropy/margin
+    # sketches are compared against it with a PSI-style drift score
+    # exposed as serving_quality_drift. "" = no reference (drift 0).
+    quality_fingerprint: str = ""
 
     def __post_init__(self):
         if self.decode_attention_impl not in ("", "xla", "pallas"):
@@ -729,6 +746,17 @@ class AutoscalerConfig:
     # window; fewer is "inconclusive" and the controller ROLLS BACK
     # (never promote on no evidence).
     canary_min_requests: int = 8
+    # Quality axis (obs/quality.py): a canary whose
+    # serving_quality_drift (PSI vs the fleet's reference fingerprint)
+    # exceeds this rolls back even when latency is flat — the knee of
+    # the conventional PSI reading ("> 0.25 = shifted"). 0 = quality
+    # drift never gates (e.g. a fleet without quality telemetry).
+    canary_max_drift: float = 0.25
+    # ...and a canary whose constraint-validity rate falls more than
+    # this far below the control replicas' rate rolls back too (a
+    # checkpoint that stops satisfying its FSMs is broken regardless
+    # of its latency). 0 = validity delta never gates.
+    canary_max_validity_delta: float = 0.05
 
     def __post_init__(self):
         for name in ("poll_interval_s", "scale_up_burn",
@@ -736,7 +764,8 @@ class AutoscalerConfig:
                      "cooldown_down_s", "util_high", "util_low",
                      "stale_after_s", "ttft_threshold_s",
                      "itl_threshold_s", "canary_window_s",
-                     "canary_max_burn", "canary_max_regress"):
+                     "canary_max_burn", "canary_max_regress",
+                     "canary_max_drift", "canary_max_validity_delta"):
             if getattr(self, name) < 0:
                 raise ValueError(
                     f"{name} must be >= 0, got {getattr(self, name)}"

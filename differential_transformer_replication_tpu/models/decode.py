@@ -148,6 +148,57 @@ def apply_logit_pipeline(logits: jnp.ndarray, allowed: jnp.ndarray,
     return jnp.where(allowed, x, -jnp.inf)
 
 
+def quality_vector(lp: jnp.ndarray, proc: jnp.ndarray,
+                   tokens: jnp.ndarray,
+                   prev: jnp.ndarray,
+                   top2: jnp.ndarray = None) -> jnp.ndarray:
+    """Fixed-shape per-slot quality vector, computed INSIDE the jitted
+    sample/verify step (obs/quality.py is the host-side consumer):
+
+      [..., 0] sampled-distribution entropy in nats — over ``lp``, the
+               log-softmax of the distribution actually drawn from
+               (penalties + constraint mask + top-k + temperature all
+               applied), so a collapsing or flattening model moves it
+               immediately;
+      [..., 1] top-1 logit margin on the processed surface ``proc``
+               (pre-top-k/temperature): the argmax's confidence gap,
+               the signal spec-verify acceptance already keys on;
+      [..., 2] repetition flag — sampled token equals the previous
+               emitted token (``prev < 0`` = no previous token); the
+               engine accumulates the host-side run length from it.
+
+    Shapes: ``lp``/``proc`` (..., V), ``tokens``/``prev`` (...) int32;
+    returns (..., 3) float32. Runtime arrays only — no shape depends
+    on request state, so inactive slots pass through and the decode
+    compile count stays pinned. ``top2``, when given, is the caller's
+    already-computed two largest PROCESSED logits (..., >=2) — the
+    samplers have a descending sort of ``proc`` on hand for the top-k
+    threshold, and reusing its head keeps the tail out of a second
+    full top_k (which breaks XLA's sampler fusion and dominates the
+    telemetry cost on small models). NaN-degradation contract:
+    fully-masked rows give entropy 0 over the -inf mass (``where``
+    keeps the 0*inf NaN out) and an infinite margin; genuinely
+    non-finite logits propagate as non-finite values the host treats
+    as "no signal" (never a crash — that guard is the sampler's
+    finite-ok column).
+    """
+    finite = jnp.isfinite(lp)
+    plogp = jnp.where(finite, jnp.exp(lp) * lp, 0.0)
+    entropy = -jnp.sum(plogp, axis=-1)
+    if top2 is None and proc.shape[-1] >= 2:
+        top2 = jax.lax.top_k(proc, 2)[0]
+    if top2 is not None:
+        margin = top2[..., 0] - top2[..., 1]
+    else:  # degenerate single-token vocab: no runner-up to compare
+        margin = jnp.zeros(proc.shape[:-1], proc.dtype)
+    repeat = ((tokens == prev) & (prev >= 0))
+    return jnp.stack([
+        entropy.astype(jnp.float32),
+        margin.astype(jnp.float32),
+        repeat.astype(jnp.float32),
+    ], axis=-1)
+
+
 def init_cache(cfg: ModelConfig, batch_size: int) -> list:
     """Per-layer K/V buffers sized to ``block_size``, HEAD-MAJOR so the
     per-(slot, head) ring is contiguous — the fused decode kernel's

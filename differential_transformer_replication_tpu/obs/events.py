@@ -21,6 +21,15 @@ still lands the buffered tail. Append mode — supervisor relaunches
 extend the log rather than truncating the forensics they exist to
 explain. Stdlib only; :data:`NOOP_EVENTS` keeps instrumentation sites
 branch-free when logging is off.
+
+Size-based rotation (``max_bytes`` > 0): a long-lived fleet must not
+grow one unbounded file. Rotation happens at FLUSH boundaries only —
+every write is a batch of whole lines, so neither the active file nor
+any rotated generation ever ends in a torn line. The cascade is
+``events.jsonl`` -> ``.1`` -> ... -> ``.keep`` via atomic
+``os.replace`` (the oldest generation falls off); ``keep=0`` just
+truncates. A crash between renames leaves at worst a duplicated
+generation — never a missing or torn one.
 """
 
 from __future__ import annotations
@@ -37,9 +46,16 @@ class EventLog:
     """Append-only JSONL event sink; see module docstring."""
 
     def __init__(self, path: str, process: str = "",
-                 flush_every: int = 64):
+                 flush_every: int = 64, max_bytes: int = 0,
+                 keep: int = 3):
+        if max_bytes < 0 or keep < 0:
+            raise ValueError(
+                f"max_bytes/keep must be >= 0, got {max_bytes}/{keep}"
+            )
         self.path = path
         self.process = process
+        self.max_bytes = int(max_bytes)  # 0 = rotation off
+        self.keep = int(keep)
         self._lock = threading.Lock()
         self._buf: List[str] = []
         self._flush_every = max(1, flush_every)
@@ -77,6 +93,22 @@ class EventLog:
         if self._buf:
             self._fh.write("\n".join(self._buf) + "\n")
             self._buf.clear()
+        if self.max_bytes and self._fh.tell() >= self.max_bytes:
+            self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Close, cascade the generations, reopen fresh. Flush-boundary
+        only, so every file involved holds whole lines."""
+        self._fh.close()
+        if self.keep > 0:
+            for i in range(self.keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        else:
+            os.remove(self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
 
     def flush(self) -> None:
         with self._lock:
@@ -114,7 +146,11 @@ class _NoopEventLog:
 NOOP_EVENTS = _NoopEventLog()
 
 
-def open_event_log(path: Optional[str], process: str = ""):
+def open_event_log(path: Optional[str], process: str = "",
+                   max_bytes: int = 0, keep: int = 3):
     """``EventLog`` when a path is given, else the shared no-op — the
-    one-liner every CLI flag funnels through."""
-    return EventLog(path, process=process) if path else NOOP_EVENTS
+    one-liner every CLI flag funnels through. ``max_bytes``/``keep``
+    arm size-based rotation (module docstring)."""
+    if not path:
+        return NOOP_EVENTS
+    return EventLog(path, process=process, max_bytes=max_bytes, keep=keep)

@@ -52,6 +52,35 @@ def _layer_lambdas(params: dict, cfg: ModelConfig) -> Optional[jnp.ndarray]:
     ])
 
 
+def serving_lambda_summary(params: dict, cfg: ModelConfig) -> dict:
+    """Host-side per-layer effective-lambda view for the SERVING
+    telemetry path (serving/engine.py mirrors it into
+    ``serving_lambda_mean{layer=}`` and ``{"record": "quality"}``
+    rows): the same ``lambda_l<k>`` / ``lambda_l<k>_t<j>`` key schema
+    as :func:`lambda_record`, so ``tools/lambda_report.py --serving``
+    renders live-fleet rows beside training ones. ``lambda_l<k>`` is
+    the term mean for ndiff (the gauge's value); per-term detail rides
+    the ``_t<j>`` keys. Empty dict for the control family.
+
+    Unjitted on purpose — it runs once at engine build and after a
+    params rebind (the ``quality_drift`` fault), never per step."""
+    import numpy as np
+
+    lams = _layer_lambdas(params, cfg)
+    if lams is None:
+        return {}
+    lams = np.asarray(lams)
+    out = {}
+    for li in range(lams.shape[0]):
+        if lams.ndim == 1:  # diff: one effective lambda per layer
+            out[f"lambda_l{li + 1}"] = float(lams[li])
+        else:  # ndiff: per-term lambdas + their mean
+            out[f"lambda_l{li + 1}"] = float(lams[li].mean())
+            for tj in range(lams.shape[1]):
+                out[f"lambda_l{li + 1}_t{tj}"] = float(lams[li, tj])
+    return out
+
+
 def group_norms(params: dict) -> dict:
     """Global L2 norm per layer group: embeddings, each block, the final
     norm + lm head — the standard per-depth training-health view."""

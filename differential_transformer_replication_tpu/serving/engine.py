@@ -55,6 +55,7 @@ are capped at ``prompt + max_new_tokens <= block_size``.
 
 from __future__ import annotations
 
+import math
 import sys
 import time
 from functools import lru_cache
@@ -82,7 +83,15 @@ from differential_transformer_replication_tpu.models.decode import (
     init_cache_paged,
     kv_store_dtype,
     merge_cache_update,
+    quality_vector,
     scatter_slot_cache,
+)
+from differential_transformer_replication_tpu.obs.quality import (
+    ENTROPY_BINS,
+    MARGIN_BINS,
+    QualityMonitor,
+    build_quality_row,
+    load_fingerprint,
 )
 from differential_transformer_replication_tpu.obs.registry import (
     Registry,
@@ -222,7 +231,7 @@ class EngineCrashError(RuntimeError):
 @lru_cache(maxsize=None)
 def _build_step_fns(cfg: ModelConfig, rope_len: int,
                     page_size: int = 0, num_pages: int = 0,
-                    lp_k: int = 5):
+                    lp_k: int = 5, quality: bool = False):
     """Jitted (prefill, decode, sample, page_copy, page_extract,
     page_inject) closures for (cfg, rope_len[, page geometry], logprob
     echo width). The last three are the paged path's page plumbing
@@ -239,6 +248,12 @@ def _build_step_fns(cfg: ModelConfig, rope_len: int,
     calls compiles nothing new — the same zero-recompile pin as the
     contiguous path. ``page_copy`` is the COW-fork device copy (None on
     the contiguous path).
+
+    ``quality`` (a static, like lp_k) appends the in-jit quality
+    telemetry tail (models/decode.py:``quality_vector``) to the
+    sampler's packed output and widens its int operand by one prev-
+    token column; False compiles the EXACT pre-telemetry closure, so
+    telemetry-off output is bit-identical by construction.
     """
     # cache leaves depend on the KV dtype (int8 adds the scale planes);
     # slicing/scatter/vmap specs derive from the shared axis table so
@@ -402,7 +417,9 @@ def _build_step_fns(cfg: ModelConfig, rope_len: int,
         Every per-row scalar rides ONE packed (B, 8) int32 operand
         (one host->device conversion per call): token count | top_k |
         PRNG base (2 cols, bitcast uint32) | temperature | repetition
-        | presence | frequency penalties (bitcast f32). ``allowed``
+        | presence | frequency penalties (bitcast f32); with
+        ``quality`` on, one extra column carries the previous emitted
+        token (-1 = none) for the repetition flag. ``allowed``
         (B, V) bool is the per-row constraint-FSM mask row and
         ``counts_v`` (B, V) int32 the generated-token histogram — both
         runtime arrays (the engine passes cached all-ones/zeros
@@ -416,7 +433,10 @@ def _build_step_fns(cfg: ModelConfig, rope_len: int,
 
         Output is ONE packed (B, 3 + 2*lp_k) int32 array: token |
         finite-ok | chosen-token logprob (bitcast f32) | top-lp_k ids
-        | top-lp_k logprobs (bitcast f32). Logprobs are over the
+        | top-lp_k logprobs (bitcast f32); with ``quality`` on, three
+        more bitcast-f32 columns append the quality tail (entropy |
+        margin | repeat — existing offsets unchanged). Logprobs are
+        over the
         distribution actually sampled from — processed logits after
         top-k, divided by the greedy-safe temperature. The finiteness
         flag is over the RAW logits (before the intentional -inf
@@ -454,13 +474,26 @@ def _build_step_fns(cfg: ModelConfig, rope_len: int,
         chosen = jnp.take_along_axis(lp, tokens[:, None], axis=-1)
         top_lp, top_ids = jax.lax.top_k(lp, lp_k)
         ok = jnp.isfinite(logits).all(axis=-1)
-        return jnp.concatenate([
+        cols = [
             tokens[:, None],
             ok.astype(jnp.int32)[:, None],
             jax.lax.bitcast_convert_type(chosen, jnp.int32),
             top_ids.astype(jnp.int32),
             jax.lax.bitcast_convert_type(top_lp, jnp.int32),
-        ], axis=1)
+        ]
+        if quality:
+            # the telemetry tail rides the SAME packed transfer: the
+            # sampled distribution's entropy, the processed-logit
+            # margin, and the repeat-of-previous flag per row. The
+            # margin reuses sorted_desc's head — the sort already paid
+            # for the top-k threshold — so the tail adds no second
+            # full-vocab top_k to the fused sampler
+            qv = quality_vector(
+                lp, proc, tokens, ints[:, 8],
+                top2=sorted_desc[:, :2] if V >= 2 else None,
+            )
+            cols.append(jax.lax.bitcast_convert_type(qv, jnp.int32))
+        return jnp.concatenate(cols, axis=1)
 
     # Donate the cache pool so XLA updates it in place instead of
     # allocating + copying a second full pool per chunk/step (the engine
@@ -497,7 +530,7 @@ _SPEC_ACCEPT_SALT = np.uint32(0x9E3779B9)
 def _build_spec_step_fns(cfg: ModelConfig, rope_len: int, draft_len: int,
                          sampled: bool = False, batched: bool = False,
                          page_size: int = 0, num_pages: int = 0,
-                         lp_k: int = 5):
+                         lp_k: int = 5, quality: bool = False):
     """ONE fused jitted verify step for (cfg, rope_len, k rung): the
     L = k+1-row pool forward (models/decode.py:``forward_decode_spec``
     or its paged twin), the per-row sampling transforms, and the
@@ -540,7 +573,8 @@ def _build_spec_step_fns(cfg: ModelConfig, rope_len: int, draft_len: int,
     L = k + 1
 
     def _accept(logits, draft, dlen, force_reject, bases, counts,
-                temps, topks, rep, pres, freq, allowed, pcounts):
+                temps, topks, rep, pres, freq, allowed, pcounts,
+                prev0):
         B, _, V = logits.shape
         # The logit pipeline (models/decode.py:apply_logit_pipeline),
         # applied to EVERY verify row exactly as the L=1 sampler
@@ -666,8 +700,27 @@ def _build_spec_step_fns(cfg: ModelConfig, rope_len: int, draft_len: int,
             lp, tokens_out[..., None], axis=-1
         )[..., 0]  # (B, L)
         top_lp, top_ids = jax.lax.top_k(lp, lp_k)  # (B, L, lp_k)
+        qv = None
+        if quality:
+            # per-row quality tail over the SAME surfaces as the L=1
+            # sampler (lp for entropy, proc for margin). Row j's
+            # "previous token" is row j-1's emitted token; row 0's is
+            # the slot's last emitted token (``prev0``, the verify
+            # block's row-0 input). Rows past the accepted prefix
+            # compute garbage the host never reads.
+            prev_chain = jnp.concatenate(
+                [prev0[:, None], tokens_out[:, :-1]], axis=1
+            )
+            # the sampled rung's top-k threshold sort already ranks
+            # proc — reuse its head for the margin (greedy rungs have
+            # no sort on hand and fall back to top_k inside)
+            qv = quality_vector(
+                lp, proc, tokens_out, prev_chain,
+                top2=(sorted_desc[..., :2]
+                      if sampled and V >= 2 else None),
+            )
         return (tokens_out, (a + 1).astype(jnp.int32), ok,
-                chosen_lp, top_ids, top_lp)
+                chosen_lp, top_ids, top_lp, qv)
 
     # Every per-slot scalar operand rides ONE packed (B, 3L+k+10)
     # int32 array and every host-consumed result ONE stacked
@@ -705,17 +758,23 @@ def _build_spec_step_fns(cfg: ModelConfig, rope_len: int, draft_len: int,
         return (tokens, pos, targets, draft, dlen, counts, topks,
                 bases, temps, force_reject, pens)
 
-    def _pack_out(toks, n_emit, ok, chosen_lp, top_ids, top_lp):
+    def _pack_out(toks, n_emit, ok, chosen_lp, top_ids, top_lp, qv):
         B = toks.shape[0]
-        return jnp.concatenate(
-            [toks, n_emit[:, None], ok.astype(jnp.int32)[:, None],
-             jax.lax.bitcast_convert_type(chosen_lp, jnp.int32),
-             top_ids.astype(jnp.int32).reshape(B, L * lp_k),
-             jax.lax.bitcast_convert_type(
-                 top_lp, jnp.int32
-             ).reshape(B, L * lp_k)],
-            axis=1,
-        )
+        cols = [
+            toks, n_emit[:, None], ok.astype(jnp.int32)[:, None],
+            jax.lax.bitcast_convert_type(chosen_lp, jnp.int32),
+            top_ids.astype(jnp.int32).reshape(B, L * lp_k),
+            jax.lax.bitcast_convert_type(
+                top_lp, jnp.int32
+            ).reshape(B, L * lp_k),
+        ]
+        if qv is not None:
+            # quality tail appended LAST (every existing echo offset
+            # stays valid): entropy | margin | repeat, L columns each
+            cols.append(jax.lax.bitcast_convert_type(
+                jnp.moveaxis(qv, -1, 1).reshape(B, 3 * L), jnp.int32
+            ))
+        return jnp.concatenate(cols, axis=1)
 
     donate = jax.default_backend() != "cpu"
     if page_size > 0:
@@ -732,6 +791,7 @@ def _build_spec_step_fns(cfg: ModelConfig, rope_len: int, draft_len: int,
                 logits.astype(jnp.float32), draft, dlen, force_reject,
                 bases, counts, temps, topks,
                 pens[:, 0], pens[:, 1], pens[:, 2], allowed, pcounts,
+                tokens[:, 0],
             )
             return _pack_out(*out), new_cache
 
@@ -750,6 +810,7 @@ def _build_spec_step_fns(cfg: ModelConfig, rope_len: int, draft_len: int,
             logits.astype(jnp.float32), draft, dlen, force_reject,
             bases, counts, temps, topks,
             pens[:, 0], pens[:, 1], pens[:, 2], allowed, pcounts,
+            tokens[:, 0],
         )
         return _pack_out(*out), new_cache
 
@@ -864,6 +925,11 @@ class ServingEngine:
         # ride as host-side truncation, never a new trace.
         self._vocab = tuple(vocab) if vocab is not None else None
         self._lp_k = min(self.serving.max_logprobs, cfg.vocab_size)
+        # quality telemetry (obs/quality.py): a STATIC of the jitted
+        # sampler/verify closures like lp_k — on, they append the
+        # in-jit quality tail; off, they compile the exact
+        # pre-telemetry trace (bit-identical output by construction)
+        self._quality = bool(self.serving.quality_telemetry)
         self._constraint_cache = ConstraintCache(
             self.serving.constraint_cache_entries
         )
@@ -888,6 +954,7 @@ class ServingEngine:
                         self._pages.total_pages if self._paged else 0
                     ),
                     lp_k=self._lp_k,
+                    quality=self._quality,
                 )
                 for s in (False, True)
             }
@@ -898,6 +965,7 @@ class ServingEngine:
             page_size=self.serving.kv_page_size if self._paged else 0,
             num_pages=self._pages.total_pages if self._paged else 0,
             lp_k=self._lp_k,
+            quality=self._quality,
         )
         self.cache = (
             init_cache_paged(cfg, self._pages.total_pages,
@@ -1149,6 +1217,62 @@ class ServingEngine:
             "serving_constraint_cache_misses_total",
             "Constraint specs compiled from scratch.",
         )
+        # model-quality telemetry (obs/quality.py): the in-jit quality
+        # tail's host-side aggregation — per-token entropy/margin
+        # histograms on the fixed fingerprint bin ladders, per-layer
+        # effective-lambda gauges (the paper's central quantity, live
+        # from the SERVING params), the PSI drift score against an
+        # optional recorded fingerprint, and the constraint-validity
+        # rate the canary judge's quality axis reads. The accumulator
+        # dict and fault flag exist unconditionally (cheap pops on
+        # every retire path); metrics + monitor only when
+        # ServingConfig.quality_telemetry is on.
+        self._q_acc: dict = {}
+        self._q_force_nan = False
+        self._q_constraint_total = 0
+        self._q_constraint_bad = 0
+        self._quality_monitor = None
+        self._lambda_gauge = None
+        self._lambda_summary: dict = {}
+        if self._quality:
+            ref = None
+            if self.serving.quality_fingerprint:
+                # a bad reference path must fail at BUILD, not judge
+                # garbage drift at rollout time
+                ref = load_fingerprint(self.serving.quality_fingerprint)
+            self._quality_monitor = QualityMonitor(reference=ref)
+            self._q_entropy_hist = self.registry.histogram(
+                "serving_token_entropy",
+                "Sampled-distribution entropy (nats) per emitted token.",
+                buckets=ENTROPY_BINS,
+            )
+            self._q_margin_hist = self.registry.histogram(
+                "serving_logit_margin",
+                "Top-1 vs top-2 processed-logit margin per emitted "
+                "token.",
+                buckets=MARGIN_BINS,
+            )
+            self._q_drift_gauge = self.registry.gauge(
+                "serving_quality_drift",
+                "Max PSI drift of the live entropy/margin sketches vs "
+                "the recorded reference fingerprint (0 = no reference, "
+                "thin evidence, or no drift).",
+            )
+            self._q_validity_gauge = self.registry.gauge(
+                "serving_constraint_validity_rate",
+                "Fraction of finished constrained requests that did "
+                "NOT dead-end (1.0 until any constrained request "
+                "finishes).",
+            )
+            self._q_validity_gauge.set(1.0)
+            self._lambda_gauge = self.registry.gauge(
+                "serving_lambda_mean",
+                "Per-layer effective differential-attention lambda of "
+                "the serving params (head/term mean; absent for the "
+                "control family).",
+                labelnames=("layer",),
+            )
+            self._refresh_lambda_gauges()
         # Continuous on-device profiling (obs/device_profile.py): every
         # profile_every engine iterations, wrap ONE iteration in a
         # jax.profiler capture, parse it off-loop, and publish device_*
@@ -1291,6 +1415,7 @@ class ServingEngine:
         del self._base_keys[request_id]
         self._drop_constraint(request_id)
         self._drop_resume(request_id)
+        self._q_acc.pop(request_id, None)
         self.stats.inc("cancelled")
         self._finished_counter.inc(reason="cancelled")
         return True
@@ -1328,6 +1453,16 @@ class ServingEngine:
             and self._device_prof.maybe_begin(iteration)
         )
         faults.serve_fire(iteration)
+        if self._quality:
+            # chaos drills for the drift detector (utils/faults.py):
+            # quality_drift perturbs the live params — logits stay
+            # FINITE, so requests keep succeeding and latency is flat;
+            # only the quality axis can catch it. quality_nan poisons
+            # this iteration's telemetry tail host-side — it must
+            # degrade to "no signal", never crash the step or judge.
+            if faults.quality_drift_at(iteration):
+                self._apply_quality_drift()
+            self._q_force_nan = faults.quality_nan_at(iteration)
         # build into the survives-an-exception buffer: a request that
         # finishes (or is deadline-shed) early in this step and is
         # already retired must still reach its caller when a LATER part
@@ -1512,6 +1647,8 @@ class ServingEngine:
                     self._emit(
                         s, int(sampled[s.index]), now, finished,
                         lp=self._lp_echo(s, packed[s.index]),
+                        q=(self._quality_echo(packed[s.index])
+                           if self._quality else None),
                     )
 
         if capturing:
@@ -1573,6 +1710,8 @@ class ServingEngine:
                 self._emit(
                     slot, int(tok[0]), time.perf_counter(), finished,
                     lp=self._lp_echo(slot, packed[0]),
+                    q=(self._quality_echo(packed[0])
+                       if self._quality else None),
                 )
 
     # -- speculative decoding (serving/spec.py) ------------------------
@@ -1822,6 +1961,8 @@ class ServingEngine:
                     self._emit(
                         s, int(toks[s.index, j]), now, finished,
                         lp=self._spec_lp_echo(s, out[s.index], j, L),
+                        q=(self._spec_quality_echo(out[s.index], j, L)
+                           if self._quality else None),
                     )
                     if s.state == FREE:
                         break  # EOS/stop/length retired the slot mid-block
@@ -1874,6 +2015,16 @@ class ServingEngine:
                 self.stats["spec_accepted"] / proposed if proposed
                 else 0.0
             )
+        if self._quality_monitor is not None:
+            # quality mirror (BOTH cache layouts — ahead of the paged
+            # early-return below): the drift score is O(bins) host
+            # math over the live sketches, nothing device-side
+            self._q_drift_gauge.set(self._quality_monitor.drift())
+            if self._q_constraint_total:
+                self._q_validity_gauge.set(
+                    1.0
+                    - self._q_constraint_bad / self._q_constraint_total
+                )
         if self._pages is not None:
             st = self._pages.stats()
             self._pages_free_gauge.set(st["free"])
@@ -1942,6 +2093,108 @@ class ServingEngine:
         out = dict(self._constraint_cache.stats())
         out["active"] = len(self._constraints)
         return out
+
+    # -- model-quality observability (obs/quality.py) ------------------
+
+    def quality_stats(self) -> Optional[dict]:
+        """Point-in-time quality snapshot for /health and serve_bench
+        (None when quality telemetry is off): live sketch means, token
+        counts, skipped ("no signal") observations, the PSI drift
+        score, the constraint-validity rate, the cumulative spec
+        acceptance when spec is on, and the per-layer lambda summary
+        the gauges mirror."""
+        if self._quality_monitor is None:
+            return None
+        out = self._quality_monitor.stats()
+        out["constraint_validity_rate"] = (
+            1.0 - self._q_constraint_bad / self._q_constraint_total
+            if self._q_constraint_total else 1.0
+        )
+        proposed = self.stats["spec_proposed"]
+        if proposed:
+            out["spec_acceptance_rate"] = round(
+                self.stats["spec_accepted"] / proposed, 4
+            )
+        out.update(self._lambda_summary)
+        return out
+
+    def quality_fingerprint(self,
+                            meta: Optional[dict] = None) -> Optional[dict]:
+        """The live sketches as a serializable reference fingerprint —
+        ``--quality-record``'s payload (obs/quality.py:
+        ``save_fingerprint`` writes it atomically at drain). None when
+        telemetry is off."""
+        if self._quality_monitor is None:
+            return None
+        return self._quality_monitor.fingerprint(meta=meta)
+
+    def quality_row(self) -> Optional[dict]:
+        """One ``{"record": "quality"}`` JSONL row (the serving twin
+        of the trainer's introspection records), carrying the
+        ``lambda_l<k>`` keys ``tools/lambda_report.py --serving``
+        renders beside training rows. None when telemetry is off."""
+        if self._quality_monitor is None:
+            return None
+        return build_quality_row(
+            self._quality_monitor, self.stats["iterations"],
+            lambdas=self._lambda_summary,
+        )
+
+    def _refresh_lambda_gauges(self) -> None:
+        """Mirror the SERVING params' per-layer effective lambdas into
+        ``serving_lambda_mean{layer=}`` — obs/introspect.py walks the
+        same ops/lambdas.py path the trainer logs, so ROADMAP item 6's
+        diff-vs-control comparison reads straight off a live fleet.
+        Called at build and after any params rebind (the quality_drift
+        fault), never per step: the summary fetches device scalars."""
+        if self._lambda_gauge is None:
+            return
+        from differential_transformer_replication_tpu.obs.introspect import (
+            serving_lambda_summary,
+        )
+
+        self._lambda_summary = serving_lambda_summary(
+            self.params, self.cfg
+        )
+        for key, val in self._lambda_summary.items():
+            if "_t" in key:
+                continue  # per-term ndiff detail rides quality_row only
+            self._lambda_gauge.set(val, layer=key[len("lambda_l"):])
+
+    def _apply_quality_drift(self) -> None:
+        """Fault-injection helper (``quality_drift@N``): perturb the
+        live params so generated DISTRIBUTIONS shift while every logit
+        stays finite — requests keep succeeding and latency stays
+        flat, so only the drift detector can catch it (the canary
+        chaos drill's point). Every family gets lm_head scaled by
+        0.25: the sampled distribution flattens (entropy up, margin
+        down) while the greedy argmax is bit-unchanged — on control,
+        greedy traffic's tokens are untouched and only the
+        fingerprint convicts. diff/ndiff additionally get +2.0 on BOTH
+        lambda_q[0] and lambda_k[0] of layer 1 — λ rides exp(lq·lk)
+        and the reference initializes those vectors to zero, so one
+        side alone is a no-op; shifting both moves term 0's
+        exponential by ~exp(4) (bounded, finite), which the
+        ``serving_lambda_mean`` gauges surface as the fault's visible
+        signature. Params are never donated by the jitted steps, so
+        rebinding a shallow-copied tree is safe; the lambda gauges
+        refresh to show the perturbed values."""
+        params = dict(self.params)
+        if self.cfg.model in ("diff", "ndiff"):
+            blocks = list(params["blocks"])
+            blk = dict(blocks[0])
+            attn = dict(blk["attn"])
+            for name in ("lambda_q", "lambda_k"):
+                vec = attn[name]
+                attn[name] = vec.at[0].add(2.0)
+            blk["attn"] = attn
+            blocks[0] = blk
+            params["blocks"] = blocks
+        params["lm_head"] = jax.tree_util.tree_map(
+            lambda a: a * 0.25, params["lm_head"]
+        )
+        self.params = params
+        self._refresh_lambda_gauges()
 
     def take_finished(self) -> List[RequestOutput]:
         """Outputs accumulated by a :meth:`step` that raised partway
@@ -2243,6 +2496,9 @@ class ServingEngine:
             self._resume.pop(rid, None)
             self._tier.drop_stash(rid)
             self.stats.inc("tier_fallbacks")
+            # the bit-exact recompute re-emits every token: reset the
+            # per-request quality accumulator so means are not doubled
+            self._q_acc.pop(rid, None)
             return "restart"
         self._resumed.append((slot, snap))
         self.stats.inc("resumes")
@@ -2279,6 +2535,7 @@ class ServingEngine:
         self._base_keys.pop(request.request_id, None)
         self._drop_constraint(request.request_id)
         self._drop_resume(request.request_id)
+        self._q_acc.pop(request.request_id, None)
         self.stats.inc("page_shed")
         self._finished_counter.inc(reason="page_exhausted")
         if self._tracing:
@@ -2399,12 +2656,16 @@ class ServingEngine:
     def _sample_operands(self, rows, B):
         """Packed (B, 8) int32 sampler operand plus the pipeline's
         allowed/counts arrays for a (row index, slot) assignment (see
-        _build_step_fns._sample for the column layout). Rows not named
-        keep inert defaults (temp 1, penalties off, mask all-ones)."""
-        ints = np.zeros((B, 8), np.int32)
+        _build_step_fns._sample for the column layout; quality
+        telemetry widens it by one previous-token column). Rows not
+        named keep inert defaults (temp 1, penalties off, mask
+        all-ones, no previous token)."""
+        ints = np.zeros((B, 9 if self._quality else 8), np.int32)
         f = ints[:, 4:8].view(np.float32)
         f[:, 0] = 1.0  # temperature
         f[:, 1] = 1.0  # repetition penalty (1 = off)
+        if self._quality:
+            ints[:, 8] = -1  # no previous token (repeat flag stays 0)
         need_mask = need_counts = False
         for i, s in rows:
             p = s.request.params
@@ -2417,6 +2678,14 @@ class ServingEngine:
             f[i, 1] = p.repetition_penalty
             f[i, 2] = p.presence_penalty
             f[i, 3] = p.frequency_penalty
+            if self._quality:
+                # the token the sampled one would repeat: the last
+                # emitted, or (first sample, at prefill completion)
+                # the last prompt token
+                if s.generated:
+                    ints[i, 8] = s.generated[-1]
+                elif s.prompt_len:
+                    ints[i, 8] = int(s.prompt[s.prompt_len - 1])
             if self._slot_fsm(s) is not None:
                 need_mask = True
             if _penalties_on(p):
@@ -2501,8 +2770,59 @@ class ServingEngine:
             (int(i), float(v)) for i, v in zip(ids, lps)
         ]
 
+    def _quality_echo(self, row: np.ndarray):
+        """The L=1 sampler's appended quality tail as host floats —
+        (entropy, margin, repeat flag), the bitcast twin of
+        :meth:`_lp_echo` (see _build_step_fns._sample's layout)."""
+        base = 3 + 2 * self._lp_k
+        q = row[base:base + 3].view(np.float32)
+        return float(q[0]), float(q[1]), float(q[2])
+
+    def _spec_quality_echo(self, row: np.ndarray, j: int, L: int):
+        """Verify row j's quality tail from the spec step's packed
+        output: ent | margin | rep blocks of L columns each, appended
+        after the logprob echo (_build_spec_step_fns._pack_out)."""
+        base = 2 + 2 * L + 2 * L * self._lp_k
+        ent = row[base + j:base + j + 1].view(np.float32)[0]
+        margin = row[base + L + j:base + L + j + 1].view(np.float32)[0]
+        rep = row[base + 2 * L + j:base + 2 * L + j + 1].view(
+            np.float32
+        )[0]
+        return float(ent), float(margin), float(rep)
+
+    def _q_observe(self, rid: int, q) -> None:
+        """Fold one emitted token's quality tail into the histograms,
+        the drift monitor's sketches, and the per-request accumulator
+        (keyed by request id, so preempt/resume carries it for free).
+        The ``quality_nan`` fault poisons the values HERE: non-finite
+        signals are skipped everywhere downstream — "no signal", never
+        a crash, never a poisoned fingerprint."""
+        ent, margin, rep = q
+        if self._q_force_nan:
+            ent = margin = float("nan")
+        if math.isfinite(ent):
+            self._q_entropy_hist.observe(ent)
+        if math.isfinite(margin):
+            self._q_margin_hist.observe(margin)
+        self._quality_monitor.observe(ent, margin)
+        acc = self._q_acc.get(rid)
+        if acc is None:
+            # ent_sum, ent_n, margin_sum, margin_n, rep_run, rep_max
+            acc = self._q_acc[rid] = [0.0, 0, 0.0, 0, 0, 0]
+        if math.isfinite(ent):
+            acc[0] += ent
+            acc[1] += 1
+        if math.isfinite(margin):
+            acc[2] += margin
+            acc[3] += 1
+        if rep > 0.5:
+            acc[4] += 1
+            acc[5] = max(acc[5], acc[4])
+        else:
+            acc[4] = 0
+
     def _emit(self, slot: Slot, token: int, now: float,
-              finished: List[RequestOutput], lp=None) -> None:
+              finished: List[RequestOutput], lp=None, q=None) -> None:
         prev_token_t = slot.token_times[-1] if slot.token_times else None
         slot.generated.append(token)
         slot.token_times.append(now)
@@ -2512,6 +2832,8 @@ class ServingEngine:
                 slot.top_logprobs = []
             slot.token_logprobs.append(lp[0])
             slot.top_logprobs.append(lp[1])
+        if q is not None:
+            self._q_observe(slot.request.request_id, q)
         if len(slot.generated) == 1:
             slot.first_token_time = now
             slot.state = ACTIVE
@@ -2565,8 +2887,35 @@ class ServingEngine:
 
     def _finish(self, slot: Slot, reason: str,
                 now: Optional[float] = None) -> RequestOutput:
+        rid = slot.request.request_id
+        quality = None
+        if self._quality:
+            acc = self._q_acc.pop(rid, None)
+            quality = {
+                "entropy_mean": (
+                    round(acc[0] / acc[1], 6)
+                    if acc and acc[1] else None
+                ),
+                "margin_mean": (
+                    round(acc[2] / acc[3], 6)
+                    if acc and acc[3] else None
+                ),
+                "tokens_observed": acc[1] if acc else 0,
+                "rep_run_max": acc[5] if acc else 0,
+            }
+            if slot.spec_proposed:
+                quality["spec_acceptance"] = round(
+                    slot.spec_accepted / slot.spec_proposed, 4
+                )
+            if slot.request.params.constrained:
+                # the validity rate the canary judge's quality axis
+                # compares across arms: a dead end is the constrained
+                # path's "wrong answer"
+                self._q_constraint_total += 1
+                if reason == "constraint_dead_end":
+                    self._q_constraint_bad += 1
         out = RequestOutput(
-            request_id=slot.request.request_id,
+            request_id=rid,
             prompt=[int(t) for t in slot.prompt],
             tokens=list(slot.generated),
             finish_reason=reason,
@@ -2592,6 +2941,7 @@ class ServingEngine:
                 list(slot.top_logprobs)
                 if slot.top_logprobs is not None else None
             ),
+            quality=quality,
         )
         if self._tracing:
             targs = (
@@ -2632,6 +2982,7 @@ class ServingEngine:
         self._base_keys.pop(request.request_id, None)
         self._drop_constraint(request.request_id)
         self._drop_resume(request.request_id)
+        self._q_acc.pop(request.request_id, None)
         self.stats.inc("deadline_expired")
         self._finished_counter.inc(reason="deadline")
         if self._tracing:
@@ -2725,6 +3076,7 @@ class ServingEngine:
                 self._base_keys.pop(rid, None)
                 self._drop_constraint(rid)
                 self._drop_resume(rid)
+                self._q_acc.pop(rid, None)
         preserved = list(self.scheduler.queue)
         self._resumed = []
         if self._tier is not None:

@@ -262,6 +262,14 @@ class RequestOutput:
     # PagePool.estimated_drain_s (observed eviction/release throughput).
     # None = no estimate; HTTP Retry-After falls back to queue bounds.
     retry_after: Optional[float] = None
+    # Per-request model-quality stats (obs/quality.py) when the engine
+    # runs with ServingConfig.quality_telemetry: mean sampled-
+    # distribution entropy and top-1 logit margin over the request's
+    # FINITE per-token signals (None means every signal was "no
+    # signal"), the count actually observed, the longest
+    # repeat-of-previous-token run, and the spec acceptance ratio when
+    # speculation engaged. None when telemetry is off.
+    quality: Optional[dict] = None
 
     @property
     def ttft(self) -> float:

@@ -178,7 +178,12 @@ def aggregate_fleet_metrics(bodies: Dict[str, str],
       ``replica="host:port"`` label (summing slot occupancies would
       hide exactly the imbalance a fleet scrape exists to show), which
       also keeps per-replica ``build_info``/``process_start_time``
-      distinguishable;
+      distinguishable — and is exactly what the model-quality plane
+      rides: ``serving_quality_drift`` / ``serving_lambda_mean`` /
+      ``serving_constraint_validity_rate`` (obs/quality.py) arrive
+      per-replica with zero router changes, so the canary judge
+      (tools/autoscaler.py) reads one arm's drift without the control
+      arm diluting it;
     - the router's ``own`` metrics pass through unmodified, merged
       under the same TYPE declarations so shared names (``build_info``)
       render once.

@@ -973,6 +973,8 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                     [[tid, lp] for tid, lp in row]
                     for row in out.top_logprobs
                 ]
+            if out.quality is not None:
+                payload["quality"] = out.quality
             if tokenizer is not None:
                 payload["text"] = tokenizer.decode(out.tokens)
             events.emit("request_finished",
@@ -1148,6 +1150,32 @@ def main() -> None:
                    help="append structured JSONL events (request "
                         "received/finished/failed with trace ids; "
                         "obs/events.py) to this path")
+    p.add_argument("--event-log-max-bytes", type=int, default=0,
+                   help="rotate --event-log when it reaches this many "
+                        "bytes (atomic rename cascade, whole lines "
+                        "only; 0 = never rotate)")
+    p.add_argument("--event-log-keep", type=int, default=3,
+                   help="rotated --event-log generations to keep "
+                        "(events.jsonl.1 ... .N; 0 = truncate)")
+    p.add_argument("--quality-telemetry", action="store_true",
+                   help="compute per-token model-quality signals "
+                        "(sampled-distribution entropy, top-1 logit "
+                        "margin, repetition runs) inside the jitted "
+                        "decode step (obs/quality.py): per-request "
+                        "quality stats on responses, "
+                        "serving_token_entropy / serving_logit_margin "
+                        "histograms and serving_lambda_mean{layer=} / "
+                        "serving_quality_drift gauges on /metrics")
+    p.add_argument("--quality-fingerprint", default=None,
+                   help="reference quality fingerprint JSON to compare "
+                        "live traffic against (PSI drift score as "
+                        "serving_quality_drift; recorded earlier with "
+                        "--quality-record); implies --quality-telemetry")
+    p.add_argument("--quality-record", default=None,
+                   help="write this replica's quality fingerprint "
+                        "(quantile sketches of the live entropy/margin "
+                        "distributions) to this path at drain/shutdown; "
+                        "implies --quality-telemetry")
     p.add_argument("--slo-ttft", type=float, default=1.0,
                    help="TTFT latency objective bound in seconds "
                         "(obs/slo.py; burn rates exposed as slo_* "
@@ -1247,6 +1275,12 @@ def main() -> None:
         spec_draft_len=args.spec_draft_len,
         spec_drafter_ckpt=args.spec_drafter_ckpt,
         spec_verify=args.spec_verify,
+        # recording or comparing a fingerprint both need the in-step
+        # telemetry tail, so either flag arms it
+        quality_telemetry=(args.quality_telemetry
+                           or bool(args.quality_fingerprint)
+                           or bool(args.quality_record)),
+        quality_fingerprint=args.quality_fingerprint or "",
     )
     spec_drafter = None
     if args.spec_mode == "model" and args.spec_drafter_ckpt:
@@ -1276,7 +1310,9 @@ def main() -> None:
             EventLog,
         )
 
-        events = EventLog(args.event_log, process="replica")
+        events = EventLog(args.event_log, process="replica",
+                          max_bytes=args.event_log_max_bytes,
+                          keep=args.event_log_keep)
     engine = ServingEngine(params, model_cfg, serving, tracer=tracer,
                            spec_drafter=spec_drafter, vocab=vocab)
     client = ServingClient(engine)
@@ -1313,6 +1349,28 @@ def main() -> None:
     import signal
 
     drained = {"done": False}
+    fingerprint_saved = {"done": False}
+
+    def _save_quality_fingerprint():
+        """Snapshot the live quality sketches to --quality-record;
+        idempotent (drain path and main finally both call it)."""
+        if not args.quality_record or fingerprint_saved["done"]:
+            return
+        fingerprint_saved["done"] = True
+        try:
+            from differential_transformer_replication_tpu.obs.quality import (
+                save_fingerprint,
+            )
+
+            rec = engine.quality_fingerprint(
+                meta={"model": model_cfg.model, "config_hash": cfg_hash}
+            )
+            save_fingerprint(args.quality_record, rec)
+            print(f"[serve] quality fingerprint written to "
+                  f"{args.quality_record}", file=sys.stderr)
+        except Exception as e:  # forensics must not block shutdown
+            print(f"[serve] quality fingerprint save failed: {e!r}",
+                  file=sys.stderr)
 
     def _graceful(signum, frame):
         del frame
@@ -1336,6 +1394,7 @@ def main() -> None:
                 # thread's finally block alone, which a wedged drain
                 # could starve — close here (idempotent; the atexit net
                 # in obs/spans.py+obs/events.py is the last resort)
+                _save_quality_fingerprint()
                 if tracer is not None:
                     tracer.close()
                 if events is not None:
@@ -1364,6 +1423,7 @@ def main() -> None:
         httpd.server_close()
         if not drained["done"]:
             client.close()
+        _save_quality_fingerprint()
         if tracer is not None:
             tracer.close()
             print(f"[serve] span trace written to {args.trace_path}")

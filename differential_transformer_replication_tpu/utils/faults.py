@@ -142,6 +142,24 @@ serving/server.py):
                         stash, and fall back to a full bit-exact
                         restart of the request (fold_in per-request
                         keys) — never garbage tokens. One-shot.
+  ``quality_drift@N``   perturb the model's params before engine
+                        iteration N (layer-1 λ for diff/ndiff; an
+                        exact lm_head logit rescale for control, so
+                        greedy outputs stay IDENTICAL) — logits stay
+                        finite and latency flat, only the token-
+                        quality distribution moves; the drift
+                        fingerprint (obs/quality.py,
+                        ``serving_quality_drift``) is the ONLY
+                        detector that can catch it. Requires
+                        ``--quality-telemetry``. One-shot; persists in
+                        the params until restart.
+  ``quality_nan@N``     NaN-poison the HOST-side quality telemetry of
+                        engine iteration N (the decode step itself is
+                        untouched): every signal that iteration must
+                        degrade to "no signal" — skipped
+                        observations, never a crash, never a drift
+                        false-positive. Requires
+                        ``--quality-telemetry``. One-shot.
 
 Constraint fault points (call-point style — ``@N`` counts CALLS):
 
@@ -247,6 +265,10 @@ _STEP_KINDS = (
     # autoscaler kind (tools/autoscaler.py): "step" is a control TICK;
     # armed ticks see an oscillating capacity signal (not one-shot)
     "scale_flap",
+    # model-quality kinds (obs/quality.py): a silent params drift only
+    # the quality fingerprint catches, and a NaN telemetry tail that
+    # must degrade to "no signal" rather than crash the step or judge
+    "quality_drift", "quality_nan",
 )
 _POINT_KINDS = (
     "ckpt_write", "ckpt_fsync", "ckpt_manifest", "ckpt_gc",
@@ -471,6 +493,31 @@ def page_swap_corrupt_at(iteration: int) -> bool:
     p = _get()
     if iteration in p["page_swap_corrupt"]:
         p["page_swap_corrupt"].discard(iteration)
+        return True
+    return False
+
+
+def quality_drift_at(iteration: int) -> bool:
+    """One-shot silent-drift fault: when armed for this engine
+    iteration, the engine perturbs its params (λ for the diff
+    families, an argmax-preserving logit rescale for control) — logits
+    stay finite and fast, so only the quality fingerprint's PSI score
+    can flag the replica. The perturbation persists until restart."""
+    p = _get()
+    if iteration in p["quality_drift"]:
+        p["quality_drift"].discard(iteration)
+        return True
+    return False
+
+
+def quality_nan_at(iteration: int) -> bool:
+    """One-shot telemetry-poison fault: when armed for this engine
+    iteration, the engine replaces that iteration's host-side quality
+    signals with NaN — the "no signal" degradation contract
+    (obs/quality.py) must skip them, never crash or score drift."""
+    p = _get()
+    if iteration in p["quality_nan"]:
+        p["quality_nan"].discard(iteration)
         return True
     return False
 

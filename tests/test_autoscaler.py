@@ -18,15 +18,21 @@ The load-bearing contracts:
 - the canary judge reuses slo_report's burn gate and perf_gate's
   regression slack, refuses to promote on thin evidence, and the
   controller always rolls back to the exact previous argv/env;
+- the judge's QUALITY axis (obs/quality.py): a latency-flat canary
+  whose PSI drift or constraint-validity delta exceeds budget rolls
+  back anyway; absent telemetry (None) never gates — quality is
+  opt-in, not fail-closed;
 - ``Fleet.scale_down`` drains the least-loaded replica by the router's
   score and RELEASES its supervision lease; ``scale_up`` mints fresh
   slots with fresh restart budgets.
 
 Quick tier: injectable clocks/transports, canned expositions, fake
 fleets. Slow tier: diurnal trace replay + SIGKILL mid-scale-down over
-a real fleet (zero failed requests, compile pin), and a deliberately
+a real fleet (zero failed requests, compile pin), a deliberately
 perf-regressed canary (``canary_regress`` fault) auto-rolling back
-unattended with zero failed requests.
+unattended with zero failed requests, and a SILENTLY-drifted canary
+(``quality_drift`` fault: finite logits, flat latency) convicted by
+the fingerprint axis alone — same zero-loss bar.
 """
 
 import importlib.util
@@ -800,6 +806,71 @@ class TestCanaryJudge:
         ws = autoscaler.window_stats([("", "")], 0.5, 0.9)
         assert ws["count"] == 0.0
 
+    def test_window_stats_extracts_quality_signals(self):
+        """The quality keys ride the same scrape pairs: windowed
+        entropy/margin means from the histogram _sum/_count deltas,
+        worst (max) drift gauge and worst (min) validity rate from the
+        AFTER bodies (gauges are levels, not counters)."""
+        extra_b = (
+            "serving_token_entropy_sum 10.0\n"
+            "serving_token_entropy_count 4\n"
+            "serving_logit_margin_sum 2.0\n"
+            "serving_logit_margin_count 4\n"
+            "serving_quality_drift 0.05\n"
+            "serving_constraint_validity_rate 1.0\n"
+        )
+        extra_a = (
+            "serving_token_entropy_sum 55.0\n"
+            "serving_token_entropy_count 14\n"
+            "serving_logit_margin_sum 4.0\n"
+            "serving_logit_margin_count 14\n"
+            "serving_quality_drift 0.42\n"
+            "serving_constraint_validity_rate 0.9\n"
+        )
+        ws = autoscaler.window_stats(
+            [(_hist_expo(5, 5, extra=extra_b),
+              _hist_expo(14, 15, extra=extra_a))],
+            0.5, 0.9,
+        )
+        assert ws["entropy_mean"] == pytest.approx(4.5)  # (55-10)/(14-4)
+        assert ws["margin_mean"] == pytest.approx(0.2)
+        assert ws["drift"] == pytest.approx(0.42)  # the after level
+        assert ws["validity"] == pytest.approx(0.9)
+        # no telemetry -> every quality key is None (gates pass open)
+        ws = autoscaler.window_stats(
+            [(_hist_expo(5, 5), _hist_expo(14, 15))], 0.5, 0.9
+        )
+        assert ws["entropy_mean"] is None and ws["margin_mean"] is None
+        assert ws["drift"] is None and ws["validity"] is None
+        # restart clamp: counts stepped backwards -> no mean, never
+        # negative; drift still reads the after level
+        ws = autoscaler.window_stats(
+            [(_hist_expo(5, 5, extra=extra_a),
+              _hist_expo(14, 15, extra=extra_b))],
+            0.5, 0.9,
+        )
+        assert ws["entropy_mean"] is None
+        assert ws["drift"] == pytest.approx(0.05)
+
+    def test_window_stats_worst_drift_across_replicas(self):
+        drifted = _hist_expo(9, 10, extra="serving_quality_drift 0.6\n")
+        calm = _hist_expo(10, 10, extra="serving_quality_drift 0.01\n")
+        inf_body = _hist_expo(
+            10, 10, extra="serving_quality_drift +Inf\n"
+        )
+        ws = autoscaler.window_stats(
+            [(_hist_expo(0, 0), calm), (_hist_expo(0, 0), drifted)],
+            0.5, 0.9,
+        )
+        assert ws["drift"] == pytest.approx(0.6)
+        # inf = incompatible fingerprint ladder: kept, so the judge
+        # convicts rather than silently passing garbage bins
+        ws = autoscaler.window_stats(
+            [(_hist_expo(0, 0), calm), (_hist_expo(0, 0), inf_body)],
+            0.5, 0.9,
+        )
+        assert ws["drift"] == math.inf
+
     def test_thin_evidence_rolls_back(self):
         verdict, reason = autoscaler.judge_canary(
             _stats(count=3.0), _stats(), self.CFG
@@ -837,6 +908,84 @@ class TestCanaryJudge:
             self.CFG,
         )
         assert verdict == "promote"
+
+    # -- the quality axis (obs/quality.py) ---------------------------
+
+    def test_quality_drift_rolls_back_despite_flat_latency(self):
+        verdict, reason = autoscaler.judge_canary(
+            _stats(drift=0.30), _stats(), self.CFG
+        )
+        assert verdict == "rollback"
+        assert "quality drift" in reason
+        assert "latency alone would have promoted" in reason
+
+    def test_quality_drift_inside_budget_promotes(self):
+        for drift in (None, 0.0, 0.24, float("nan")):
+            verdict, _ = autoscaler.judge_canary(
+                _stats(drift=drift), _stats(), self.CFG
+            )
+            assert verdict == "promote", drift
+
+    def test_quality_drift_inf_rolls_back(self):
+        # inf = incompatible fingerprint ladder (drift_score contract):
+        # a fingerprint that cannot be compared must not promote
+        verdict, reason = autoscaler.judge_canary(
+            _stats(drift=math.inf), _stats(), self.CFG
+        )
+        assert verdict == "rollback" and "quality drift" in reason
+
+    def test_quality_drift_gate_off_at_zero_budget(self):
+        cfg = AutoscalerConfig(
+            canary_min_requests=8, canary_max_burn=1.0,
+            canary_max_regress=0.5, canary_max_drift=0.0,
+        )
+        verdict, _ = autoscaler.judge_canary(
+            _stats(drift=5.0), _stats(), cfg
+        )
+        assert verdict == "promote"
+
+    def test_validity_delta_rolls_back(self):
+        verdict, reason = autoscaler.judge_canary(
+            _stats(validity=0.90), _stats(validity=1.0), self.CFG
+        )
+        assert verdict == "rollback"
+        assert "constraint validity" in reason
+        # within the 0.05 delta budget: promoted
+        verdict, _ = autoscaler.judge_canary(
+            _stats(validity=0.96), _stats(validity=1.0), self.CFG
+        )
+        assert verdict == "promote"
+
+    def test_validity_baseline_defaults_to_perfect(self):
+        # control without constrained traffic (validity None): the
+        # canary is held to 1.0, not excused
+        verdict, reason = autoscaler.judge_canary(
+            _stats(validity=0.90), _stats(validity=None), self.CFG
+        )
+        assert verdict == "rollback" and "1.000" in reason
+        verdict, _ = autoscaler.judge_canary(
+            _stats(validity=0.97), _stats(validity=None), self.CFG
+        )
+        assert verdict == "promote"
+
+    def test_validity_gate_off_at_zero_budget(self):
+        cfg = AutoscalerConfig(
+            canary_min_requests=8, canary_max_burn=1.0,
+            canary_max_regress=0.5, canary_max_validity_delta=0.0,
+        )
+        verdict, _ = autoscaler.judge_canary(
+            _stats(validity=0.1), _stats(validity=1.0), cfg
+        )
+        assert verdict == "promote"
+
+    def test_latency_gates_rule_before_quality(self):
+        # a canary that is BOTH slow and drifted is convicted on the
+        # burn gate first — quality is the tiebreaker, not the lead
+        verdict, reason = autoscaler.judge_canary(
+            _stats(burn_rate=5.0, error_ratio=0.05, drift=0.9),
+            _stats(), self.CFG,
+        )
+        assert verdict == "rollback" and "burn rate" in reason
 
 
 class _FakeCanaryFleet:
@@ -1324,6 +1473,165 @@ def test_chaos_canary_regress_auto_rollback_zero_loss():
         assert router.canary() == (None, 0.0)
         assert len(fleet.replicas) == 2
         # ZERO failed client requests through relaunch + rollback
+        bad = [(s, b) for s, b in results if s != 200]
+        assert not bad, f"{len(bad)} failed requests, first: {bad[:3]}"
+        assert len(results) >= 10
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if router is not None:
+            router.close()
+        fleet.stop()
+
+
+@pytest.mark.slow
+def test_chaos_canary_quality_drift_auto_rollback_zero_loss(tmp_path):
+    """Acceptance pin for the quality axis: a canary whose params are
+    SILENTLY perturbed (``quality_drift`` fault — finite logits,
+    greedy tokens unchanged on the control family, latency flat) is
+    convicted by the PSI drift score against a recorded fingerprint
+    and auto-rolled-back with ZERO failed client requests. Every
+    latency gate is given generous slack, so the quality gate is the
+    only one that can convict — a burn- or p95-triggered rollback
+    would fail the reason assertion."""
+    import jax
+
+    from differential_transformer_replication_tpu.config import (
+        ModelConfig,
+        ServingConfig,
+    )
+    from differential_transformer_replication_tpu.models import init_model
+    from differential_transformer_replication_tpu.obs.quality import (
+        save_fingerprint,
+    )
+    from differential_transformer_replication_tpu.serving import (
+        ServingEngine,
+    )
+
+    # record the reference fingerprint from an engine bit-matching the
+    # server's random-init demo model (serving/server.py), driving the
+    # same greedy traffic shape the chaos clients will send
+    model_cfg = ModelConfig(
+        model="control", vocab_size=512, n_embd=64, n_head=2,
+        n_layer=2, block_size=128, compute_dtype="float32",
+    )
+    rec_eng = ServingEngine(
+        init_model(jax.random.PRNGKey(0), model_cfg), model_cfg,
+        ServingConfig(num_slots=2, prefill_chunk=16, prefill_budget=32,
+                      quality_telemetry=True),
+    )
+    rec_eng.generate(
+        [[1 + (w + k) % 7] * (1 + k % 12)
+         for w in range(6) for k in range(1, 8)],
+        max_new_tokens=2, temperature=0.0,
+    )
+    assert rec_eng.quality_stats()["tokens_observed"] >= 32
+    fp = str(tmp_path / "quality_fp.json")
+    save_fingerprint(fp, rec_eng.quality_fingerprint(
+        meta={"model": "control", "source": "chaos-test"}
+    ))
+
+    fleet_mod = _load_fleet()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    fleet = fleet_mod.Fleet(
+        2,
+        server_args=["--num-slots", "2", "--prefill-chunk", "16",
+                     "--prefill-budget", "32", "--drain-timeout", "60",
+                     "--max-queue-len", "0",
+                     "--quality-telemetry", "--quality-fingerprint", fp],
+        env=env, max_restarts=3, backoff_base=0.2, backoff_max=2.0,
+        ready_timeout_s=240.0,
+    )
+    router = None
+    httpd = None
+    try:
+        fleet.start()
+        for url in fleet.urls:
+            _warm_ladder(url)
+        router = Router(fleet.urls, _chaos_router_cfg()).start()
+        httpd = serve_router(router, port=0)
+        gen_url = (
+            f"http://127.0.0.1:{httpd.server_address[1]}/generate"
+        )
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+        results = []
+        results_lock = threading.Lock()
+        stop = threading.Event()
+
+        def client(wid):
+            k = 0
+            while not stop.is_set():
+                k += 1
+                req = urllib.request.Request(
+                    gen_url,
+                    data=json.dumps({
+                        "prompt_ids": [1 + (wid + k) % 7] * (1 + k % 12),
+                        "max_new_tokens": 2, "temperature": 0.0,
+                        "seed": wid * 1000 + k, "timeout": 60,
+                    }).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=90) as r:
+                        rec = (r.status, json.load(r))
+                except urllib.error.HTTPError as e:
+                    rec = (e.code, json.loads(e.read() or b"{}"))
+                except OSError as e:
+                    rec = (-1, {"error": repr(e)})
+                with results_lock:
+                    results.append(rec)
+
+        workers = [
+            threading.Thread(target=client, args=(w,)) for w in range(6)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            time.sleep(1.0)
+            original_argv = list(fleet.replicas[1].argv)
+            # quality_drift@2 perturbs the canary's params two engine
+            # iterations after it comes back: every request still
+            # succeeds (finite logits, greedy argmax unchanged on
+            # control), and the latency gates below are slack enough
+            # that only the fingerprint's PSI score can convict
+            cc = autoscaler.CanaryController(
+                fleet, router,
+                AutoscalerConfig(
+                    canary_fraction=0.5, canary_window_s=15.0,
+                    canary_min_requests=2, ttft_threshold_s=30.0,
+                    slo_target=0.9, canary_max_burn=1000.0,
+                    canary_max_regress=100.0, canary_max_drift=0.25,
+                ),
+            )
+            record = cc.run(
+                index=1,
+                extra_env={"DTX_FAULTS": "quality_drift@2"},
+            )
+            time.sleep(1.0)  # serve a little while fully healed
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=180)
+                assert not w.is_alive(), "client hung"
+
+        # convicted on the QUALITY axis, not on any latency gate
+        assert record["verdict"] == "rollback", record
+        assert "quality drift" in record["reason"], record
+        assert record["canary"]["drift"] > 0.25, record
+        assert record["canary"]["count"] >= 2, record
+        # latency stayed inside the (generous) judge slack: the burn
+        # gate saw a healthy canary
+        assert (record["canary"]["burn_rate"] or 0.0) <= 1000.0
+        # ...rolled back onto its ORIGINAL command line, faults gone
+        assert fleet.replicas[1].argv == original_argv
+        env1 = fleet.replicas[1].env or {}
+        assert "DTX_FAULTS" not in env1
+        assert router.canary() == (None, 0.0)
+        assert len(fleet.replicas) == 2
+        # ZERO failed client requests through the whole dance
         bad = [(s, b) for s, b in results if s != 200]
         assert not bad, f"{len(bad)} failed requests, first: {bad[:3]}"
         assert len(results) >= 10

@@ -1,0 +1,724 @@
+"""Model-quality observability plane (obs/quality.py + the engine's
+in-step telemetry tail).
+
+Pinned here, against numpy oracles where the math matters:
+
+- the fixed-bin QuantileSketch/PSI/drift machinery (stdlib-only, no
+  device) — identical distributions score ~0, shifted ones cross the
+  0.25 canary budget, NaN observations are "no signal" (skipped,
+  never drift), thin evidence scores 0.0, and a mismatched bin ladder
+  fails loudly (inf drift / raise);
+- models/decode.py:quality_vector vs a numpy entropy/margin/repeat
+  oracle, including the fully-masked-row degradation contract;
+- the engine contract: telemetry OFF leaves outputs bit-identical
+  (and ``RequestOutput.quality`` None); telemetry ON changes no
+  token while populating per-request quality and the registry
+  series; the decode compile count stays pinned at 1 across mixed
+  constrained/sampled/plain traffic (RecompileSentinel budget 0);
+- the chaos drills: ``quality_drift@N`` moves the PSI score past the
+  budget in every family with zero failed requests (control greedy
+  tokens bit-unchanged), ``quality_nan@N`` degrades to "no signal"
+  without a crash or a drift false-positive;
+- EventLog size-based rotation (whole-line generations, atomic
+  cascade) and the report tools' ``{"record": "quality"}`` learning.
+"""
+
+import json
+import math
+import os
+import sys
+from functools import lru_cache
+from types import SimpleNamespace
+
+import importlib.util
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from differential_transformer_replication_tpu.analysis.sanitizers import (
+    RecompileSentinel,
+)
+from differential_transformer_replication_tpu.config import (
+    ModelConfig,
+    ServingConfig,
+)
+from differential_transformer_replication_tpu.models import init_model
+from differential_transformer_replication_tpu.models.decode import (
+    quality_vector,
+)
+from differential_transformer_replication_tpu.obs.events import (
+    EventLog,
+    NOOP_EVENTS,
+    open_event_log,
+)
+from differential_transformer_replication_tpu.obs.quality import (
+    ENTROPY_BINS,
+    FINGERPRINT_RECORD,
+    MARGIN_BINS,
+    MIN_DRIFT_COUNT,
+    QualityMonitor,
+    QuantileSketch,
+    build_quality_row,
+    drift_score,
+    fingerprint,
+    load_fingerprint,
+    psi,
+    save_fingerprint,
+)
+from differential_transformer_replication_tpu.serving import (
+    SamplingParams,
+    ServingEngine,
+)
+from differential_transformer_replication_tpu.utils import faults
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(TOOLS, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cfg(kind, vocab=61):
+    return ModelConfig(
+        model=kind, vocab_size=vocab, n_embd=32, n_head=2, n_layer=2,
+        block_size=32, dropout=0.0, n_terms=3, compute_dtype="float32",
+    )
+
+
+@lru_cache(maxsize=None)
+def _setup(kind, vocab=61):
+    cfg = _cfg(kind, vocab)
+    return cfg, init_model(jax.random.PRNGKey(0), cfg)
+
+
+def _prompts(lens, vocab, seed=1):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=L).tolist() for L in lens]
+
+
+def _serving(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("prefill_budget", 6)
+    kw.setdefault("quality_telemetry", True)
+    return ServingConfig(**kw)
+
+
+# ---------------------------------------------------------------------
+# QuantileSketch
+# ---------------------------------------------------------------------
+
+
+class TestQuantileSketch:
+    def test_bucketing_matches_numpy_searchsorted(self):
+        rng = np.random.default_rng(0)
+        vals = rng.uniform(-1.0, 30.0, size=500)
+        sk = QuantileSketch(MARGIN_BINS)
+        for v in vals:
+            assert sk.add(v)
+        # add() places v in the first bucket whose upper bound >= v
+        idx = np.searchsorted(np.asarray(MARGIN_BINS), vals, side="left")
+        expect = np.bincount(idx, minlength=len(MARGIN_BINS) + 1)
+        assert sk.counts == expect.tolist()
+        assert sk.total == 500
+        assert sk.mean() == pytest.approx(float(vals.mean()))
+
+    def test_non_finite_and_junk_skipped(self):
+        sk = QuantileSketch(ENTROPY_BINS)
+        assert sk.add(1.0)
+        for bad in (float("nan"), float("inf"), float("-inf"),
+                    None, "not-a-number"):
+            assert not sk.add(bad)
+        assert sk.total == 1
+        assert sk.mean() == pytest.approx(1.0)
+
+    def test_roundtrip_dict(self):
+        sk = QuantileSketch(ENTROPY_BINS)
+        for v in (0.01, 0.3, 2.0, 50.0):
+            sk.add(v)
+        back = QuantileSketch.from_dict(sk.to_dict())
+        assert back.counts == sk.counts
+        assert back.total == sk.total
+        assert back.mean() == pytest.approx(sk.mean())
+
+    def test_from_dict_validates_counts_length(self):
+        with pytest.raises(ValueError, match="does not match"):
+            QuantileSketch.from_dict({"bins": [1.0, 2.0], "counts": [1, 2]})
+
+    def test_bins_must_strictly_increase(self):
+        with pytest.raises(ValueError, match="strictly increasing"):
+            QuantileSketch((1.0, 1.0, 2.0))
+        with pytest.raises(ValueError, match="strictly increasing"):
+            QuantileSketch((2.0, 1.0))
+
+
+# ---------------------------------------------------------------------
+# PSI + drift score
+# ---------------------------------------------------------------------
+
+
+def _sketch_from(vals, bins=ENTROPY_BINS):
+    sk = QuantileSketch(bins)
+    for v in vals:
+        sk.add(v)
+    return sk
+
+
+class TestPsiAndDrift:
+    def test_identical_distributions_score_zero(self):
+        rng = np.random.default_rng(1)
+        vals = rng.uniform(0.0, 8.0, size=400)
+        assert psi(_sketch_from(vals), _sketch_from(vals)) == \
+            pytest.approx(0.0, abs=1e-12)
+
+    def test_shifted_distribution_crosses_canary_budget(self):
+        rng = np.random.default_rng(2)
+        ref = _sketch_from(rng.normal(4.5, 0.4, size=600))
+        live = _sketch_from(rng.normal(7.0, 0.4, size=600))
+        score = psi(ref, live)
+        assert score > 0.25  # the "shifted" knee / default budget
+        assert math.isfinite(score)
+
+    def test_psi_matches_numpy_oracle(self):
+        rng = np.random.default_rng(3)
+        ref = _sketch_from(rng.uniform(0, 10, size=300))
+        live = _sketch_from(rng.uniform(2, 12, size=250))
+        eps = 1e-4
+        p = (np.asarray(live.counts) + eps) / (live.total + len(live.counts) * eps)
+        q = (np.asarray(ref.counts) + eps) / (ref.total + len(ref.counts) * eps)
+        expect = float(np.sum((p - q) * np.log(p / q)))
+        assert psi(ref, live) == pytest.approx(expect, rel=1e-12)
+
+    def test_mismatched_ladder_raises(self):
+        with pytest.raises(ValueError, match="ladders differ"):
+            psi(QuantileSketch(ENTROPY_BINS), QuantileSketch(MARGIN_BINS))
+
+    def test_drift_no_reference_is_zero(self):
+        live = {"entropy": _sketch_from(np.full(100, 5.0))}
+        assert drift_score(None, live) == 0.0
+        assert drift_score({}, live) == 0.0
+
+    def test_drift_thin_evidence_is_zero(self):
+        ref = fingerprint({"entropy": _sketch_from(np.full(200, 1.0))})
+        live = {"entropy": _sketch_from(np.full(MIN_DRIFT_COUNT - 1, 9.0))}
+        assert drift_score(ref, live) == 0.0
+        # one more observation and the same shift becomes signal
+        live = {"entropy": _sketch_from(np.full(MIN_DRIFT_COUNT, 9.0))}
+        assert drift_score(ref, live) > 0.25
+
+    def test_drift_incompatible_ladder_is_inf(self):
+        ref = fingerprint({"entropy": _sketch_from(np.full(100, 1.0),
+                                                   bins=MARGIN_BINS)})
+        live = {"entropy": _sketch_from(np.full(100, 1.0))}
+        assert drift_score(ref, live) == math.inf
+
+    def test_drift_takes_worst_signal(self):
+        rng = np.random.default_rng(4)
+        base_e = rng.normal(4.0, 0.3, size=300)
+        base_m = rng.uniform(0.0, 2.0, size=300)
+        ref = fingerprint({
+            "entropy": _sketch_from(base_e),
+            "margin": _sketch_from(base_m, bins=MARGIN_BINS),
+        })
+        live = {
+            "entropy": _sketch_from(base_e),  # unmoved
+            "margin": _sketch_from(base_m + 10.0, bins=MARGIN_BINS),
+        }
+        score = drift_score(ref, live)
+        assert score > 0.25
+        assert score == pytest.approx(psi(
+            QuantileSketch.from_dict(ref["sketches"]["margin"]),
+            live["margin"],
+        ))
+
+
+class TestFingerprintIO:
+    def test_save_load_roundtrip(self, tmp_path):
+        rec = fingerprint(
+            {"entropy": _sketch_from([1.0, 2.0, 3.0])},
+            meta={"model": "control"},
+        )
+        path = str(tmp_path / "sub" / "fp.json")
+        save_fingerprint(path, rec)
+        assert not os.path.exists(path + ".tmp")  # atomic rename landed
+        back = load_fingerprint(path)
+        assert back["record"] == FINGERPRINT_RECORD
+        assert back["meta"] == {"model": "control"}
+        assert back["sketches"]["entropy"] == rec["sketches"]["entropy"]
+
+    def test_load_rejects_non_fingerprint(self, tmp_path):
+        path = tmp_path / "bogus.json"
+        path.write_text('{"record": "quality"}')
+        with pytest.raises(ValueError, match="not a quality fingerprint"):
+            load_fingerprint(str(path))
+
+
+class TestQualityMonitor:
+    def test_observe_and_no_signal_accounting(self):
+        mon = QualityMonitor()
+        mon.observe(2.0, 0.5)
+        mon.observe(float("nan"), 0.7)   # entropy skipped
+        mon.observe(3.0, float("inf"))   # margin skipped
+        s = mon.stats()
+        assert s["tokens_observed"] == 2
+        assert s["no_signal_observations"] == 2
+        assert s["entropy_mean"] == pytest.approx(2.5)
+        assert s["margin_mean"] == pytest.approx(0.6)
+        assert s["drift"] == 0.0  # no reference
+
+    def test_quality_row_shape(self):
+        mon = QualityMonitor()
+        for _ in range(3):
+            mon.observe(1.0, 2.0)
+        row = build_quality_row(mon, 7, lambdas={"lambda_l1": 0.123456789})
+        assert row["record"] == "quality"
+        assert row["iter"] == 7
+        assert row["entropy_mean"] == pytest.approx(1.0)
+        assert row["lambda_l1"] == pytest.approx(0.123457)  # rounded
+        assert json.loads(json.dumps(row)) == row  # JSONL-safe
+
+
+# ---------------------------------------------------------------------
+# quality_vector vs numpy oracle
+# ---------------------------------------------------------------------
+
+
+class TestQualityVector:
+    def _oracle(self, lp, proc, tokens, prev):
+        p = np.exp(lp)
+        plogp = np.where(np.isfinite(lp), p * lp, 0.0)
+        entropy = -plogp.sum(-1)
+        top2 = np.sort(proc, axis=-1)[..., ::-1][..., :2]
+        margin = top2[..., 0] - top2[..., 1]
+        repeat = ((tokens == prev) & (prev >= 0)).astype(np.float32)
+        return entropy, margin, repeat
+
+    def test_matches_oracle_2d(self):
+        rng = np.random.default_rng(5)
+        logits = rng.normal(0, 3, size=(6, 40)).astype(np.float32)
+        lp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+        tokens = jnp.asarray([0, 1, 2, 3, 4, 5], jnp.int32)
+        prev = jnp.asarray([0, 9, 2, -1, 4, 7], jnp.int32)
+        qv = np.asarray(jax.jit(quality_vector)(
+            lp, jnp.asarray(logits), tokens, prev
+        ))
+        assert qv.shape == (6, 3)
+        ent, mar, rep = self._oracle(
+            np.asarray(lp), logits, np.asarray(tokens), np.asarray(prev)
+        )
+        np.testing.assert_allclose(qv[:, 0], ent, rtol=1e-5)
+        np.testing.assert_allclose(qv[:, 1], mar, rtol=1e-5)
+        # prev=-1 means "no previous token": never a repeat, even when
+        # tokens coincidentally matches
+        np.testing.assert_array_equal(qv[:, 2], rep)
+        assert rep.tolist() == [1.0, 0.0, 1.0, 0.0, 1.0, 0.0]
+
+    def test_matches_oracle_3d_spec_shape(self):
+        rng = np.random.default_rng(6)
+        logits = rng.normal(0, 2, size=(3, 4, 17)).astype(np.float32)
+        lp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+        tokens = jnp.asarray(rng.integers(0, 17, size=(3, 4)), jnp.int32)
+        prev = jnp.asarray(rng.integers(-1, 17, size=(3, 4)), jnp.int32)
+        qv = np.asarray(quality_vector(lp, jnp.asarray(logits), tokens, prev))
+        assert qv.shape == (3, 4, 3)
+        ent, mar, rep = self._oracle(
+            np.asarray(lp), logits, np.asarray(tokens), np.asarray(prev)
+        )
+        np.testing.assert_allclose(qv[..., 0], ent, rtol=1e-5)
+        np.testing.assert_allclose(qv[..., 1], mar, rtol=1e-5)
+        np.testing.assert_array_equal(qv[..., 2], rep)
+
+    def test_single_allowed_token_degrades_not_crashes(self):
+        # a constraint mask that leaves ONE legal token: entropy is an
+        # exact 0 (the where() keeps 0 * -inf NaN out), margin is +inf
+        # (the host's sketch add() skips it as "no signal")
+        V = 8
+        proc = np.full((2, V), -np.inf, np.float32)
+        proc[:, 3] = 1.5
+        lp = jax.nn.log_softmax(jnp.asarray(proc), axis=-1)
+        qv = np.asarray(quality_vector(
+            lp, jnp.asarray(proc),
+            jnp.asarray([3, 3], jnp.int32), jnp.asarray([-1, 3], jnp.int32),
+        ))
+        np.testing.assert_array_equal(qv[:, 0], [0.0, 0.0])
+        assert np.isposinf(qv[:, 1]).all()
+        np.testing.assert_array_equal(qv[:, 2], [0.0, 1.0])
+        assert not QuantileSketch(MARGIN_BINS).add(float(qv[0, 1]))
+
+
+# ---------------------------------------------------------------------
+# engine telemetry
+# ---------------------------------------------------------------------
+
+
+class TestEngineQuality:
+    def test_off_by_default(self):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _serving(quality_telemetry=False))
+        (out,) = eng.generate(_prompts([5], cfg.vocab_size),
+                              max_new_tokens=4, temperature=0.0)
+        assert out.quality is None
+        assert eng.quality_stats() is None
+        assert eng.quality_fingerprint() is None
+        assert eng.quality_row() is None
+
+    def test_greedy_tokens_bit_identical_on_vs_off(self):
+        """The telemetry tail reads the step's arrays; it must never
+        change a token. Per-request quality rides the output when on."""
+        cfg, params = _setup("control")
+        prompts = _prompts([3, 9, 14, 6], cfg.vocab_size)
+        off = ServingEngine(params, cfg, _serving(quality_telemetry=False))
+        ref = off.generate(prompts, max_new_tokens=8, temperature=0.0)
+        on = ServingEngine(params, cfg, _serving())
+        outs = on.generate(prompts, max_new_tokens=8, temperature=0.0)
+        for a, b in zip(ref, outs):
+            assert a.tokens == b.tokens
+            assert a.quality is None
+            assert b.quality is not None
+            assert b.quality["tokens_observed"] == 8
+            assert math.isfinite(b.quality["entropy_mean"])
+            assert math.isfinite(b.quality["margin_mean"])
+            assert b.quality["rep_run_max"] >= 0
+        s = on.quality_stats()
+        assert s["tokens_observed"] == 8 * len(prompts)
+        assert s["no_signal_observations"] == 0
+        assert s["drift"] == 0.0
+        assert s["constraint_validity_rate"] == 1.0
+
+    @pytest.mark.slow
+    def test_sampled_tokens_bit_identical_on_vs_off(self):
+        cfg, params = _setup("control")
+        prompts = _prompts([4, 7, 11], cfg.vocab_size, seed=8)
+        kw = dict(max_new_tokens=6, temperature=1.0, top_k=5, seed=17)
+        off = ServingEngine(params, cfg, _serving(quality_telemetry=False))
+        on = ServingEngine(params, cfg, _serving())
+        for a, b in zip(off.generate(prompts, **kw),
+                        on.generate(prompts, **kw)):
+            assert a.tokens == b.tokens
+            assert b.quality["tokens_observed"] == 6
+
+    def test_registry_series_and_quality_row(self):
+        cfg, params = _setup("diff")
+        eng = ServingEngine(params, cfg, _serving())
+        eng.generate(_prompts([5, 8], cfg.vocab_size),
+                     max_new_tokens=6, temperature=0.0)
+        expo = eng.registry.render()
+        for name in ("serving_token_entropy", "serving_logit_margin",
+                     "serving_quality_drift", "serving_lambda_mean"):
+            assert name in expo, name
+        assert 'serving_lambda_mean{layer="1"}' in expo
+        s = eng.quality_stats()
+        # layer-1 lambda init schedule: 0.8 - 0.6*exp(0) = 0.2
+        assert s["lambda_l1"] == pytest.approx(0.2, abs=1e-6)
+        assert s["lambda_l2"] == pytest.approx(0.35551, abs=1e-4)
+        row = eng.quality_row()
+        assert row["record"] == "quality"
+        assert row["lambda_l1"] == pytest.approx(0.2, abs=1e-6)
+        assert json.loads(json.dumps(row)) == row
+
+    def test_constrained_run_length_and_validity(self):
+        """A forced-repetition constraint pins the host-side run-length
+        accumulator exactly, and the one-legal-token margin degrades to
+        "no signal" instead of poisoning the sketches."""
+        vocab = [chr(i) if 32 <= i < 127 else "" for i in range(128)]
+        cfg, params = _setup("control", vocab=128)
+        eng = ServingEngine(params, cfg, _serving(num_slots=4), vocab=vocab)
+        (out,) = eng.generate(
+            [_prompts([5], 128, seed=9)[0]],
+            params=[SamplingParams(max_new_tokens=12, temperature=0.0,
+                                   seed=0, regex="a{8}")],
+        )
+        assert out.tokens == [ord("a")] * 8
+        assert out.finish_reason == "constraint_complete"
+        # 8 identical tokens = 7 consecutive repeat flags
+        assert out.quality["rep_run_max"] == 7
+        assert out.quality["entropy_mean"] == pytest.approx(0.0, abs=1e-6)
+        s = eng.quality_stats()
+        assert s["constraint_validity_rate"] == 1.0
+        assert s["no_signal_observations"] > 0  # inf margins skipped
+
+    def test_decode_compile_pinned_with_quality_mixed_traffic(self):
+        """Quality telemetry rides the SAME jitted step: after one
+        warming batch, mixed constrained/sampled/plain traffic compiles
+        nothing new and the decode cache stays at one entry."""
+        vocab = [chr(i) if 32 <= i < 127 else "" for i in range(128)]
+        cfg, params = _setup("control", vocab=128)
+        eng = ServingEngine(params, cfg, _serving(num_slots=4), vocab=vocab)
+        warm = _prompts([4, 7, 5], 128, seed=10)
+        eng.generate(
+            warm,
+            params=[
+                SamplingParams(max_new_tokens=6, temperature=0.0, seed=0,
+                               regex="(ab|ba){1,4}"),
+                SamplingParams(max_new_tokens=6, temperature=1.0, top_k=5,
+                               seed=1),
+                SamplingParams(max_new_tokens=6, temperature=0.0, seed=2),
+            ],
+        )
+        baseline = eng.compile_stats()
+        assert baseline["decode"] == 1
+        with RecompileSentinel(budget=0, name="quality-mixed"):
+            outs = eng.generate(
+                _prompts([6, 3, 8, 5], 128, seed=11),
+                params=[
+                    SamplingParams(max_new_tokens=5, temperature=0.0,
+                                   seed=3, regex="[xy]{2,6}"),
+                    SamplingParams(max_new_tokens=5, temperature=1.0,
+                                   top_k=3, seed=4),
+                    SamplingParams(max_new_tokens=5, temperature=0.0,
+                                   seed=5),
+                    SamplingParams(max_new_tokens=5, temperature=0.7,
+                                   seed=6),
+                ],
+            )
+        assert len(outs) == 4
+        assert all(o.quality is not None for o in outs)
+        assert eng.compile_stats() == baseline
+
+    @pytest.mark.slow
+    def test_spec_engine_quality_parity_and_acceptance(self):
+        cfg, params = _setup("control")
+        prompts = _prompts([4, 9, 6], cfg.vocab_size, seed=12)
+        plain = ServingEngine(params, cfg, _serving())
+        ref = plain.generate(prompts, max_new_tokens=8, temperature=0.0)
+        spec = ServingEngine(
+            params, cfg, _serving(spec_mode="ngram", spec_draft_len=3)
+        )
+        outs = spec.generate(prompts, max_new_tokens=8, temperature=0.0)
+        for a, b in zip(ref, outs):
+            assert a.tokens == b.tokens  # spec greedy == non-spec greedy
+            assert b.quality is not None
+            assert b.quality["tokens_observed"] == 8
+            if "spec_acceptance" in b.quality:
+                assert 0.0 <= b.quality["spec_acceptance"] <= 1.0
+        s = spec.quality_stats()
+        assert s["tokens_observed"] == 8 * len(prompts)
+        if spec.stats["spec_proposed"]:
+            assert 0.0 <= s["spec_acceptance_rate"] <= 1.0
+
+    def test_quality_nan_fault_degrades_to_no_signal(self):
+        cfg, params = _setup("control")
+        faults.arm("quality_nan@1")
+        eng = ServingEngine(params, cfg, _serving())
+        outs = eng.generate(_prompts([3, 6], cfg.vocab_size, seed=13),
+                            max_new_tokens=6, temperature=0.0)
+        assert all(o.finish_reason == "length" for o in outs)
+        s = eng.quality_stats()
+        assert s["no_signal_observations"] > 0
+        assert s["drift"] == 0.0  # poisoned telemetry is not drift
+        assert s["tokens_observed"] < 12  # the NaN iteration was skipped
+
+    @pytest.mark.parametrize("kind", [
+        "control",
+        pytest.param("diff", marks=pytest.mark.slow),
+        pytest.param("ndiff", marks=pytest.mark.slow),
+    ])
+    def test_quality_drift_fault_trips_fingerprint(self, kind, tmp_path):
+        """The silent-drift chaos drill: requests keep finishing, greedy
+        control tokens stay bit-identical (argmax-preserving rescale),
+        and ONLY the PSI score vs the recorded fingerprint convicts —
+        past the 0.25 default canary budget in every family."""
+        cfg, params = _setup(kind)
+        prompts = _prompts([3, 9, 14, 6, 11, 7], cfg.vocab_size)
+        clean = ServingEngine(params, cfg, _serving())
+        ref = clean.generate(prompts, max_new_tokens=8, temperature=0.0)
+        assert clean.quality_stats()["tokens_observed"] >= MIN_DRIFT_COUNT
+        fp = str(tmp_path / "fp.json")
+        save_fingerprint(fp, clean.quality_fingerprint(
+            meta={"model": kind}
+        ))
+
+        faults.arm("quality_drift@1")
+        eng = ServingEngine(params, cfg, _serving(quality_fingerprint=fp))
+        outs = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+        assert all(o.finish_reason == "length" for o in outs)
+        s = eng.quality_stats()
+        assert s["drift"] > 0.25, s
+        assert math.isfinite(s["drift"])
+        expo = eng.registry.render()
+        assert "serving_quality_drift" in expo
+        if kind == "control":
+            # lm_head rescale preserves the argmax: same greedy tokens
+            for a, b in zip(ref, outs):
+                assert a.tokens == b.tokens
+        elif kind == "diff":
+            # the λ collapse is the fault's visible gauge signature
+            assert s["lambda_l1"] > 1.0
+        else:
+            # ndiff's layer mean cancels (t0 +δ, t1 -δ via the shared
+            # subtracted exponential); the per-term row shows the shift
+            assert s["lambda_l1_t0"] > 1.0
+
+    def test_fingerprint_survives_engine_roundtrip(self, tmp_path):
+        cfg, params = _setup("control")
+        eng = ServingEngine(params, cfg, _serving())
+        eng.generate(_prompts([5, 8, 12], cfg.vocab_size, seed=14),
+                     max_new_tokens=8, temperature=0.0)
+        fp = str(tmp_path / "fp.json")
+        save_fingerprint(fp, eng.quality_fingerprint(meta={"m": 1}))
+        # identical traffic against its own fingerprint: drift ~ 0
+        again = ServingEngine(params, cfg, _serving(quality_fingerprint=fp))
+        again.generate(_prompts([5, 8, 12], cfg.vocab_size, seed=14),
+                       max_new_tokens=8, temperature=0.0)
+        assert again.quality_stats()["drift"] == pytest.approx(0.0, abs=1e-9)
+
+
+# ---------------------------------------------------------------------
+# EventLog rotation
+# ---------------------------------------------------------------------
+
+
+def _lines(path):
+    with open(path) as fh:
+        return [json.loads(ln) for ln in fh.read().splitlines() if ln]
+
+
+class TestEventLogRotation:
+    def test_no_rotation_by_default(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path, process="t", flush_every=1)
+        for i in range(50):
+            log.emit("tick", i=i)
+        log.close()
+        assert len(_lines(path)) == 50
+        assert not os.path.exists(path + ".1")
+
+    def test_rotation_cascade_whole_lines(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path, process="t", flush_every=1,
+                       max_bytes=256, keep=2)
+        for i in range(60):
+            log.emit("tick", i=i, pad="x" * 16)
+        log.close()
+        assert os.path.exists(path + ".1")
+        assert os.path.exists(path + ".2")
+        assert not os.path.exists(path + ".3")  # oldest fell off
+        seen = []
+        for p in (path + ".2", path + ".1", path):
+            recs = _lines(p)  # every generation parses whole-line clean
+            assert all(r["event"] == "tick" for r in recs)
+            seen.extend(r["i"] for r in recs)
+        # the retained tail is contiguous and ends at the last emit
+        assert seen == list(range(seen[0], 60))
+
+    def test_keep_zero_truncates(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path, flush_every=1, max_bytes=128, keep=0)
+        for i in range(40):
+            log.emit("tick", i=i)
+        log.close()
+        assert not os.path.exists(path + ".1")
+        recs = _lines(path)  # only the newest tail survives
+        assert len(recs) < 40
+        assert recs[-1]["i"] == 39 if recs else True
+
+    def test_rotation_batches_flush_boundary(self, tmp_path):
+        # flush_every > 1: rotation happens only at flush boundaries,
+        # so a burst smaller than the buffer never splits mid-line
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path, flush_every=8, max_bytes=64, keep=3)
+        for i in range(8):
+            log.emit("tick", i=i)
+        log.close()
+        total = sum(
+            len(_lines(p)) for p in
+            (path, path + ".1", path + ".2", path + ".3")
+            if os.path.exists(p)
+        )
+        assert total == 8
+
+    def test_invalid_params_raise(self, tmp_path):
+        with pytest.raises(ValueError):
+            EventLog(str(tmp_path / "e.jsonl"), max_bytes=-1)
+        with pytest.raises(ValueError):
+            EventLog(str(tmp_path / "e.jsonl"), keep=-1)
+
+    def test_open_event_log_passthrough(self, tmp_path):
+        assert open_event_log(None) is NOOP_EVENTS
+        log = open_event_log(str(tmp_path / "e.jsonl"), process="x",
+                             max_bytes=1024, keep=5)
+        assert log.max_bytes == 1024
+        assert log.keep == 5
+        log.close()
+
+
+# ---------------------------------------------------------------------
+# report tools learn {"record": "quality"} rows
+# ---------------------------------------------------------------------
+
+
+def _check_args(**kw):
+    base = dict(require_loss_decrease=False, max_stall_frac=0.9,
+                max_skipped=0, max_rollbacks=0, max_compile_events=0,
+                max_capture_failures=0, max_drift=0.0)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+class TestReportToolsQuality:
+    def _stream(self, tmp_path, drifts):
+        path = tmp_path / "metrics.jsonl"
+        rows = [
+            {"record": "run_header", "config_hash": "abc"},
+            {"loss": 3.0, "step_time_ms": 10.0},
+            {"loss": 2.5, "step_time_ms": 10.0},
+        ]
+        for i, d in enumerate(drifts):
+            rows.append({
+                "record": "quality", "iter": 10 * (i + 1),
+                "entropy_mean": 4.0 + i, "margin_mean": 0.5,
+                "drift": d, "lambda_l1": 0.2 + i * 0.01,
+                "lambda_init_l1": 0.2,
+            })
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        return str(path)
+
+    def test_metrics_report_summarizes_and_gates_drift(self, tmp_path):
+        mr = _load_tool("metrics_report")
+        path = self._stream(tmp_path, [0.05, 0.31, float("nan")])
+        summary = mr.summarize(mr.load(path))
+        assert summary["quality_records"] == 3
+        assert summary["quality_drift_max"] == pytest.approx(0.31)
+        assert summary["quality_entropy_mean_last"] == pytest.approx(6.0)
+        assert "quality" not in summary.get("unknown_records", {})
+        assert mr.check(summary, _check_args()) == []  # gate off
+        bad = mr.check(summary, _check_args(max_drift=0.25))
+        assert any("quality drift" in b for b in bad)
+        assert mr.check(summary, _check_args(max_drift=0.5)) == []
+
+    def test_lambda_report_serving_rows_need_flag(self, tmp_path):
+        lr = _load_tool("lambda_report")
+        path = self._stream(tmp_path, [0.01, 0.02])
+        series, inits = lr.load_series(path)  # default: training rows only
+        assert series == {}
+        series, inits = lr.load_series(
+            path, records=("introspection", "quality")
+        )
+        assert (1, None) in series
+        assert [v for _, v in sorted(series[(1, None)])] == \
+            pytest.approx([0.2, 0.21])
+        assert inits[(1, None)] == pytest.approx(0.2)
+        # mixed stream: a training introspection row rides alongside
+        with open(path, "a") as fh:
+            fh.write(json.dumps({
+                "record": "introspection", "iter": 5, "lambda_l1": 0.19,
+            }) + "\n")
+        series, _ = lr.load_series(
+            path, records=("introspection", "quality")
+        )
+        assert len(series[(1, None)]) == 3

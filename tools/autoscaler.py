@@ -25,7 +25,13 @@ checkpoint no longer needs a human:
   with the same burn math tools/slo_report.py uses and the same
   regression slack tools/perf_gate.py uses (``gate_key`` on windowed
   p95 TTFT, canary vs control), then promote or roll back to the
-  exact previous argv/env — unattended.
+  exact previous argv/env — unattended. When the fleet runs
+  ``--quality-telemetry`` the judge gains a MODEL-QUALITY axis
+  (obs/quality.py): a canary whose ``serving_quality_drift`` exceeds
+  ``canary_max_drift`` or whose constraint validity falls
+  ``canary_max_validity_delta`` below control rolls back even when
+  every latency gate passes — a perturbed λ or a bad quantization
+  scale moves token distributions, not p95.
 
 Every decision is a typed, reasoned JSONL event (obs/events.py) and a
 registry metric (``autoscaler_*``). Decisions are BIT-REPRODUCIBLE:
@@ -537,14 +543,37 @@ def histogram_quantile(bounds: Sequence[float],
     return math.inf
 
 
+def _sum_samples(samples, name: str) -> Optional[float]:
+    """Sum of all samples with this exact name (label children and
+    per-replica gauged samples collapse); None when absent."""
+    vals = [v for n, _, v in samples if n == name]
+    return sum(vals) if vals else None
+
+
+def _gauge_values(samples, name: str) -> List[float]:
+    return [v for n, _, v in samples if n == name]
+
+
 def window_stats(pairs: Sequence[Tuple[str, str]],
                  ttft_threshold_s: float, slo_target: float) -> dict:
-    """TTFT stats over a canary window from (before, after) exposition
-    snapshots of one or more replicas: delta the cumulative buckets
-    per bound (restart-safe: a counter that stepped backwards clamps
-    to zero), sum across replicas, then judge the window alone."""
+    """TTFT + quality stats over a canary window from (before, after)
+    exposition snapshots of one or more replicas: delta the cumulative
+    buckets per bound (restart-safe: a counter that stepped backwards
+    clamps to zero), sum across replicas, then judge the window alone.
+
+    Quality keys (obs/quality.py; all None when the replicas do not
+    run ``--quality-telemetry``): ``entropy_mean`` / ``margin_mean``
+    are windowed means from the serving_token_entropy /
+    serving_logit_margin histograms' ``_sum``/``_count`` deltas;
+    ``drift`` is the WORST (max) finite ``serving_quality_drift``
+    gauge in the after bodies (gauges are levels, not counters — the
+    after snapshot IS the window's verdict); ``validity`` is the
+    worst (min) ``serving_constraint_validity_rate``."""
     by_bound: Dict[float, float] = {}
     total = 0.0
+    q_sums = {"entropy": [0.0, 0.0], "margin": [0.0, 0.0]}
+    drift: Optional[float] = None
+    validity: Optional[float] = None
     for before, after in pairs:
         _, s0 = parse_exposition(before or "")
         _, s1 = parse_exposition(after or "")
@@ -555,6 +584,24 @@ def window_stats(pairs: Sequence[Tuple[str, str]],
             by_bound[b] = by_bound.get(b, 0.0) \
                 + max(0.0, c - prev.get(b, 0.0))
         total += max(0.0, n1 - n0)
+        for key, hist in (("entropy", "serving_token_entropy"),
+                          ("margin", "serving_logit_margin")):
+            sum1 = _sum_samples(s1, f"{hist}_sum")
+            cnt1 = _sum_samples(s1, f"{hist}_count")
+            if sum1 is None or cnt1 is None:
+                continue
+            sum0 = _sum_samples(s0, f"{hist}_sum") or 0.0
+            cnt0 = _sum_samples(s0, f"{hist}_count") or 0.0
+            q_sums[key][0] += max(0.0, sum1 - sum0)
+            q_sums[key][1] += max(0.0, cnt1 - cnt0)
+        for v in _gauge_values(s1, "serving_quality_drift"):
+            if math.isfinite(v):
+                drift = v if drift is None else max(drift, v)
+            elif not math.isnan(v):  # inf = incompatible fingerprint
+                drift = v
+        for v in _gauge_values(s1, "serving_constraint_validity_rate"):
+            if math.isfinite(v):
+                validity = v if validity is None else min(validity, v)
     bounds = sorted(by_bound)
     cumulative = [by_bound[b] for b in bounds]
     good = good_count_under(bounds, cumulative, ttft_threshold_s)
@@ -566,6 +613,12 @@ def window_stats(pairs: Sequence[Tuple[str, str]],
         "target": slo_target,
         "p95_ttft_s": histogram_quantile(bounds, cumulative, total,
                                          0.95),
+        "entropy_mean": (q_sums["entropy"][0] / q_sums["entropy"][1]
+                         if q_sums["entropy"][1] else None),
+        "margin_mean": (q_sums["margin"][0] / q_sums["margin"][1]
+                        if q_sums["margin"][1] else None),
+        "drift": drift,
+        "validity": validity,
     }
 
 
@@ -615,8 +668,35 @@ def judge_canary(canary: dict, control: dict,
                 f"canary p95 TTFT {c_p95:.3f}s regressed past control "
                 f"{ctl_p95:.3f}s + {cfg.canary_max_regress:.0%} slack"
             )
+    # -- quality axis (obs/quality.py) -----------------------------------
+    # A canary can be latency-flat and still WRONG: a perturbed λ or a
+    # bad int8 scale moves the token-quality distributions, not p95.
+    # None = the fleet does not run quality telemetry (gates pass, not
+    # fail-closed: quality is opt-in); NaN never reaches here (the
+    # drift gauge's "no signal" degradation is 0.0).
+    drift = canary.get("drift")
+    if (cfg.canary_max_drift > 0 and drift is not None
+            and not math.isnan(drift) and drift > cfg.canary_max_drift):
+        return "rollback", (
+            f"canary quality drift {drift:.3f} past budget "
+            f"{cfg.canary_max_drift:.3f} (PSI vs reference "
+            "fingerprint) — latency alone would have promoted"
+        )
+    c_validity = canary.get("validity")
+    if cfg.canary_max_validity_delta > 0 and c_validity is not None:
+        ctl_validity = control.get("validity")
+        base = (ctl_validity
+                if ctl_validity is not None
+                and math.isfinite(ctl_validity) else 1.0)
+        if base - c_validity > cfg.canary_max_validity_delta:
+            return "rollback", (
+                f"canary constraint validity {c_validity:.3f} fell "
+                f"more than {cfg.canary_max_validity_delta:.3f} below "
+                f"control {base:.3f} — latency alone would have "
+                "promoted"
+            )
     return "promote", (
-        "canary inside burn and latency budgets over "
+        "canary inside burn, latency, and quality budgets over "
         f"{canary['count']:.0f}-request window"
     )
 
@@ -759,6 +839,16 @@ def main() -> int:
     p.add_argument("--itl", type=float, default=0.25)
     p.add_argument("--target", type=float, default=0.99)
     p.add_argument("--stale-after", type=float, default=5.0)
+    p.add_argument("--canary-max-drift", type=float, default=0.25,
+                   help="canary judge rolls back when the canary's "
+                        "serving_quality_drift (PSI vs reference "
+                        "fingerprint) exceeds this; 0 = quality drift "
+                        "gate off")
+    p.add_argument("--canary-max-validity-delta", type=float,
+                   default=0.05,
+                   help="canary judge rolls back when the canary's "
+                        "constraint validity rate falls this far "
+                        "below control's; 0 = gate off")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--router-port", type=int, default=8000)
     p.add_argument("--record", default=None,
@@ -784,6 +874,8 @@ def main() -> int:
         itl_threshold_s=args.itl,
         slo_target=args.target,
         stale_after_s=args.stale_after,
+        canary_max_drift=args.canary_max_drift,
+        canary_max_validity_delta=args.canary_max_validity_delta,
     )
 
     if args.replay:

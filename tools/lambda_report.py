@@ -16,6 +16,12 @@ control runs carry no lambdas (the tool says so and exits 0 — absence
 is the expected answer there, not an error). With matplotlib missing
 (or ``--ascii``) the series print as a text table instead, so the tool
 works on bare metal.
+
+``--serving`` additionally accepts the SERVING engine's
+``{"record": "quality", ...}`` rows (obs/quality.py:quality_row, same
+``lambda_l<k>`` / ``lambda_l<k>_t<j>`` key schema), so a live fleet's
+λ view renders beside — or instead of — training introspection rows
+from one stream with one flag.
 """
 
 from __future__ import annotations
@@ -29,9 +35,12 @@ from collections import defaultdict
 _LAMBDA_KEY = re.compile(r"^lambda_l(\d+)(?:_t(\d+))?$")
 
 
-def load_series(path: str):
+def load_series(path: str, records: tuple = ("introspection",)):
     """{(layer, term|None): [(iter, value), ...]} plus the init values
-    {(layer, term|None): lambda_init}; term is None for diff runs."""
+    {(layer, term|None): lambda_init}; term is None for diff runs.
+    ``records`` selects which record kinds contribute rows — the
+    ``--serving`` flag adds the engine's ``"quality"`` rows, which
+    share the lambda key schema (obs/quality.py:quality_row)."""
     series = defaultdict(list)
     inits = {}
     with open(path) as fh:
@@ -43,7 +52,7 @@ def load_series(path: str):
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue  # torn tail line from a killed run
-            if rec.get("record") != "introspection":
+            if rec.get("record") not in records:
                 continue
             it = rec.get("iter", 0)
             for key, val in rec.items():
@@ -112,14 +121,22 @@ def main() -> int:
                    help="output PNG path (default: <metrics>.lambda.png)")
     p.add_argument("--ascii", action="store_true",
                    help="print a text table instead of writing a PNG")
+    p.add_argument("--serving", action="store_true",
+                   help='also render the serving engine\'s {"record": '
+                        '"quality"} λ rows (obs/quality.py; shared '
+                        "lambda_l<k> schema) beside training ones")
     args = p.parse_args()
 
-    series, inits = load_series(args.metrics)
+    records = ("introspection", "quality") if args.serving \
+        else ("introspection",)
+    series, inits = load_series(args.metrics, records=records)
     if not series:
         print(
             "no lambda records found — a control-family run logs none "
             "(no differential attention), or the run predates the "
             "introspection records (obs/introspect.py)"
+            + ("" if args.serving
+               else "; serving quality rows need --serving")
         )
         return 0
     if args.ascii:

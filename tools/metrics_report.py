@@ -38,6 +38,7 @@ def _percentile(xs, q):
 
 def load(path: str) -> dict:
     headers, steps, evals, intro, device = [], [], [], [], []
+    quality = []
     unknown: dict = {}
     with open(path) as fh:
         for line in fh:
@@ -56,6 +57,10 @@ def load(path: str) -> dict:
             elif kind == "device_profile":
                 # the continuous profiler's rows (obs/device_profile.py)
                 device.append(rec)
+            elif kind == "quality":
+                # serving-side model-quality rows (obs/quality.py:
+                # quality_row — entropy/margin means, PSI drift, λ)
+                quality.append(rec)
             elif kind is not None:
                 # typed records this tool does not understand are
                 # COUNTED, not silently dropped — a new record type
@@ -66,7 +71,8 @@ def load(path: str) -> dict:
             elif "loss" in rec:
                 steps.append(rec)
     return {"headers": headers, "steps": steps, "evals": evals,
-            "intro": intro, "device": device, "unknown": unknown}
+            "intro": intro, "device": device, "quality": quality,
+            "unknown": unknown}
 
 
 def summarize(recs: dict) -> dict:
@@ -138,6 +144,21 @@ def summarize(recs: dict) -> dict:
             fails[-1] if fails
             else sum(1 for r in device if "error" in r)
         )
+    quality = recs.get("quality", [])
+    if quality:
+        out["quality_records"] = len(quality)
+        drifts = [
+            r["drift"] for r in quality
+            if isinstance(r.get("drift"), (int, float))
+            and not math.isnan(r["drift"])
+        ]
+        if drifts:
+            out["quality_drift_max"] = round(max(drifts), 6)
+        for key in ("entropy_mean", "margin_mean"):
+            vals = [r[key] for r in quality
+                    if isinstance(r.get(key), (int, float))]
+            if vals:
+                out[f"quality_{key}_last"] = vals[-1]
     if recs.get("unknown"):
         out["unknown_records"] = recs["unknown"]
     return out
@@ -187,6 +208,13 @@ def check(summary: dict, args) -> list:
             f"profile capture failures > {args.max_capture_failures} "
             "(the continuous profiler is not landing its samples)"
         )
+    max_drift = getattr(args, "max_drift", 0.0)
+    if max_drift and summary.get("quality_drift_max", 0.0) > max_drift:
+        bad.append(
+            f"quality drift {summary['quality_drift_max']} > "
+            f"{max_drift} (PSI vs reference fingerprint; "
+            "obs/quality.py)"
+        )
     return bad
 
 
@@ -215,6 +243,10 @@ def main() -> int:
                    help="gate: device-profile capture-failure budget "
                         "(obs/device_profile.py; applies only when the "
                         "stream carries device_profile records)")
+    p.add_argument("--max-drift", type=float, default=0.0,
+                   help="gate: quality-drift ceiling over the stream's "
+                        '{"record": "quality"} rows (PSI vs reference '
+                        "fingerprint, obs/quality.py; 0 = gate off)")
     args = p.parse_args()
 
     path = args.from_jsonl or args.metrics

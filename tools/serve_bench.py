@@ -939,6 +939,147 @@ def _run_spec_ab(args, params, model_cfg, serving) -> None:
     )
 
 
+def _run_quality_ab(args, params, model_cfg, serving) -> None:
+    """``--quality-ab`` workload: the SAME closed-loop load run twice —
+    telemetry OFF, then telemetry ON — against fresh engines at
+    otherwise identical config, reported as one JSON line. Measures
+    the acceptance criterion directly: ``quality_overhead_pct`` (the
+    tok/s cost of the in-step quality tail; budget < 3% on smoke) and
+    ``compiles_in_window`` (the quality arm's zero-recompile pin).
+    Greedy traffic keeps the on arm bit-identical to the off arm —
+    asserted token-for-token: the telemetry columns are APPENDED to
+    the packed step outputs, never read by the sampling path."""
+    import jax  # noqa: F401  (engine stack below pulls it in anyway)
+
+    from differential_transformer_replication_tpu.analysis.sanitizers import (
+        RecompileSentinel,
+    )
+    from differential_transformer_replication_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    max_prompt = min(args.max_prompt,
+                     model_cfg.block_size - args.new_tokens - 1)
+    min_prompt = max(1, min(args.min_prompt, max_prompt))
+    prompts = [
+        rng.integers(
+            0, model_cfg.vocab_size,
+            size=int(rng.integers(min_prompt, max_prompt + 1)),
+        ).tolist()
+        for _ in range(args.requests)
+    ]
+
+    def _workload(client):
+        return spec_workload(client, prompts, args.new_tokens,
+                             args.clients, args.seed, args.temperature)
+
+    def _mk_arm(quality_on):
+        cfg_arm = serving.replace(
+            quality_telemetry=quality_on,
+            quality_fingerprint=(args.quality_fingerprint or ""
+                                 if quality_on else ""),
+        )
+        warm = ServingClient(ServingEngine(params, model_cfg, cfg_arm))
+        _workload(warm)
+        warm.close()
+        engine = ServingEngine(params, model_cfg, cfg_arm)
+        client = ServingClient(engine)
+        sentinel = RecompileSentinel(
+            budget=(None if args.allow_recompiles < 0
+                    else args.allow_recompiles),
+            name=f"serve-bench-quality-{'on' if quality_on else 'off'}"
+                 "-window",
+        )
+        return engine, client, sentinel
+
+    # the two arms ALTERNATE timed passes (best-of-3 per arm): the
+    # comparison is percent-level, so a background-load burst during
+    # one sequential arm would swing the verdict by tens of percent —
+    # alternating lands any burst on both arms, and the per-arm best
+    # pass is the least-disturbed measurement of each
+    arms = {q: _mk_arm(q) for q in (False, True)}
+    best = {False: None, True: None}
+    first_toks = {}
+    compiles = {False: 0, True: 0}
+    for _ in range(3):
+        for quality_on in (False, True):
+            _, client, sentinel = arms[quality_on]
+            with sentinel:
+                wall, out_tokens, toks = _workload(client)
+            compiles[quality_on] = max(compiles[quality_on],
+                                       sentinel.count)
+            first_toks.setdefault(quality_on, toks)
+            if best[quality_on] is None or wall < best[quality_on][0]:
+                best[quality_on] = (wall, out_tokens)
+    on_engine = arms[True][0]
+    q_stats = on_engine.quality_stats()
+    if args.quality_record:
+        from differential_transformer_replication_tpu.obs.quality import (
+            save_fingerprint,
+        )
+
+        save_fingerprint(
+            args.quality_record,
+            on_engine.quality_fingerprint(
+                meta={"model": model_cfg.model, "bench": "serve_bench"}
+            ),
+        )
+    for _, client, _ in arms.values():
+        client.close()
+    off_wall, off_tokens = best[False]
+    on_wall, on_tokens = best[True]
+    off_toks, on_toks = first_toks[False], first_toks[True]
+    off_compiles, on_compiles = compiles[False], compiles[True]
+    if args.temperature <= 0:
+        # telemetry must be a pure OBSERVER: greedy outputs bit-match
+        assert off_toks == on_toks, (
+            "greedy output diverged with quality telemetry on — the "
+            "telemetry tail is supposed to observe, not perturb"
+        )
+    off_tps = off_tokens / off_wall
+    on_tps = on_tokens / on_wall
+    line = {
+        "metric": "serving_quality_overhead_pct",
+        "value": round((1.0 - on_tps / off_tps) * 100.0, 2)
+        if off_tps else None,
+        "unit": "percent",
+        "quality_tok_per_s": round(on_tps, 1),
+        "baseline_tok_per_s": round(off_tps, 1),
+        "quality_overhead_pct": round((1.0 - on_tps / off_tps) * 100.0,
+                                      2) if off_tps else None,
+        "quality": q_stats,
+        "compiles_in_window": on_compiles,
+        "baseline_compiles_in_window": off_compiles,
+        "greedy_bit_identical": args.temperature <= 0,
+        "quality_fingerprint": args.quality_fingerprint,
+        "quality_record": args.quality_record,
+        "n_requests": len(prompts),
+        "output_tokens": on_tokens,
+        "wall_s": round(on_wall, 3),
+        "model": model_cfg.model,
+        "num_slots": serving.num_slots,
+        "clients": args.clients,
+        "new_tokens": args.new_tokens,
+        "temperature": args.temperature,
+        "prompt_len_range": [min_prompt, max_prompt],
+        "smoke": bool(args.smoke),
+    }
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    print(
+        f"[serve_bench] quality A/B off={off_tps:.1f} tok/s "
+        f"on={on_tps:.1f} tok/s "
+        f"overhead={line['quality_overhead_pct']}% "
+        f"drift={q_stats.get('drift') if q_stats else None} "
+        f"compiles={on_compiles}",
+        file=sys.stderr,
+    )
+
+
 _CONSTRAINT_SPECS = {
     # every canned spec is BOUNDED (no unbounded repetition), so each
     # constrained request reaches an accepting terminal state well
@@ -1249,6 +1390,28 @@ def main() -> None:
                         "large sizes, so the A/B reports "
                         "greedy_token_match_rate instead of "
                         "asserting)")
+    p.add_argument("--quality", action="store_true",
+                   help="run the in-process engine with model-quality "
+                        "telemetry (obs/quality.py): the JSON line "
+                        "gains a 'quality' block — mean token entropy "
+                        "/ logit margin, drift vs --quality-"
+                        "fingerprint, constraint validity")
+    p.add_argument("--quality-ab", action="store_true",
+                   help="quality-telemetry A/B: the SAME closed-loop "
+                        "load with telemetry off then on, against "
+                        "fresh engines; reports quality_overhead_pct "
+                        "(the in-step telemetry tail's tok/s cost), "
+                        "per-arm compiles_in_window, and asserts "
+                        "greedy bit-parity between arms. In-process "
+                        "only")
+    p.add_argument("--quality-fingerprint", default=None,
+                   help="reference quality fingerprint JSON to score "
+                        "live drift against (recorded earlier with "
+                        "--quality-record)")
+    p.add_argument("--quality-record", default=None,
+                   help="write the run's quality fingerprint to this "
+                        "path after the measured window (implies "
+                        "--quality)")
     p.add_argument("--priority-mix", default=None, metavar="CLS:N,...",
                    help="priority-class workload mix, e.g. "
                         "'high:8,batch:56': run exactly N requests of "
@@ -1361,6 +1524,12 @@ def main() -> None:
             args.requests, args.clients = 8, 4
             args.max_prompt, args.new_tokens = 10, 24
             args.temperature = 0.0
+        if args.quality_ab:
+            # quality smoke: the A/B measures a per-token overhead, so
+            # the timed window must be long enough that one scheduler
+            # hiccup can't swamp it (the default 64-token smoke window
+            # is ~40 ms — pure noise for a percent-level comparison)
+            args.requests, args.new_tokens = 64, 24
         if args.constrained:
             # constrained smoke: the char vocab must cover printable
             # ASCII (the JSON spec needs '{' = 0x7b), and the token
@@ -1394,6 +1563,20 @@ def main() -> None:
             "--priority-mix drives the in-process engine (per-class "
             "latency needs the engine's own attribution, not a remote "
             "fleet's)"
+        )
+    if args.quality_record:
+        args.quality = True
+    if (args.quality or args.quality_ab) and args.target:
+        raise SystemExit(
+            "--quality/--quality-ab drive the in-process engine "
+            "(they read engine.quality_stats() directly; against a "
+            "fleet use --quality-telemetry on the servers and "
+            "tools/slo_report.py)"
+        )
+    if args.quality_ab and args.http:
+        raise SystemExit(
+            "--quality-ab is an in-process A/B bench (it builds both "
+            "engines and compares their outputs token-for-token)"
         )
     if args.working_set_mult:
         if args.target or args.http:
@@ -1493,6 +1676,8 @@ def main() -> None:
         # plus new_tokens always fits (the diff family ignores this and
         # stays hard-capped at block_size)
         max_seq_len=model_cfg.block_size + args.new_tokens,
+        quality_telemetry=bool(args.quality),
+        quality_fingerprint=args.quality_fingerprint or "",
     )
     tracer = None
     if args.trace_dir:
@@ -1511,6 +1696,9 @@ def main() -> None:
         return
     if args.spec:
         _run_spec_ab(args, params, model_cfg, serving)
+        return
+    if args.quality_ab:
+        _run_quality_ab(args, params, model_cfg, serving)
         return
 
     engine = ServingEngine(params, model_cfg, serving, tracer=tracer)
@@ -1891,6 +2079,23 @@ def main() -> None:
         line["working_set_mult"] = args.working_set_mult
         line["working_set_prefixes"] = ws_prefixes
         line["kv_pages"] = engine.page_stats()
+    if args.quality:
+        # engine-side model-quality view (obs/quality.py): means over
+        # every finite per-token signal, PSI drift vs the reference
+        # fingerprint when one was given, validity + λ summary
+        line["quality"] = engine.quality_stats()
+        if args.quality_record:
+            from differential_transformer_replication_tpu.obs.quality import (
+                save_fingerprint,
+            )
+
+            save_fingerprint(
+                args.quality_record,
+                engine.quality_fingerprint(
+                    meta={"model": model_cfg.model, "bench": "serve_bench"}
+                ),
+            )
+            line["quality_record"] = args.quality_record
     print(json.dumps(line))
     if args.out:
         with open(args.out, "a") as f:

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import urllib.request
 
@@ -147,6 +148,37 @@ def report_from_exposition(text: str, args) -> dict:
         live[key] = v
     if live:
         out["server_reported_burn_rates"] = live
+    # model-quality plane (obs/quality.py; replicas running
+    # --quality-telemetry): PSI drift gauges keep per-replica identity
+    # on a fleet body — drift_max is what --max-drift gates on —
+    # entropy/margin means come from the cumulative histograms, and
+    # validity is the WORST replica's constraint validity rate
+    drifts = {}
+    validity = None
+    for n, labels, v in samples:
+        if n == "serving_quality_drift":
+            if labels.get("replica") in stale:
+                continue
+            drifts[labels.get("replica", "local")] = v
+        elif n == "serving_constraint_validity_rate":
+            if labels.get("replica") in stale:
+                continue
+            if math.isfinite(v):
+                validity = v if validity is None else min(validity, v)
+    if drifts:
+        finite = [v for v in drifts.values() if not math.isnan(v)]
+        quality = {
+            "drift": {r: round(v, 6) for r, v in sorted(drifts.items())},
+            "drift_max": max(finite) if finite else None,
+        }
+        for key, hist in (("entropy_mean", "serving_token_entropy"),
+                          ("margin_mean", "serving_logit_margin")):
+            s = _counter_value(samples, f"{hist}_sum")
+            c = _counter_value(samples, f"{hist}_count")
+            quality[key] = round(s / c, 6) if c else None
+        if validity is not None:
+            quality["constraint_validity_rate"] = round(validity, 6)
+        out["quality"] = quality
     return out
 
 
@@ -217,6 +249,17 @@ def check(objectives: dict, args) -> list:
                 f"{args.max_burn} (error ratio "
                 f"{round(o['error_ratio'], 5)} vs target {o['target']})"
             )
+    # quality drift gate (--max-drift; getattr because the autoscaler's
+    # _GateArgs shim predates the flag and sets only max_burn)
+    max_drift = getattr(args, "max_drift", None)
+    quality = objectives.get("quality")
+    if max_drift and isinstance(quality, dict):
+        d = quality.get("drift_max")
+        if d is not None and not math.isnan(d) and d > max_drift:
+            bad.append(
+                f"quality drift {round(d, 4)} > {max_drift} (PSI vs "
+                "reference fingerprint; see obs/quality.py)"
+            )
     return bad
 
 
@@ -254,6 +297,11 @@ def main() -> int:
     p.add_argument("--max-burn", type=float, default=1.0,
                    help="gate: fail --check when any burn rate "
                         "exceeds this")
+    p.add_argument("--max-drift", type=float, default=0.0,
+                   help="gate: fail --check when any replica's "
+                        "serving_quality_drift (PSI vs reference "
+                        "fingerprint, obs/quality.py) exceeds this "
+                        "(0 = off)")
     p.add_argument("--check", action="store_true",
                    help="exit 1 when any objective burns past "
                         "--max-burn")
