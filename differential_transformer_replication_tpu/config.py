@@ -621,6 +621,22 @@ class RouterConfig:
     # their own bound. 0 = legacy unbounded behavior.
     metrics_max_age_s: float = 10.0
 
+    # -- live migration / resume-by-replay (serving/migrate.py) --------
+    # Total wall-clock budget for migrating ONE slot (destination probe
+    # + export + checksummed transfer + import ACK). A migration that
+    # cannot land within it falls back to replay — the request is never
+    # harmed either way. 0 disables migration: drain degrades to the
+    # replay/plain-retry rungs only.
+    migrate_budget_s: float = 10.0
+    # Per-request cap on journaled emitted tokens (ReplayJournal). A
+    # runaway generation stops growing its entry; replay then degrades
+    # gracefully to a longer — still bit-exact — re-decode of the tail.
+    replay_journal_max_tokens: int = 4096
+    # Finished-entry LRU size: journal ids of completed requests are
+    # remembered this long so late duplicate replies resolve without
+    # re-registering, bounded against months of unique requests.
+    replay_journal_max_finished: int = 1024
+
     # -- predictive admission (serving/admission.py) -------------------
     # When on, the router's shed paths (no_replica, exhausted failover,
     # proactive admission sheds) compute an HONEST Retry-After from
@@ -649,7 +665,8 @@ class RouterConfig:
                      "retry_after_cap_s", "hedge_factor", "hedge_min_s",
                      "queue_weight", "slot_weight", "kv_weight",
                      "wait_for_replica_s", "shed_retry_after_s",
-                     "metrics_max_age_s", "admission_rate_halflife_s",
+                     "metrics_max_age_s", "migrate_budget_s",
+                     "admission_rate_halflife_s",
                      "admission_max_retry_after_s",
                      "admission_wait_bound_s"):
             if getattr(self, name) < 0:
@@ -673,6 +690,12 @@ class RouterConfig:
                 f"affinity_max_sessions must be >= 1, got "
                 f"{self.affinity_max_sessions}"
             )
+        for name in ("replay_journal_max_tokens",
+                     "replay_journal_max_finished"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0, got {getattr(self, name)}"
+                )
 
     def replace(self, **kw) -> "RouterConfig":
         return dataclasses.replace(self, **kw)

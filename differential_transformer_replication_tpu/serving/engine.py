@@ -108,6 +108,16 @@ from differential_transformer_replication_tpu.serving.constrain import (
     ConstraintCompileError,
     spec_key,
 )
+from differential_transformer_replication_tpu.serving.host_tier import (
+    TierEntry,
+)
+from differential_transformer_replication_tpu.serving.migrate import (
+    MigrateExportError,
+    decode_slot_state,
+    encode_slot_state,
+    params_from_dict,
+    params_to_dict,
+)
 from differential_transformer_replication_tpu.serving.pages import (
     PagePool,
     PagePoolExhaustedError,
@@ -214,6 +224,37 @@ _STAT_SPEC = {
         "Tier transfers that degraded to recompute or full restart "
         "(failed/corrupt demote, promote, or swap-in) — typed, "
         "counted, never a wedge.",
+    ),
+    # live migration (serving/migrate.py): slot states exported to /
+    # imported from peer replicas, the wire page traffic (shipped vs
+    # radix-deduped), and the typed failures that fell back to
+    # resume-by-replay instead of wedging or attending garbage KV
+    "migrate_exports": (
+        "serving_migrate_exports_total",
+        "Slot decode states exported to a peer replica (drain path).",
+    ),
+    "migrate_imports": (
+        "serving_migrate_imports_total",
+        "Migrated slot states imported and re-admitted bit-exact.",
+    ),
+    "migrate_pages_shipped": (
+        "serving_migrate_pages_shipped_total",
+        "KV pages shipped over the wire by slot-state exports.",
+    ),
+    "migrate_pages_deduped": (
+        "serving_migrate_pages_deduped_total",
+        "KV pages NOT shipped because the destination's radix tree "
+        "already held the prompt-prefix node (copied device-locally).",
+    ),
+    "migrate_bytes": (
+        "serving_migrate_bytes_total",
+        "Wire bytes of exported slot states (post-dedup).",
+    ),
+    "migrate_failed": (
+        "serving_migrate_failed_total",
+        "Migration imports that failed after admission (bad checksum, "
+        "torn payload, injection failure) — typed, counted, degraded "
+        "to a bit-exact recompute, never garbage KV.",
     ),
 }
 
@@ -1837,7 +1878,9 @@ class ServingEngine:
                 draft[s.index, j] = t
             pos_blk[s.index, :dl + 1] = p0 + np.arange(dl + 1)
             row[c] = dl  # dlen
-            row[c + 1] = len(s.generated)  # counts
+            # counts: key-chain position, replay-offset like the L=1
+            # sampler's column 0 (serving/migrate.py key_offset)
+            row[c + 1] = prm.key_offset + len(s.generated)
             row[c + 2] = prm.top_k or 0  # topks
             bases[s.index] = self._base_keys[s.request.request_id]
             temps[s.index] = prm.temperature
@@ -2474,7 +2517,12 @@ class ServingEngine:
         self._drain_demotions(iteration)
         if pages is None:
             return "wait"
-        ents = self._tier.unstash(rid)
+        # a snapshot carrying its own page images came over the WIRE
+        # (a migrated slot state, serving/migrate.py:import_state) —
+        # inject from it instead of the host-tier stash; everything
+        # downstream (verify, inject, restore) is shared machinery
+        migrated = "pages" in snap
+        ents = snap["pages"] if migrated else self._tier.unstash(rid)
         ok = ents is not None
         if ok and faults.page_swap_corrupt_at(iteration):
             # flip one byte of the first payload leaf in place: the
@@ -2485,7 +2533,8 @@ class ServingEngine:
         if ok:
             for pg, ent in zip(pages, ents):
                 if not ent.verify():
-                    self._tier.note_corrupt()
+                    if self._tier is not None:
+                        self._tier.note_corrupt()
                     ok = False
                     break
                 if not self._inject_page(int(pg), ent.payload):
@@ -2494,8 +2543,14 @@ class ServingEngine:
         if not ok:
             self._pages.release(slot.index, [], False)
             self._resume.pop(rid, None)
-            self._tier.drop_stash(rid)
-            self.stats.inc("tier_fallbacks")
+            if migrated:
+                # fresh admission below recomputes the whole image;
+                # fold_in(key, t) keys make the regenerated stream
+                # bit-identical, so the import degrades, never lies
+                self.stats.inc("migrate_failed")
+            else:
+                self._tier.drop_stash(rid)
+                self.stats.inc("tier_fallbacks")
             # the bit-exact recompute re-emits every token: reset the
             # per-request quality accumulator so means are not doubled
             self._q_acc.pop(rid, None)
@@ -2511,6 +2566,230 @@ class ServingEngine:
         self._resume.pop(request_id, None)
         if self._tier is not None:
             self._tier.drop_stash(request_id)
+
+    # -- live migration (serving/migrate.py) ---------------------------
+    # Engine-thread only, like every other device-touching method: the
+    # runner (serving/server.py) executes these between steps.
+
+    def _slot_for(self, request_id: int) -> Optional[Slot]:
+        return next(
+            (s for s in self.scheduler.slots
+             if s.state != FREE and s.request is not None
+             and s.request.request_id == request_id),
+            None,
+        )
+
+    def export_slot_state(self, request_id: int,
+                          dedup_pages: int = 0) -> bytes:
+        """Capture one ACTIVE slot's full decode state as a wire image
+        WITHOUT disturbing it — the slot keeps decoding until the
+        destination ACKs and :meth:`release_migrated` retires it, so a
+        failed transfer costs nothing. ``dedup_pages`` is the
+        destination's radix-probe answer (PagePool.probe_prefix):
+        that many leading full prompt pages ship as holes the importer
+        copies device-locally. Raises the typed
+        :class:`MigrateExportError` when there is nothing exportable
+        (contiguous layout, request queued/prefilling/finished)."""
+        if self._pages is None or self._extract_fn is None:
+            raise MigrateExportError(
+                "live migration needs the paged KV layout "
+                "(ServingConfig.kv_page_size > 0) — fall back to replay"
+            )
+        slot = self._slot_for(request_id)
+        if slot is None or slot.state != ACTIVE or not slot.generated:
+            raise MigrateExportError(
+                f"request {request_id} holds no ACTIVE slot (queued, "
+                "prefilling, or already finished) — nothing to "
+                "migrate; replay or plain retry covers it",
+                code="migrate_not_active",
+            )
+        faults.stall("migrate_hang")
+        ps = self.serving.kv_page_size
+        p = slot.request.params
+        # live pages: same arithmetic as _preempt_slot — after g
+        # emitted tokens the device KV covers positions 0..P+g-2
+        pos = slot.prompt_len + len(slot.generated)
+        n_live = min(-(-pos // ps), self._pages.pages_per_slot)
+        # dedup can only cover FULL pages of the PROMPT (generated
+        # tokens never live in a radix tree), and the radix match is
+        # capped at prompt_len - 1
+        dedup = max(0, min(
+            int(dedup_pages), n_live,
+            (slot.prompt_len - 1) // ps if slot.prompt_len else 0,
+        ))
+        row = self._pages.table_row(slot.index)
+        payloads: List[Optional[list]] = [
+            None if j < dedup else self._extract_page(int(row[j]))
+            for j in range(n_live)
+        ]
+        now = time.perf_counter()
+        meta = {
+            "prompt": [int(t) for t in slot.prompt],
+            "params": params_to_dict(p),
+            "generated": list(slot.generated),
+            "n_live": n_live,
+            "dedup_pages": dedup,
+            "page_size": ps,
+            "model": self.cfg.model,
+            "block_size": self.cfg.block_size,
+            "filled": slot.filled,
+            "cached_len": slot.cached_len,
+            "spec_proposed": slot.spec_proposed,
+            "spec_accepted": slot.spec_accepted,
+            "fsm_state": slot.fsm_state,
+            "token_logprobs": slot.token_logprobs,
+            "top_logprobs": slot.top_logprobs,
+            "deadline_left_s": (
+                max(0.0, slot.deadline - now) if slot.deadline else 0.0
+            ),
+        }
+        blob = encode_slot_state(meta, payloads)
+        if payloads and faults.consume("migrate_corrupt"):
+            # chaos drill: flip one byte AFTER the per-page CRCs were
+            # stamped — the import side's decode must convict the
+            # transfer (MigratePayloadError), and the drain path falls
+            # back to replay; garbage KV is never attended
+            torn = bytearray(blob)
+            torn[-1] ^= 0xFF
+            blob = bytes(torn)
+        self.stats.inc("migrate_exports")
+        self.stats.inc("migrate_pages_shipped", n_live - dedup)
+        self.stats.inc("migrate_pages_deduped", dedup)
+        self.stats.inc("migrate_bytes", len(blob))
+        return blob
+
+    def release_migrated(self, request_id: int) -> bool:
+        """Retire a slot whose decode state now lives on the
+        destination replica (the import was ACKed). Same engine thread
+        as the export, so the slot cannot have stepped in between.
+        Returns False when the request is unknown/finished — the local
+        output wins and the caller abandons the migration."""
+        slot = self._slot_for(request_id)
+        if slot is None:
+            return False
+        self._base_keys.pop(request_id, None)
+        self._drop_constraint(request_id)
+        self._drop_resume(request_id)
+        self._q_acc.pop(request_id, None)
+        self._finished_counter.inc(reason="migrated")
+        if self._tracing:
+            self.tracer.instant(
+                "finish", rid=request_id, reason="migrated",
+                **(instant_args(slot.trace)
+                   if slot.trace is not None else {}),
+            )
+        # standard retire path: pages dereferenced (prompt prefix
+        # donated to the radix cache when trustworthy) + drafter state
+        # dropped — the SOURCE keeps serving the prefix to new traffic
+        self.scheduler.retire(slot)
+        return True
+
+    def import_state(self, blob: bytes) -> int:
+        """Re-admit a migrated slot state: decode + checksum-verify the
+        wire image (serving/migrate.py — a flipped byte is convicted
+        HERE, before anything reaches the device), resolve dedup holes
+        from the local radix tree, then ride the SAME zero-recompile
+        swap-in machinery as host-tier resume: submit() mints a fresh
+        request id (key chain, constraint compile, deadline from the
+        shipped remainder) and the registered ``self._resume`` snapshot
+        makes the paged admission gate inject the pages bit-exact
+        (:meth:`_try_resume`). Returns the minted request id. Raises
+        :class:`MigratePayloadError` (corrupt/torn) or
+        :class:`MigrateExportError` (geometry mismatch, dedup miss,
+        contiguous layout) — both typed, both leave the engine clean."""
+        if self._pages is None or self._inject_fn is None:
+            raise MigrateExportError(
+                "live migration needs the paged KV layout "
+                "(ServingConfig.kv_page_size > 0)"
+            )
+        meta, payloads = decode_slot_state(blob)
+        if (meta.get("page_size") != self.serving.kv_page_size
+                or meta.get("model") != self.cfg.model
+                or meta.get("block_size") != self.cfg.block_size):
+            raise MigrateExportError(
+                f"geometry mismatch: wire (model={meta.get('model')}, "
+                f"block={meta.get('block_size')}, "
+                f"page={meta.get('page_size')}) vs engine "
+                f"(model={self.cfg.model}, block={self.cfg.block_size},"
+                f" page={self.serving.kv_page_size})",
+                code="migrate_geometry",
+            )
+        prompt = [int(t) for t in meta["prompt"]]
+        dedup = int(meta.get("dedup_pages", 0))
+        if dedup:
+            # resolve the holes from the local radix tree NOW (same
+            # engine thread, no planning call until submit below, so
+            # the chain cannot be evicted under us); a miss — evicted
+            # since the probe — fails typed and the source keeps the
+            # request untouched
+            chain = self._pages.chain_pages(prompt, dedup)
+            if chain is None:
+                self.stats.inc("migrate_failed")
+                raise MigrateExportError(
+                    f"dedup chain ({dedup} pages) no longer cached — "
+                    "evicted between probe and import; source retries "
+                    "without dedup or falls back to replay",
+                    code="migrate_dedup_miss",
+                )
+            for j, pg in enumerate(chain):
+                payloads[j] = self._extract_page(int(pg))
+        params = params_from_dict(meta["params"])
+        left = float(meta.get("deadline_left_s") or 0.0)
+        rid = self.submit(
+            prompt, params=params,
+            deadline=(time.perf_counter() + left) if left else None,
+        )
+        self._resume[rid] = {
+            "n_live": int(meta["n_live"]),
+            "generated": [int(t) for t in meta["generated"]],
+            # host timestamps do not survive the process hop: token
+            # times restart on the destination clock (ITL histograms
+            # skip the splice point; finish_time stays monotonic)
+            "token_times": [],
+            "first_token_time": time.perf_counter(),
+            "filled": int(meta["filled"]),
+            "cached_len": int(meta["cached_len"]),
+            "spec_proposed": int(meta.get("spec_proposed", 0)),
+            "spec_accepted": int(meta.get("spec_accepted", 0)),
+            "prompt_ids": None,
+            "penalty_counts": None,  # _slot_counts rebuilds lazily
+            "token_logprobs": meta.get("token_logprobs"),
+            "top_logprobs": (
+                [[(int(i), float(v)) for i, v in alts]
+                 for alts in meta["top_logprobs"]]
+                if meta.get("top_logprobs") is not None else None
+            ),
+            "fsm_state": int(meta.get("fsm_state", 0)),
+            # wire-borne page images: _try_resume injects these instead
+            # of a host-tier stash (checksums re-verified at injection)
+            "pages": [TierEntry(p) for p in payloads],
+        }
+        self.stats.inc("migrate_imports")
+        return rid
+
+    def progress_snapshot(self) -> List[dict]:
+        """Per-in-flight-request emitted-token progress — the
+        ``GET /inflight`` body the router harvests into its replay
+        journal (serving/migrate.py:ReplayJournal). Engine thread
+        (published by the runner between steps); the journal only
+        needs a PREFIX of the truly-emitted tokens, so lagging a step
+        is correct by construction."""
+        out = []
+        for s in self.scheduler.slots:
+            if s.state == FREE or s.request is None:
+                continue
+            out.append({
+                "request_id": s.request.request_id,
+                "prompt_len": s.prompt_len,
+                "tokens": list(s.generated),
+            })
+        for req, prompt, _t, _dl, _tr in list(self.scheduler.queue):
+            out.append({
+                "request_id": req.request_id,
+                "prompt_len": int(prompt.shape[0]),
+                "tokens": [],
+            })
+        return out
 
     def _release_slot_pages(self, slot: Slot) -> None:
         """Scheduler retirement hook (every retire path: finish,
@@ -2623,6 +2902,19 @@ class ServingEngine:
                 return None
             s.constraint = ent[1]
             s.fsm_state = ent[1].start
+            ko = s.request.params.key_offset
+            if ko:
+                # replayed continuation (serving/migrate.py): the dead
+                # attempt's FSM already consumed the tokens now riding
+                # the prompt tail — walk the fresh cursor over them so
+                # masks continue from the same state
+                P = s.prompt_len
+                st = s.fsm_state
+                for t in s.prompt[max(0, P - ko):P]:
+                    if st < 0:
+                        break
+                    st = ent[1].advance(st, int(t))
+                s.fsm_state = st
         return s.constraint
 
     def _slot_counts(self, s: Slot) -> np.ndarray:
@@ -2632,6 +2924,15 @@ class ServingEngine:
         exact host cost class the packed operands exist to avoid."""
         if s.penalty_counts is None:
             h = np.zeros((self.cfg.vocab_size,), np.int32)
+            ko = s.request.params.key_offset
+            if ko:
+                # replayed continuation: the dead attempt's emitted
+                # tokens (now the prompt tail) were penalized then, so
+                # they seed the histogram here — same distribution as
+                # the uninterrupted run
+                P = s.prompt_len
+                for t in s.prompt[max(0, P - ko):P]:
+                    h[int(t)] += 1
             for t in s.generated:
                 h[t] += 1
             s.penalty_counts = h
@@ -2669,7 +2970,11 @@ class ServingEngine:
         need_mask = need_counts = False
         for i, s in rows:
             p = s.request.params
-            ints[i, 0] = len(s.generated)
+            # key-chain position: a replayed continuation (key_offset >
+            # 0, serving/migrate.py) samples token t with the key the
+            # DEAD attempt would have used at global position
+            # key_offset + t — bit-identical streams across failover
+            ints[i, 0] = p.key_offset + len(s.generated)
             ints[i, 1] = p.top_k or 0
             ints[i, 2:4].view(np.uint32)[:] = (
                 self._base_keys[s.request.request_id]
@@ -2866,7 +3171,20 @@ class ServingEngine:
             g = slot.generated
             for seq in p.stop:
                 n = len(seq)
-                if len(g) >= n and tuple(g[-n:]) == seq:
+                tail = g
+                if len(g) < n and p.key_offset:
+                    # replayed continuation: a stop sequence may span
+                    # the prompt/generated boundary (its head was
+                    # emitted by the dead attempt and rides the prompt
+                    # tail) — match it exactly like the uninterrupted
+                    # run would have
+                    borrow = min(n - len(g), p.key_offset,
+                                 slot.prompt_len)
+                    P = slot.prompt_len
+                    tail = [
+                        int(t) for t in slot.prompt[P - borrow:P]
+                    ] + g
+                if len(tail) >= n and tuple(tail[-n:]) == seq:
                     stop_hit = True
                     break
         if hit_eos or stop_hit or len(slot.generated) >= p.max_new_tokens:

@@ -474,6 +474,44 @@ class PagePool:
             self._slot_private[slot] = list(pages)
             return pages
 
+    def probe_prefix(self, prompt: Sequence[int]) -> int:
+        """How many LEADING FULL PAGES of ``prompt`` this pool's radix
+        tree holds on device — the migration dedup probe
+        (serving/migrate.py): the source replica skips shipping pages
+        the destination can copy device-locally. Read-only (no
+        refcount, no eviction) and conservative: the chain is
+        re-resolved under the lock at import time and a miss there
+        degrades to a typed import failure, never garbage KV."""
+        with self._lock:
+            full, _fork, _matched = self._match_locked(prompt)
+            return len(full)
+
+    def chain_pages(self, prompt: Sequence[int],
+                    n_pages: int) -> Optional[List[int]]:
+        """Physical page ids of the first ``n_pages`` full-page radix
+        nodes covering ``prompt``, or None when the chain is no longer
+        fully cached (evicted between the dedup probe and the import —
+        the race is closed by failing typed, not by pinning). Bumps
+        each node's LRU clock; the caller (engine thread) must read the
+        pages' device bytes before its next pool planning call, the
+        same single-thread invariant COW forks rely on."""
+        with self._lock:
+            ps = self.page_size
+            node = self._root
+            out: List[int] = []
+            for i in range(n_pages):
+                key = tuple(prompt[i * ps:(i + 1) * ps])
+                child = (
+                    node.children.get(key) if len(key) == ps else None
+                )
+                if child is None or child.filled != ps:
+                    return None
+                self._clock += 1
+                child.last_use = self._clock
+                out.append(child.page)
+                node = child
+            return out
+
     def take_demotions(self) -> List[Tuple[tuple, int]]:
         """Drain the pending demotion plans (prefix key, freed page).
         The engine MUST call this immediately after EVERY planning call

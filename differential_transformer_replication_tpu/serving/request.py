@@ -80,6 +80,15 @@ class SamplingParams:
     # tier) when higher classes are blocked on the pool. Anti-starvation
     # aging (ServingConfig.priority_aging_s) guarantees batch progress.
     priority: str = "normal"
+    # Resume-by-replay (serving/migrate.py): the request's last
+    # key_offset PROMPT tokens were emitted by an earlier attempt that
+    # died mid-decode. The engine offsets the fold_in key chain by it
+    # (token t samples with key position key_offset + t), seeds the
+    # penalty histogram and constraint-FSM cursor from that prompt
+    # tail, and matches stop sequences across the prompt/generated
+    # boundary — so the continuation is bit-identical to the
+    # uninterrupted run. 0 = a normal request.
+    key_offset: int = 0
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -183,6 +192,11 @@ class SamplingParams:
             raise ValueError(
                 f"priority must be one of {PRIORITY_CLASSES}, got "
                 f"{self.priority!r}"
+            )
+        if not isinstance(self.key_offset, int) or self.key_offset < 0:
+            raise ValueError(
+                f"key_offset must be a non-negative int, got "
+                f"{self.key_offset!r}"
             )
 
     @property

@@ -82,6 +82,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import uuid
 from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -107,8 +108,12 @@ from differential_transformer_replication_tpu.obs.trace import (
 from differential_transformer_replication_tpu.serving.admission import (
     AdmissionController,
 )
+from differential_transformer_replication_tpu.serving.migrate import (
+    ReplayJournal,
+)
 from differential_transformer_replication_tpu.serving.retry import (
     backoff_delay,
+    http_post_json_with_retries,
 )
 from differential_transformer_replication_tpu.utils import faults
 
@@ -439,6 +444,14 @@ class Router:
             AdmissionController(self.cfg, registry=self.registry)
             if self.cfg.admission_predictive else None
         )
+        # resume-by-replay (serving/migrate.py): bounded per-inflight
+        # journal of emitted tokens, harvested from each replica's
+        # GET /inflight by the probe loop; on a retriable replica death
+        # the request replays prompt+journal on a peer bit-exactly
+        self.journal = ReplayJournal(
+            max_tokens=self.cfg.replay_journal_max_tokens,
+            max_finished=self.cfg.replay_journal_max_finished,
+        )
 
         reg = self.registry
         self._req_counter = reg.counter(
@@ -481,6 +494,23 @@ class Router:
         self._move_counter = reg.counter(
             "router_session_moves_total",
             "Sticky sessions re-pinned because their replica died.",
+        )
+        self._migration_counter = reg.counter(
+            "router_migrations_total",
+            "Fallback-ladder rungs taken for in-flight failover, by "
+            "outcome: migrated (live state moved to a peer), replayed "
+            "(prompt+journal resubmitted bit-exactly), migrate_failed "
+            "(a migration rung failed and the ladder fell through).",
+            labelnames=("outcome",),
+        )
+        self._journal_bytes_gauge = reg.gauge(
+            "router_replay_journal_bytes",
+            "Bytes of emitted tokens held in the replay journal.",
+        )
+        self._drain_hist = reg.histogram(
+            "router_drain_seconds",
+            "Wall-clock of one replica drain via live migration "
+            "(migrate_out) — independent of max_new_tokens by design.",
         )
         self._pick_hist = reg.histogram(
             "router_pick_seconds",
@@ -666,6 +696,30 @@ class Router:
                             )
                 except OSError:
                     pass  # scores are advisory; /ready is the contract
+                try:
+                    # replay-journal harvest: each in-flight request's
+                    # emitted-token prefix. Best-effort and lag-safe —
+                    # a stale prefix only means a few tokens get
+                    # re-generated bit-exactly on replay
+                    code, text = self._http_get(
+                        replica.url + "/inflight", timeout=t
+                    )
+                    if code == 200:
+                        for ent in json.loads(
+                            text or b"{}"
+                        ).get("inflight", []):
+                            jid = ent.get("journal_id")
+                            if jid:
+                                self.journal.update(
+                                    str(jid),
+                                    [int(x)
+                                     for x in ent.get("tokens") or []],
+                                )
+                        self._journal_bytes_gauge.set(
+                            self.journal.stats()["bytes"]
+                        )
+                except (OSError, ValueError):
+                    pass  # pre-migration replicas have no /inflight
             replica.note_probe_success(
                 ready, status, scores,
                 now=time.monotonic() if now is None else now,
@@ -797,6 +851,155 @@ class Router:
         with self._rng_lock:
             a, b = self._rng.sample(eligible, 2)
         return a if a.score() <= b.score() else b
+
+    # -- live migration / resume-by-replay (serving/migrate.py) --------
+
+    def repin(self, session_id: str, url: str) -> bool:
+        """Immediately re-pin a sticky session to the replica at
+        ``url``. Before migration the affinity map only re-pinned when
+        the pinned replica DIED; a migrated session's prefix-cache
+        locality now lives at the destination, so the pin must follow
+        the moved state right away — not after another failure."""
+        url = url.rstrip("/")
+        rep = next((r for r in self.replicas if r.url == url), None)
+        if rep is None:
+            return False
+        with self._aff_lock:
+            if self._affinity.get(session_id) is rep:
+                self._affinity.move_to_end(session_id)
+                return True
+            self._affinity[session_id] = rep
+            self._affinity.move_to_end(session_id)
+            while len(self._affinity) > self.cfg.affinity_max_sessions:
+                self._affinity.popitem(last=False)
+        self._move_counter.inc()
+        self.events.emit("session_repinned", session_id=session_id,
+                         replica=rep.name, via="migration")
+        return True
+
+    def _await_migrated(self, dest_url: str, migrate_id: str,
+                        timeout: float, ctx=None) -> Tuple[int, dict]:
+        """Pick up a migrated continuation at the destination replica:
+        POST /migrate/await blocks until the imported request finishes
+        and answers in the exact /generate reply shape (COMPLETE token
+        list — no stitching needed)."""
+        payload: dict = {"migrate_id": migrate_id, "timeout": timeout}
+        if ctx is not None:
+            payload["traceparent"] = ctx.child().to_traceparent()
+        try:
+            req = urllib.request.Request(
+                dest_url + "/migrate/await",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=timeout + 5.0) as r:
+                body = json.load(r)
+                if not isinstance(body, dict):
+                    raise ValueError(f"non-object reply: {body!r}")
+                return r.status, body
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}")
+            except (ValueError, OSError):
+                return e.code, {}
+        except (urllib.error.URLError, TimeoutError, ConnectionError,
+                OSError, ValueError) as e:
+            return -1, {
+                "error": f"migrated continuation at {dest_url} "
+                         f"unreachable: {e!r}",
+                "code": "replica_unreachable",
+            }
+
+    @staticmethod
+    def _replay_finish_reason(tokens: List[int], payload: dict,
+                              remaining: int) -> Optional[str]:
+        """Whether the journaled prefix ALREADY completes the request
+        (the source died between finishing and replying) — replaying a
+        finished generation would decode extra tokens past the stop."""
+        if remaining <= 0:
+            return "length"
+        eos = payload.get("eos_token_id")
+        if eos is not None and tokens and tokens[-1] == int(eos):
+            return "eos"
+        for seq in payload.get("stop") or ():
+            seq = [int(tok) for tok in seq]
+            if seq and tokens[-len(seq):] == seq:
+                return "stop_sequence"
+        return None
+
+    def migrate_out(self, url: str) -> dict:
+        """Drain a replica by MIGRATING its in-flight requests to the
+        least-loaded eligible peer (the tentpole of zero-loss rolling
+        restarts: drain time becomes the page-transfer time, not
+        max_new_tokens' worth of decoding). Enumerates the source's
+        ``GET /inflight`` and POSTs ``/migrate/export`` per request;
+        each successful export flips that request's blocked /generate
+        into the ``migrated`` reply, which :meth:`handle_generate`
+        follows to the destination. Failed exports are counted and left
+        to the replay rung — the request is never harmed. Returns the
+        per-outcome counts plus ``drain_seconds`` (also observed into
+        ``router_drain_seconds``)."""
+        url = url.rstrip("/")
+        t0 = time.monotonic()
+        counts = {"migrated": 0, "finished": 0, "failed": 0}
+        budget = self.cfg.migrate_budget_s
+        if budget <= 0:
+            return {**counts, "drain_seconds": 0.0,
+                    "outcome": "migration_disabled"}
+        try:
+            code, text = self._http_get(
+                url + "/inflight", timeout=self.cfg.probe_timeout_s
+            )
+            entries = (
+                json.loads(text or b"{}").get("inflight", [])
+                if code == 200 else []
+            )
+        except (OSError, ValueError):
+            entries = []
+        for ent in entries:
+            rid = ent.get("request_id")
+            if rid is None:
+                continue
+            if not ent.get("tokens"):
+                # queued / still prefilling: nothing device-side worth
+                # shipping — the replay rung resubmits it wholesale
+                # when the source drains
+                continue
+            peers = [
+                r for r in self.replicas
+                if r.url != url and r.eligible()
+            ]
+            if not peers:
+                counts["failed"] += 1
+                continue
+            dest = min(peers, key=lambda r: r.score())
+            migrate_id = uuid.uuid4().hex
+            try:
+                status, body, _ = http_post_json_with_retries(
+                    url + "/migrate/export",
+                    {"request_id": int(rid), "dest": dest.url,
+                     "migrate_id": migrate_id, "budget_s": budget},
+                    timeout=budget + 10.0, max_retries=0,
+                    deadline_s=budget + 10.0,
+                )
+            except Exception:
+                status, body = -1, {}
+            if status == 200 and body.get("outcome") == "migrated":
+                counts["migrated"] += 1
+            elif status == 200:
+                counts["finished"] += 1  # completed before the export
+            else:
+                counts["failed"] += 1
+                self.events.emit(
+                    "migrate_export_failed", replica=url,
+                    request_id=rid,
+                    code=(body or {}).get("code"),
+                )
+        dt = time.monotonic() - t0
+        self._drain_hist.observe(dt)
+        self.events.emit("replica_drained", replica=url,
+                         drain_seconds=round(dt, 3), **counts)
+        return {**counts, "drain_seconds": dt}
 
     # -- forwarding ----------------------------------------------------
 
@@ -1078,11 +1281,34 @@ class Router:
         shed_headers = {
             "Retry-After": _fmt_secs(self._shed_retry_after(priority))
         }
+        # resume-by-replay bookkeeping: every routed request carries a
+        # journal id the replica echoes in GET /inflight, so the probe
+        # loop can harvest its emitted tokens. Replay needs token-level
+        # prompts (text prompts stay on the plain-retry rung). Each
+        # replay ATTEMPT gets a FRESH id: a replayed submission's
+        # /inflight tokens are continuation-only, and harvesting them
+        # under the old id would mis-position them in the journal.
+        raw_prompt = payload.get("prompt_ids")
+        orig_prompt = (
+            [int(t) for t in raw_prompt] if raw_prompt is not None else None
+        )
+        jid = uuid.uuid4().hex
+        payload = dict(payload)
+        payload["journal_id"] = jid
+        self.journal.begin(jid)
+        try:
+            remaining_max = int(payload.get("max_new_tokens", 16))
+        except (TypeError, ValueError):
+            remaining_max = 16
+        cur_prompt = orig_prompt
+        replay_prefix: List[int] = []
         tried: List[str] = []
         last: Optional[Tuple[int, dict, dict]] = None
         attempt = 0
 
         def _done(status: int, body: dict, headers: dict):
+            self.journal.finish(jid)
+            self._journal_bytes_gauge.set(self.journal.stats()["bytes"])
             body.setdefault("trace_id", ctx.trace_id)
             self.events.emit(
                 "request_finished" if status == 200 else "request_failed",
@@ -1116,7 +1342,60 @@ class Router:
                 ctx=ctx,
             )
             attempt += 1
-            if status == 200:
+            if status == 200 and body.get("code") == "migrated":
+                # the source drained and live-migrated this request
+                # mid-decode: follow the continuation to the
+                # destination and collect the COMPLETE reply there
+                dest = str(body.get("dest") or "").rstrip("/")
+                mid = str(body.get("migrate_id") or "")
+                if session_id is not None and dest:
+                    # affinity must follow the moved state immediately
+                    self.repin(session_id, dest)
+                await_t = 600.0
+                if end is not None:
+                    await_t = max(0.05, end - time.monotonic())
+                astatus, abody = self._await_migrated(
+                    dest, mid, await_t, ctx=ctx
+                )
+                if astatus == 200:
+                    self._migration_counter.inc(outcome="migrated")
+                    if replay_prefix:
+                        abody["tokens"] = (
+                            replay_prefix + list(abody.get("tokens") or [])
+                        )
+                        abody["prompt_ids"] = orig_prompt
+                    drep = next(
+                        (r for r in self.replicas if r.url == dest), None
+                    )
+                    abody["replica"] = (
+                        drep.name if drep is not None else dest
+                    )
+                    abody["attempts"] = attempt
+                    abody["hedged"] = hedged
+                    abody["migrated"] = True
+                    return _done(200, abody, {})
+                # destination lost the continuation (crash between
+                # import and finish): typed, counted, and dropped into
+                # the normal retriable ladder — the replay rung below
+                # reconstructs from the journal
+                self._migration_counter.inc(outcome="migrate_failed")
+                status, body, retry_after = 503, {
+                    "error": f"migrated continuation lost at {dest}: "
+                             + str(abody.get("error")
+                                   or abody.get("code") or astatus),
+                    "code": "migrate_await_failed",
+                }, None
+            elif status == 200:
+                if replay_prefix:
+                    # this attempt decoded only the tail; splice the
+                    # journaled prefix back and restore the original
+                    # prompt so the client sees one seamless reply
+                    body["tokens"] = (
+                        replay_prefix + list(body.get("tokens") or [])
+                    )
+                    body["prompt_ids"] = orig_prompt
+                    body["replayed"] = True
+                    self._migration_counter.inc(outcome="replayed")
                 body["replica"] = used.name
                 body["attempts"] = attempt
                 body["hedged"] = hedged
@@ -1133,6 +1412,48 @@ class Router:
             tried.append(replica.url)
             if used is not replica and used.url not in tried:
                 tried.append(used.url)  # a failed hedge also counts
+            if orig_prompt is not None:
+                toks = self.journal.tokens(jid)
+                if toks:
+                    # resume-by-replay: the dead attempt already
+                    # emitted these tokens; resubmit prompt+prefix as
+                    # a prefill with key_offset carrying the key-chain
+                    # position, so the peer's continuation is
+                    # bit-identical — no page transfer, no lost work
+                    replay_prefix = replay_prefix + toks
+                    remaining_max = max(0, remaining_max - len(toks))
+                    reason = self._replay_finish_reason(
+                        replay_prefix, payload, remaining_max
+                    )
+                    if reason is not None:
+                        # the source died AFTER finishing the
+                        # generation but before replying: the journal
+                        # holds the complete answer — synthesize it
+                        self._migration_counter.inc(outcome="replayed")
+                        return _done(200, {
+                            "request_id": -1,
+                            "prompt_ids": orig_prompt,
+                            "tokens": replay_prefix,
+                            "finish_reason": reason,
+                            "ttft_ms": 0.0,
+                            "replayed": True,
+                            "attempts": attempt,
+                            "hedged": hedged,
+                        }, {})
+                    cur_prompt = list(cur_prompt) + toks
+                    self.journal.finish(jid)
+                    jid = uuid.uuid4().hex
+                    self.journal.begin(jid)
+                    payload = dict(payload)
+                    payload["prompt_ids"] = cur_prompt
+                    payload["key_offset"] = len(replay_prefix)
+                    payload["max_new_tokens"] = max(1, remaining_max)
+                    payload["journal_id"] = jid
+                    self.events.emit(
+                        "request_replayed", trace_id=ctx.trace_id,
+                        journaled=len(toks),
+                        total_prefix=len(replay_prefix),
+                    )
             capped_ra = None
             if retry_after is not None:
                 capped_ra = min(retry_after, self.cfg.retry_after_cap_s)
@@ -1279,7 +1600,7 @@ def _make_handler(router: Router):
                                   "code": "bad_request"})
 
         def do_POST(self):
-            if self.path != "/generate":
+            if self.path not in ("/generate", "/drain"):
                 self._reply(404, {"error": f"unknown path {self.path}",
                                   "code": "bad_request"})
                 return
@@ -1290,6 +1611,20 @@ def _make_handler(router: Router):
                     raise ValueError("request body must be a JSON object")
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._reply(400, {"error": str(e), "code": "bad_request"})
+                return
+            if self.path == "/drain":
+                # migrate a replica's in-flight requests to peers —
+                # tools/fleet.py calls this before a rolling restart
+                url = str(payload.get("replica") or "").rstrip("/")
+                if not url:
+                    self._reply(400, {"error": "missing 'replica' url",
+                                      "code": "bad_request"})
+                    return
+                try:
+                    self._reply(200, router.migrate_out(url))
+                except Exception as e:
+                    self._reply(500, {"error": f"drain error: {e!r}",
+                                      "code": "internal"})
                 return
             try:
                 status, body, headers = router.handle_generate(payload)

@@ -28,6 +28,18 @@ Three layers, smallest useful surface each:
   or restarting). SIGTERM triggers a graceful drain: admission stops
   (503 + Retry-After), in-flight requests finish within
   ``ServingConfig.drain_timeout_s``, then the process exits.
+
+Live migration (serving/migrate.py) rides four extra endpoints: the
+router drains a replica by enumerating ``GET /inflight`` and POSTing
+``/migrate/export {request_id, dest, migrate_id}`` per active request —
+the source then probes the destination's radix tree (``/migrate/probe``,
+dedup), exports the slot's checksummed wire image, lands it with
+``POST dest /migrate/import``, releases the slot, and answers the
+original blocked ``/generate`` with ``200 {"code": "migrated"}`` so the
+router re-issues ``POST dest /migrate/await {migrate_id}`` and returns
+the COMPLETE token list from the peer. Probe, export, transfer and
+release run as ONE command on the engine thread between steps, so no
+decode iteration can interleave with a half-exported slot.
 """
 
 from __future__ import annotations
@@ -36,7 +48,7 @@ import json
 import sys
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence
 
@@ -57,12 +69,21 @@ from differential_transformer_replication_tpu.serving.engine import (
     EngineCrashError,
     ServingEngine,
 )
+from differential_transformer_replication_tpu.serving.migrate import (
+    MigrateExportError,
+    MigratePayloadError,
+    from_wire,
+    to_wire,
+)
 from differential_transformer_replication_tpu.serving.pages import (
     PagePoolExhaustedError,
 )
 from differential_transformer_replication_tpu.serving.request import (
     RequestOutput,
     SamplingParams,
+)
+from differential_transformer_replication_tpu.serving.retry import (
+    http_post_json_with_retries,
 )
 from differential_transformer_replication_tpu.serving.scheduler import (
     DeadlineExceededError,
@@ -76,6 +97,19 @@ class ShuttingDownError(RuntimeError):
     Retry-After so load balancers take the instance out of rotation."""
 
     retriable = True
+
+
+class MigratedError(RuntimeError):
+    """Settle marker, not a failure: this request's live decode state
+    moved to a peer replica mid-flight (serving/migrate.py). The HTTP
+    handler maps it to 200 ``{"code": "migrated", "dest", "migrate_id"}``
+    so the router follows with ``POST dest /migrate/await`` and returns
+    the peer's COMPLETE continuation to the caller."""
+
+    def __init__(self, dest: str, migrate_id: str):
+        super().__init__(f"request migrated to {dest}")
+        self.dest = dest
+        self.migrate_id = migrate_id
 
 
 def _inc_stat(stats, key: str) -> None:
@@ -94,9 +128,11 @@ class _Pending:
     """One submitted request's handle across the thread boundary."""
 
     __slots__ = ("prompt", "params", "deadline", "trace", "done",
-                 "result", "error", "rid", "cancelled", "settled")
+                 "result", "error", "rid", "cancelled", "settled",
+                 "journal_id")
 
-    def __init__(self, prompt, params, deadline=None, trace=None):
+    def __init__(self, prompt, params, deadline=None, trace=None,
+                 journal_id=None):
         self.prompt = prompt
         self.params = params
         self.deadline = deadline  # absolute perf_counter ts, or None
@@ -107,6 +143,10 @@ class _Pending:
         self.rid: Optional[int] = None  # set once the engine admits it
         self.cancelled = False
         self.settled = False  # exactly-once delivery (drain accounting)
+        # the router's replay-journal handle (serving/migrate.py):
+        # echoed in GET /inflight so harvested token prefixes land in
+        # the right journal entry
+        self.journal_id = journal_id
 
 
 class EngineRunner:
@@ -126,6 +166,16 @@ class EngineRunner:
         self._cond = threading.Condition()
         self._incoming: deque = deque()  # _Pending not yet in the engine
         self._cancels: deque = deque()  # _Pending to cancel in the engine
+        # engine-thread command queue (serving/migrate.py): migration
+        # export/import thunks run here between steps, so a decode
+        # iteration can never interleave with a half-exported slot
+        self._commands: deque = deque()
+        self._waiters: dict = {}  # request_id -> _Pending (engine thread)
+        self._inflight: list = []  # last step's progress snapshot
+        # migrate_id -> _Pending for imported requests; /migrate/await
+        # blocks on these. Bounded: settled entries evict oldest-first.
+        self._migrated: "OrderedDict[str, _Pending]" = OrderedDict()
+        self._migrated_cap = 256
         self._stop = False
         self._abort = False  # drain budget blown: fail leftovers, exit
         self._draining = False
@@ -189,7 +239,7 @@ class EngineRunner:
     def submit(self, prompt: Sequence[int],
                params: Optional[SamplingParams] = None,
                deadline_s: Optional[float] = None,
-               trace=None, **kw) -> _Pending:
+               trace=None, journal_id=None, **kw) -> _Pending:
         """Thread-safe enqueue; returns the request's :class:`_Pending`
         handle. Raises :class:`QueueFullError` IMMEDIATELY when the
         admission bound (ServingConfig.max_queue_len) is hit — counting
@@ -208,7 +258,8 @@ class EngineRunner:
             time.perf_counter() + deadline_s
             if deadline_s is not None else None
         )
-        pending = _Pending(list(prompt), params, deadline, trace)
+        pending = _Pending(list(prompt), params, deadline, trace,
+                           journal_id=journal_id)
         with self._cond:
             if self._failed:
                 err = EngineCrashError(
@@ -253,9 +304,9 @@ class EngineRunner:
                  params: Optional[SamplingParams] = None,
                  timeout: Optional[float] = None,
                  deadline_s: Optional[float] = None,
-                 trace=None, **kw) -> RequestOutput:
+                 trace=None, journal_id=None, **kw) -> RequestOutput:
         pending = self.submit(prompt, params, deadline_s=deadline_s,
-                              trace=trace, **kw)
+                              trace=trace, journal_id=journal_id, **kw)
         if not pending.done.wait(timeout):
             # reclaim the engine-side resources before giving up — the
             # old behavior decoded to completion for nobody, pinning a
@@ -265,6 +316,154 @@ class EngineRunner:
         if pending.error is not None:
             raise pending.error
         return pending.result
+
+    # -- live migration (serving/migrate.py) ---------------------------
+
+    def run_on_engine(self, fn, timeout: float = 30.0):
+        """Run ``fn()`` ON the engine thread between steps and return
+        its result (or re-raise its exception) to the calling thread.
+        The engine is single-threaded by contract — this is the only
+        sanctioned way for an HTTP handler to touch engine state.
+        Accepted while draining (drain-time migration IS the point),
+        refused once the runner is stopped or failed."""
+        done = threading.Event()
+        box: dict = {}
+
+        def thunk():
+            try:
+                box["result"] = fn()
+            except BaseException as e:
+                box["error"] = e
+            finally:
+                done.set()
+
+        with self._cond:
+            if self._failed or self._stop:
+                raise ShuttingDownError(
+                    "runner is stopped; no engine thread to run on"
+                )
+            self._commands.append(thunk)
+            self._cond.notify()
+        if not done.wait(timeout):
+            raise TimeoutError(
+                f"engine command did not complete within {timeout}s"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def migrate_out(self, request_id: int, dest_url: str,
+                    migrate_id: str, budget_s: float = 10.0) -> dict:
+        """Migrate one in-flight request's live decode state to a peer
+        replica: probe the destination's radix tree (dedup), export the
+        slot's checksummed wire image, POST it to ``dest/migrate/import``
+        under the transfer budget, then release the local slot and
+        settle its waiter with :class:`MigratedError` — the blocked
+        /generate handler answers 200 ``{"code": "migrated"}`` and the
+        router awaits the peer. The whole sequence runs as ONE engine-
+        thread command, so no decode step interleaves between export
+        and release (the exported image is exact, and the source can
+        never decode past it). Raises :class:`MigrateExportError`
+        (typed ``code``) when any rung fails — the caller's fallback is
+        replay."""
+        budget = max(0.1, float(budget_s))
+
+        def thunk():
+            deadline = time.monotonic() + budget
+            pending = self._waiters.get(request_id)
+            if pending is None:
+                # finished (or never admitted here): its /generate
+                # already answered with the real result — nothing to move
+                return {"outcome": "finished"}
+            slot = self.engine._slot_for(request_id)
+            cached = 0
+            if slot is not None:
+                try:
+                    status, body, _ = http_post_json_with_retries(
+                        dest_url + "/migrate/probe",
+                        {"prompt_ids": [int(t) for t in slot.prompt]},
+                        timeout=min(5.0, budget), max_retries=0,
+                        deadline_s=max(0.1, deadline - time.monotonic()),
+                    )
+                    if status == 200:
+                        cached = int(body.get("cached_pages", 0) or 0)
+                except Exception:
+                    cached = 0  # probe is best-effort: dedup off
+            blob = self.engine.export_slot_state(
+                request_id, dedup_pages=cached
+            )
+            status, body, _ = http_post_json_with_retries(
+                dest_url + "/migrate/import",
+                {"state": to_wire(blob), "migrate_id": migrate_id},
+                timeout=budget, max_retries=2,
+                deadline_s=max(0.1, deadline - time.monotonic()),
+            )
+            if status != 200:
+                code = body.get("code") if isinstance(body, dict) else None
+                _inc_stat(self.engine.stats, "migrate_failed")
+                raise MigrateExportError(
+                    f"destination import failed (status {status}, "
+                    f"code {code})", code="migrate_transfer",
+                )
+            self.engine.release_migrated(request_id)
+            self._waiters.pop(request_id, None)
+            self._settle(
+                pending, error=MigratedError(dest_url, migrate_id)
+            )
+            return {
+                "outcome": "migrated",
+                "bytes": len(blob),
+                "dedup_pages": cached,
+                "dest": dest_url,
+                "migrate_id": migrate_id,
+            }
+
+        return self.run_on_engine(thunk, timeout=budget + 30.0)
+
+    def import_state(self, blob: bytes, migrate_id: str,
+                     timeout: float = 30.0) -> int:
+        """Land a migrated slot here: decode + CRC-verify the wire
+        image, re-admit it through the zero-recompile swap-in path
+        (serving/engine.py:import_state), and register a synthetic
+        waiter under ``migrate_id`` for ``/migrate/await``. Runs on the
+        engine thread. Raises :class:`MigratePayloadError` on a
+        convicted transfer (never garbage KV), typed admission errors
+        (QueueFullError, PagePoolExhaustedError) when full."""
+        with self._cond:
+            if self._draining or self._stop or self._failed:
+                raise ShuttingDownError(
+                    "replica is draining; migrate elsewhere"
+                )
+
+        def thunk():
+            rid = self.engine.import_state(blob)
+            pending = _Pending([], None)
+            pending.rid = rid
+            self._waiters[rid] = pending
+            with self._cond:
+                self._open += 1
+                self._migrated[migrate_id] = pending
+                while len(self._migrated) > self._migrated_cap:
+                    oldest = next(iter(self._migrated))
+                    if not self._migrated[oldest].settled:
+                        break  # never drop a live import
+                    self._migrated.popitem(last=False)
+            return rid
+
+        return self.run_on_engine(thunk, timeout=timeout)
+
+    def migrated_pending(self, migrate_id: str) -> Optional[_Pending]:
+        with self._cond:
+            return self._migrated.get(migrate_id)
+
+    def inflight_snapshot(self) -> list:
+        """The last completed step's per-request progress (request_id,
+        prompt_len, emitted tokens so far, journal_id when the router
+        supplied one). Read lock-free by the router's probe loop into
+        its ReplayJournal — a stale snapshot only means a few tokens
+        get re-generated bit-exactly on replay."""
+        with self._cond:
+            return list(self._inflight)
 
     # -- shutdown ------------------------------------------------------
 
@@ -472,12 +671,13 @@ class EngineRunner:
         return True
 
     def _loop(self) -> None:
-        waiters: dict = {}  # request_id -> _Pending
+        waiters = self._waiters  # request_id -> _Pending (this thread's)
         while True:
             with self._cond:
                 while (
                     not self._incoming
                     and not self._cancels
+                    and not self._commands
                     and not self.engine.has_work()
                     and not self._abort
                 ):
@@ -488,6 +688,8 @@ class EngineRunner:
                 self._incoming.clear()
                 cancels = list(self._cancels)
                 self._cancels.clear()
+                commands = list(self._commands)
+                self._commands.clear()
                 stopping = self._stop
                 aborting = self._abort
             if aborting:
@@ -531,6 +733,10 @@ class EngineRunner:
                     waiters[pending.rid] = pending
                 except Exception as e:  # invalid request: fail the caller
                     self._settle(pending, error=e)
+            for thunk in commands:
+                # migration export/import thunks (run_on_engine): each
+                # captures its own exception and signals its caller
+                thunk()
             try:
                 t0 = time.perf_counter()
                 # the watchdog state is read by status() from HTTP
@@ -563,6 +769,15 @@ class EngineRunner:
                     return
                 continue
             self._deliver(outs, waiters)
+            progress = getattr(self.engine, "progress_snapshot", None)
+            if progress is not None:
+                entries = progress()
+                for ent in entries:
+                    p = waiters.get(ent.get("request_id"))
+                    if p is not None and p.journal_id is not None:
+                        ent["journal_id"] = p.journal_id
+                with self._cond:
+                    self._inflight = entries
             if stopping and not self.engine.has_work():
                 return
 
@@ -577,10 +792,10 @@ class ServingClient:
                  params: Optional[SamplingParams] = None,
                  timeout: Optional[float] = None,
                  deadline_s: Optional[float] = None,
-                 trace=None, **kw) -> RequestOutput:
+                 trace=None, journal_id=None, **kw) -> RequestOutput:
         return self.runner.generate(
             prompt, params, timeout=timeout, deadline_s=deadline_s,
-            trace=trace, **kw
+            trace=trace, journal_id=journal_id, **kw
         )
 
     def generate_batch(self, prompts: Sequence[Sequence[int]],
@@ -761,11 +976,192 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                         503, {"ready": False, "status": client.status()},
                         headers=self._retry_after(),
                     )
+            elif self.path == "/inflight":
+                # per-request progress for the router: replay-journal
+                # harvest + drain-time migration enumeration
+                self._reply(
+                    200, {"inflight": client.runner.inflight_snapshot()}
+                )
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
+        # -- live migration endpoints (serving/migrate.py) ------------
+
+        def _read_json(self) -> dict:
+            n = int(self.headers.get("Content-Length", "0"))
+            return json.loads(self.rfile.read(n) or b"{}")
+
+        def _migrate_probe(self) -> None:
+            """How many leading prompt pages this replica's radix tree
+            already holds — the source ships holes for them (dedup)."""
+            try:
+                req = self._read_json()
+                prompt = [int(t) for t in req.get("prompt_ids") or []]
+                pool = getattr(client.runner.engine, "_pages", None)
+                cached = (
+                    pool.probe_prefix(prompt)
+                    if pool is not None and prompt else 0
+                )
+                self._reply(200, {"cached_pages": int(cached)})
+            except Exception as e:
+                self._reply(400, {"error": str(e), "code": "bad_request"})
+
+        def _migrate_import(self) -> None:
+            """Land a migrated slot: decode + CRC-verify, re-admit via
+            the zero-recompile swap-in path. A convicted (corrupt/torn)
+            payload answers a typed 409 — garbage KV never lands."""
+            try:
+                req = self._read_json()
+                migrate_id = str(req.get("migrate_id") or "")
+                if not migrate_id or "state" not in req:
+                    raise ValueError("migrate_id and state required")
+                blob = from_wire(str(req["state"]))
+                rid = client.runner.import_state(blob, migrate_id)
+            except MigratePayloadError as e:
+                self._reply(409, {"error": str(e),
+                                  "code": "migrate_corrupt"})
+            except MigrateExportError as e:
+                self._reply(409, {"error": str(e), "code": e.code})
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e), "code": "bad_request"})
+            except QueueFullError as e:
+                self._reply(503, {"error": str(e), "code": "queue_full"},
+                            headers=self._retry_after())
+            except PagePoolExhaustedError as e:
+                self._reply(503, {"error": str(e),
+                                  "code": "page_pool_exhausted"},
+                            headers=self._retry_after())
+            except ShuttingDownError as e:
+                self._reply(503, {"error": str(e),
+                                  "code": "shutting_down"},
+                            headers=self._retry_after())
+            except TimeoutError as e:
+                self._reply(503, {"error": str(e),
+                                  "code": "migrate_timeout"})
+            except Exception as e:
+                self._reply(500, {"error": str(e) or repr(e),
+                                  "code": "internal"})
+            else:
+                events.emit("migrate_imported", migrate_id=migrate_id,
+                            request_id=rid)
+                self._reply(200, {"request_id": rid,
+                                  "migrate_id": migrate_id})
+
+        def _migrate_export(self) -> None:
+            """Drain-side trigger: move one in-flight request to
+            ``dest``. Any typed failure (contiguous layout, transfer
+            death, dest full) answers non-200 so the router falls back
+            to replay — the request itself is NEVER harmed (the slot
+            keeps decoding unless the hand-off fully landed)."""
+            try:
+                req = self._read_json()
+                result = client.runner.migrate_out(
+                    int(req["request_id"]),
+                    str(req["dest"]).rstrip("/"),
+                    str(req.get("migrate_id") or ""),
+                    budget_s=float(req.get("budget_s", 10.0)),
+                )
+            except MigrateExportError as e:
+                self._reply(409, {"error": str(e), "code": e.code})
+            except (ValueError, TypeError, KeyError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e), "code": "bad_request"})
+            except ShuttingDownError as e:
+                self._reply(503, {"error": str(e),
+                                  "code": "shutting_down"})
+            except TimeoutError as e:
+                self._reply(503, {"error": str(e),
+                                  "code": "migrate_timeout"})
+            except Exception as e:
+                self._reply(500, {"error": str(e) or repr(e),
+                                  "code": "internal"})
+            else:
+                events.emit("migrate_exported",
+                            outcome=result.get("outcome"),
+                            dest=result.get("dest"))
+                self._reply(200, result)
+
+        def _run_generate(self, req: dict, ctx) -> RequestOutput:
+            """Parse a /generate body into SamplingParams and run it;
+            raises the typed errors do_POST's ladder maps to HTTP."""
+            prompt_ids = req.get("prompt_ids")
+            if prompt_ids is None and "prompt" in req:
+                if tokenizer is None:
+                    raise ValueError(
+                        "text prompts need the server started with a "
+                        "tokenizer dir; send prompt_ids instead"
+                    )
+                prompt_ids = tokenizer.encode(req["prompt"]).ids
+            if not prompt_ids:
+                raise ValueError("prompt_ids (or prompt) required")
+            top_k = req.get("top_k")
+            eos = req.get("eos_token_id")
+            choices = req.get("choices")
+            stop = req.get("stop")
+            # json_schema arrives as a JSON VALUE (object) or a
+            # pre-encoded string; SamplingParams wants the string
+            schema = req.get("json_schema")
+            if schema is not None and not isinstance(schema, str):
+                schema = json.dumps(schema)
+            params = SamplingParams(
+                max_new_tokens=int(req.get("max_new_tokens", 16)),
+                temperature=float(req.get("temperature", 1.0)),
+                top_k=None if top_k is None else int(top_k),
+                seed=int(req.get("seed", 0)),
+                eos_token_id=None if eos is None else int(eos),
+                json_schema=schema,
+                regex=req.get("regex"),
+                choices=choices,
+                repetition_penalty=float(
+                    req.get("repetition_penalty", 1.0)
+                ),
+                presence_penalty=float(
+                    req.get("presence_penalty", 0.0)
+                ),
+                frequency_penalty=float(
+                    req.get("frequency_penalty", 0.0)
+                ),
+                stop=(
+                    None if stop is None
+                    else tuple(
+                        tuple(int(t) for t in seq) for seq in stop
+                    )
+                ),
+                logprobs=int(req.get("logprobs", 0)),
+                priority=str(req.get("priority", "normal")),
+                # resume-by-replay (serving/migrate.py): the router
+                # resubmits prompt+emitted with the key-chain position
+                key_offset=int(req.get("key_offset", 0)),
+            )
+            deadline_s = req.get("deadline_s")
+            # "received", not "admitted": a QueueFullError /
+            # ShuttingDownError raised inside generate() means the
+            # scheduler never accepted this request — true
+            # admission is the engine's trace-stamped `admit`
+            # instant; this event marks arrival at the handler
+            events.emit("request_received", trace_id=ctx.trace_id,
+                        prompt_len=len(prompt_ids))
+            jid = req.get("journal_id")
+            return client.generate(
+                [int(t) for t in prompt_ids], params,
+                timeout=float(req.get("timeout", 600.0)),
+                deadline_s=(
+                    None if deadline_s is None else float(deadline_s)
+                ),
+                trace=ctx,
+                journal_id=None if jid is None else str(jid),
+            )
+
         def do_POST(self):
-            if self.path != "/generate":
+            if self.path == "/migrate/probe":
+                return self._migrate_probe()
+            if self.path == "/migrate/import":
+                return self._migrate_import()
+            if self.path == "/migrate/export":
+                return self._migrate_export()
+            # /migrate/await shares /generate's error ladder and reply
+            # shape — it IS a /generate whose work arrived by migration
+            if self.path not in ("/generate", "/migrate/await"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
             ctx = None  # TraceContext once the body parses
@@ -791,68 +1187,30 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                 # one; a directly-hit replica mints its own, so replies
                 # ALWAYS carry a trace_id a stitched timeline can find
                 ctx = trace_from_payload(req)
-                prompt_ids = req.get("prompt_ids")
-                if prompt_ids is None and "prompt" in req:
-                    if tokenizer is None:
-                        raise ValueError(
-                            "text prompts need the server started with a "
-                            "tokenizer dir; send prompt_ids instead"
-                        )
-                    prompt_ids = tokenizer.encode(req["prompt"]).ids
-                if not prompt_ids:
-                    raise ValueError("prompt_ids (or prompt) required")
-                top_k = req.get("top_k")
-                eos = req.get("eos_token_id")
-                choices = req.get("choices")
-                stop = req.get("stop")
-                # json_schema arrives as a JSON VALUE (object) or a
-                # pre-encoded string; SamplingParams wants the string
-                schema = req.get("json_schema")
-                if schema is not None and not isinstance(schema, str):
-                    schema = json.dumps(schema)
-                params = SamplingParams(
-                    max_new_tokens=int(req.get("max_new_tokens", 16)),
-                    temperature=float(req.get("temperature", 1.0)),
-                    top_k=None if top_k is None else int(top_k),
-                    seed=int(req.get("seed", 0)),
-                    eos_token_id=None if eos is None else int(eos),
-                    json_schema=schema,
-                    regex=req.get("regex"),
-                    choices=choices,
-                    repetition_penalty=float(
-                        req.get("repetition_penalty", 1.0)
-                    ),
-                    presence_penalty=float(
-                        req.get("presence_penalty", 0.0)
-                    ),
-                    frequency_penalty=float(
-                        req.get("frequency_penalty", 0.0)
-                    ),
-                    stop=(
-                        None if stop is None
-                        else tuple(
-                            tuple(int(t) for t in seq) for seq in stop
-                        )
-                    ),
-                    logprobs=int(req.get("logprobs", 0)),
-                    priority=str(req.get("priority", "normal")),
-                )
-                deadline_s = req.get("deadline_s")
-                # "received", not "admitted": a QueueFullError /
-                # ShuttingDownError raised inside generate() means the
-                # scheduler never accepted this request — true
-                # admission is the engine's trace-stamped `admit`
-                # instant; this event marks arrival at the handler
-                events.emit("request_received", trace_id=ctx.trace_id,
-                            prompt_len=len(prompt_ids))
-                out = client.generate(
-                    [int(t) for t in prompt_ids], params,
-                    timeout=float(req.get("timeout", 600.0)),
-                    deadline_s=(
-                        None if deadline_s is None else float(deadline_s)
-                    ),
-                    trace=ctx,
-                )
+                if self.path == "/migrate/await":
+                    # pick up a migrated continuation: block on the
+                    # imported request's waiter and reply in the exact
+                    # /generate shape (COMPLETE token list — the slot
+                    # restored the source's emitted tokens, so no
+                    # router-side stitching is needed)
+                    migrate_id = str(req.get("migrate_id") or "")
+                    pending = client.runner.migrated_pending(migrate_id)
+                    if pending is None:
+                        _fail(404, {
+                            "error": f"unknown migrate_id {migrate_id!r}",
+                            "code": "unknown_migrate_id",
+                        })
+                        return
+                    if not pending.done.wait(
+                        float(req.get("timeout", 600.0))
+                    ):
+                        client.runner.cancel(pending)
+                        raise TimeoutError("generation timed out")
+                    if pending.error is not None:
+                        raise pending.error
+                    out = pending.result
+                else:
+                    out = self._run_generate(req, ctx)
             except ConstraintCompileError as e:
                 # must precede the ValueError branch (it IS one): a
                 # malformed/unsupported constraint spec fails typed at
@@ -951,6 +1309,19 @@ def _make_handler(client: ServingClient, tokenizer=None, events=None,
                 # slowest, so: no Retry-After, non-retriable code
                 _fail(503, {"error": "generation timed out",
                             "code": "timeout"})
+                return
+            except MigratedError as e:
+                # not a failure: the live state moved to a peer mid-
+                # flight — 200 with the forwarding pointer, and the
+                # router picks the continuation up at dest's
+                # /migrate/await
+                payload = {"code": "migrated", "dest": e.dest,
+                           "migrate_id": e.migrate_id}
+                if ctx is not None:
+                    payload["trace_id"] = ctx.trace_id
+                events.emit("request_migrated", dest=e.dest,
+                            trace_id=payload.get("trace_id"))
+                self._reply(200, payload)
                 return
             except Exception as e:  # unexpected failure — still typed:
                 # the router (serving/router.py) and retry client key

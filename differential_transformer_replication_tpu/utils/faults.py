@@ -199,6 +199,26 @@ tests/test_router.py against serving/router.py):
                         (scrape_age_seconds) must flag it and judges
                         must treat the body as missing
 
+Migration fault points (serving/migrate.py + serving/server.py;
+call-point style — ``@N`` counts CALLS; exercised by
+tests/test_migrate.py):
+
+  ``migrate_corrupt`` / ``migrate_corrupt@N``
+                        flip one byte of the Nth upcoming exported
+                        page image AFTER its CRC32 is stamped (fires
+                        through :func:`consume`): the import side's
+                        checksum verify must convict the transfer
+                        (typed MigratePayloadError), the migration
+                        fails counted, and the router falls back to
+                        resume-by-replay — the request still succeeds
+                        and garbage KV is never attended
+  ``migrate_hang`` / ``migrate_hang@N``
+                        stall the Nth upcoming slot-state export for
+                        ``DTX_MIGRATE_HANG_S`` seconds (default 2.0)
+                        — a slow/stuck transfer; the drain path's
+                        total transfer budget (serving/retry.py
+                        deadline) must bound it and fall back typed
+
 Control-plane fault points (tools/autoscaler.py + serving/engine.py;
 exercised by tests/test_autoscaler.py):
 
@@ -241,6 +261,7 @@ TRAIN_HANG_ENV_VAR = "DTX_TRAIN_HANG_S"
 SKEW_ENV_VAR = "DTX_SKEW_S"
 TIER_HANG_ENV_VAR = "DTX_TIER_HANG_S"
 CANARY_REGRESS_ENV_VAR = "DTX_CANARY_REGRESS_S"
+MIGRATE_HANG_ENV_VAR = "DTX_MIGRATE_HANG_S"
 
 _STEP_KINDS = (
     "raise", "sigterm", "sigkill", "nan", "corrupt_params",
@@ -285,6 +306,9 @@ _POINT_KINDS = (
     # persistent engine-step penalty (serve_fire): a deliberately
     # perf-regressed canary build; membership-checked, never consumed
     "canary_regress",
+    # live-migration points (serving/migrate.py): corrupt fires through
+    # consume() (flip a byte post-checksum), hang through stall()
+    "migrate_corrupt", "migrate_hang",
 )
 
 
@@ -606,10 +630,11 @@ def consume(point: str) -> bool:
 
 
 def stall(point: str) -> None:
-    """Stall-class call-point fault (``ckpt_hang``, ``router_replica_hang``):
-    the armed call SLEEPS instead of raising — a slow disk / hung
-    replica, not a broken one. The sleep length comes from
-    ``DTX_ROUTER_HANG_S`` for ``router_*`` points and
+    """Stall-class call-point fault (``ckpt_hang``,
+    ``router_replica_hang``, ``migrate_hang``): the armed call SLEEPS
+    instead of raising — a slow disk / hung replica, not a broken one.
+    The sleep length comes from ``DTX_ROUTER_HANG_S`` for ``router_*``
+    points, ``DTX_MIGRATE_HANG_S`` for ``migrate_*`` points, and
     ``DTX_CKPT_HANG_S`` otherwise (default 2.0 s). Same ``@N``
     call-counting as :func:`check`."""
     points = _get()["points"]
@@ -618,8 +643,10 @@ def stall(point: str) -> None:
     points[point] -= 1
     if points[point] <= 0:
         del points[point]
-        env = (
-            ROUTER_HANG_ENV_VAR if point.startswith("router_")
-            else CKPT_HANG_ENV_VAR
-        )
+        if point.startswith("router_"):
+            env = ROUTER_HANG_ENV_VAR
+        elif point.startswith("migrate_"):
+            env = MIGRATE_HANG_ENV_VAR
+        else:
+            env = CKPT_HANG_ENV_VAR
         time.sleep(float(os.environ.get(env, "2.0")))

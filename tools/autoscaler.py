@@ -512,14 +512,20 @@ class FleetActuator:
 
     def scale_down(self) -> str:
         # least-loaded victim by the ROUTER's load score (never the
-        # canary mid-judgment): drain it out through the fleet's
-        # zero-loss path, then drop it from rotation + admission
+        # canary mid-judgment): live-migrate its ACTIVE decodes to the
+        # surviving peers (router.migrate_out — drain time is page
+        # transfer, not max_new_tokens), then drain it out through the
+        # fleet's zero-loss path and drop it from rotation + admission
         canary_url, _ = self.router.canary()
         scores = {r.url: r.score() for r in self.router.replicas}
-        url = self.fleet.scale_down(
-            score_of=lambda u: None if u == canary_url
-            else scores.get(u)
-        )
+        kwargs = {
+            "score_of": lambda u: None if u == canary_url
+            else scores.get(u),
+        }
+        pre_drain = getattr(self.router, "migrate_out", None)
+        if pre_drain is not None:
+            kwargs["pre_drain"] = pre_drain
+        url = self.fleet.scale_down(**kwargs)
         self.router.remove_replica(url)
         return url
 

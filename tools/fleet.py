@@ -304,6 +304,25 @@ class Fleet:
 
     # -- rolling restart / scaling ------------------------------------
 
+    def _migrate_drain(self, r: ReplicaProc, pre_drain) -> None:
+        """Best-effort live migration before the SIGTERM drain: hand
+        ``pre_drain`` (the router's ``migrate_out``, or a closure
+        POSTing the router's ``/drain``) the victim's URL so ACTIVE
+        decodes move to peers FIRST — drain time becomes page-transfer
+        time instead of ``max_new_tokens``' worth of decoding. A
+        failure here only means the classic finish-in-place drain does
+        the work; the requests are never harmed."""
+        if pre_drain is None or not r.alive():
+            return
+        try:
+            result = pre_drain(r.url)
+        except Exception as e:
+            self._log({"event": "drain_migrate_failed",
+                       "replica": r.index, "error": repr(e)})
+            return
+        self._log({"event": "drain_migrate", "replica": r.index,
+                   **(result if isinstance(result, dict) else {})})
+
     def _drain_exit(self, r: ReplicaProc) -> None:
         """SIGTERM (the server drains: admission stops, in-flight
         requests finish), wait for exit, escalate to SIGKILL on a
@@ -318,15 +337,19 @@ class Fleet:
                 r.proc.kill()
                 r.proc.wait(10)
 
-    def _restart_one(self, r: ReplicaProc, ready_check=None) -> None:
+    def _restart_one(self, r: ReplicaProc, ready_check=None,
+                     pre_drain=None) -> None:
         """Drain one replica, relaunch it (on whatever argv/env the
         slot now carries), wait for /ready and the optional
-        ``ready_check`` gate, then grant a fresh supervision lease."""
+        ``ready_check`` gate, then grant a fresh supervision lease.
+        ``pre_drain(url)`` (optional — the router's ``migrate_out``)
+        live-migrates ACTIVE decodes to peers before the SIGTERM."""
         with self._lock:
             r.expected_exit = True  # supervisor: hands off
             self._relaunch_at.pop(r.index, None)
         try:
             self._log({"event": "rolling_drain", "replica": r.index})
+            self._migrate_drain(r, pre_drain)
             self._drain_exit(r)
             self._launch(r)
             if not wait_http_ready(r.url, self.ready_timeout_s):
@@ -357,7 +380,7 @@ class Fleet:
             with self._lock:
                 r.expected_exit = False
 
-    def rolling_restart(self, ready_check=None) -> None:
+    def rolling_restart(self, ready_check=None, pre_drain=None) -> None:
         """Drain-aware, one replica at a time; see module docstring.
         Raises when a replica fails to come back — continuing would
         take the NEXT replica down too and shrink the fleet to zero.
@@ -367,16 +390,22 @@ class Fleet:
         the ROUTER's view (replica re-admitted, i.e. state ``up``) so
         the restart never drains replica k+1 while the router is still
         slow-re-admitting replica k — the zero-eligible window that
-        would shed requests."""
+        would shed requests.
+
+        ``pre_drain(url)`` (optional) live-migrates each replica's
+        ACTIVE decodes to peers before its SIGTERM (pass the router's
+        ``migrate_out``) — the restart's wall-clock stops depending on
+        the longest in-flight ``max_new_tokens``."""
         for r in list(self.replicas):
-            self._restart_one(r, ready_check=ready_check)
+            self._restart_one(r, ready_check=ready_check,
+                              pre_drain=pre_drain)
 
     def relaunch_replica(self, index: int,
                          server_args: Optional[Sequence[str]] = None,
                          extra_env: Optional[dict] = None,
                          argv: Optional[List[str]] = None,
                          env: Optional[dict] = None,
-                         ready_check=None):
+                         ready_check=None, pre_drain=None):
         """Drain ONE replica and relaunch it on a different command
         line — the canary-rollout primitive. ``server_args`` replaces
         the fleet's shared extra args for this slot (new checkpoint /
@@ -398,7 +427,11 @@ class Fleet:
                 extra_env=extra_env,
             )
             r.argv, r.env = fresh.argv, fresh.env
-        self._restart_one(r, ready_check=ready_check)
+        if pre_drain is None:
+            self._restart_one(r, ready_check=ready_check)
+        else:
+            self._restart_one(r, ready_check=ready_check,
+                              pre_drain=pre_drain)
         return old
 
     def scale_up(self, n: int = 1, wait_ready: bool = True) -> List[str]:
@@ -433,7 +466,7 @@ class Fleet:
         return [r.url for r in added]
 
     def scale_down(self, index: Optional[int] = None,
-                   score_of=None) -> str:
+                   score_of=None, pre_drain=None) -> str:
         """Drain ONE replica out of the fleet, zero-loss, and RELEASE
         its supervision lease (slot removed, pending relaunch
         cancelled) — a later scale_up mints a fresh slot with a fresh
@@ -443,7 +476,10 @@ class Fleet:
         LEAST-LOADED replica by ``score_of(url)`` (pass the router's
         load score — draining the busiest replica would orphan the
         most in-flight work onto its siblings); else the highest
-        index. Returns the removed replica's URL."""
+        index. ``pre_drain(url)`` (optional — the router's
+        ``migrate_out``) live-migrates the victim's ACTIVE decodes to
+        the surviving peers before its SIGTERM. Returns the removed
+        replica's URL."""
         with self._lock:
             candidates = [r for r in self.replicas if not r.expected_exit]
             if len(self.replicas) <= 1 or not candidates:
@@ -470,6 +506,7 @@ class Fleet:
             self._relaunch_at.pop(victim.index, None)
         self._log({"event": "scale_down_drain", "replica": victim.index,
                    "fleet_size": len(self.replicas)})
+        self._migrate_drain(victim, pre_drain)
         self._drain_exit(victim)
         with self._lock:
             self.replicas = [r for r in self.replicas if r is not victim]
@@ -618,7 +655,8 @@ def main() -> None:
 
         def run():
             try:
-                fleet.rolling_restart(ready_check=_router_readmitted)
+                fleet.rolling_restart(ready_check=_router_readmitted,
+                                      pre_drain=router.migrate_out)
             except Exception as e:
                 print(f"[fleet] rolling restart FAILED: {e!r}",
                       file=sys.stderr)

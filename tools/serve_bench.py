@@ -165,6 +165,8 @@ def _run_against_targets(args, targets, post) -> None:
     per_replica: dict = {}
     retries_total = [0]
     hedges_total = [0]
+    migrated_total = [0]   # replies stitched after live migration
+    replayed_total = [0]   # replies reconstructed via resume-by-replay
     lock = threading.Lock()
     next_idx = [0]
 
@@ -240,6 +242,10 @@ def _run_against_targets(args, targets, post) -> None:
                          body.get("trace_id"))
                     )
                     entry["ok"] += 1
+                    if body.get("migrated"):
+                        migrated_total[0] += 1
+                    if body.get("replayed"):
+                        replayed_total[0] += 1
                 else:
                     entry["errors"] += 1
                     if status == 504:
@@ -256,8 +262,35 @@ def _run_against_targets(args, targets, post) -> None:
     ]
     for t in threads:
         t.start()
+    drain_result = [None]
+    drain_thread = None
+    if getattr(args, "drain_during_run", None):
+        # zero-loss-failover arm: mid-run, ask the ROUTER (the first
+        # target) to live-migrate one replica's in-flight decodes to
+        # its peers; the load threads never notice beyond the stitched
+        # migrated/replayed replies counted above
+        def _drain():
+            time.sleep(max(0.0, args.drain_delay_s))
+            try:
+                status, body, _ = post(
+                    targets[0].rstrip("/") + "/drain",
+                    {"replica": args.drain_during_run},
+                    timeout=600, max_retries=0,
+                )
+                drain_result[0] = (
+                    body if status == 200
+                    else {"status": status,
+                          "error": (body or {}).get("error")}
+                )
+            except (OSError, ValueError) as e:
+                drain_result[0] = {"error": repr(e)}
+
+        drain_thread = threading.Thread(target=_drain, daemon=True)
+        drain_thread.start()
     for t in threads:
         t.join()
+    if drain_thread is not None:
+        drain_thread.join(30.0)
     wall = time.perf_counter() - t0
 
     out_tokens = sum(e[0] for e in completed)
@@ -276,6 +309,8 @@ def _run_against_targets(args, targets, post) -> None:
         "errors": errors,
         "retries": retries_total[0],
         "hedges": hedges_total[0],
+        "migrated": migrated_total[0],
+        "replayed": replayed_total[0],
         "failed": n_failed,
         "output_tokens": out_tokens,
         "wall_s": round(wall, 3),
@@ -289,6 +324,8 @@ def _run_against_targets(args, targets, post) -> None:
         "http": True,
         "smoke": bool(args.smoke),
     }
+    if drain_thread is not None:
+        line["drain"] = drain_result[0] or {"error": "drain timed out"}
     print(json.dumps(line))
     if args.out:
         with open(args.out, "a") as f:
@@ -296,6 +333,7 @@ def _run_against_targets(args, targets, post) -> None:
     print(
         f"[serve_bench] targets={len(targets)} clients={args.clients} "
         f"reqs={len(completed)} failed={n_failed} "
+        f"migrated={migrated_total[0]} replayed={replayed_total[0]} "
         f"retries={retries_total[0]} hedges={hedges_total[0]} "
         f"wall={wall:.2f}s out_tok/s={out_tokens / wall:.1f} "
         f"per_replica={json.dumps(per_replica)}",
@@ -1468,6 +1506,17 @@ def main() -> None:
     p.add_argument("--deadline", type=float, default=0.0,
                    help="server-side per-request deadline in seconds; "
                         "0 = none")
+    p.add_argument("--drain-during-run", default=None, metavar="URL",
+                   help="HTTP mode, router target only: mid-run, POST "
+                        "the router's /drain for this replica URL (live "
+                        "migration of its in-flight decodes to peers). "
+                        "The JSON line gains a 'drain' block "
+                        "(drain_seconds + migrated/finished/failed "
+                        "counts) plus per-request migrated/replayed "
+                        "tallies — the zero-loss-failover bench arm")
+    p.add_argument("--drain-delay-s", type=float, default=1.0,
+                   help="seconds into the measured window before the "
+                        "--drain-during-run POST fires")
     p.add_argument("--trace", default=None,
                    help="open-loop load-trace replay against --target: "
                         "a JSONL file of {\"t\": seconds} arrival rows, "

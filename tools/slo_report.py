@@ -179,6 +179,38 @@ def report_from_exposition(text: str, args) -> dict:
         if validity is not None:
             quality["constraint_validity_rate"] = round(validity, 6)
         out["quality"] = quality
+    # live-migration / resume-by-replay plane (serving/migrate.py): the
+    # router's per-outcome ladder counters plus the engines' transfer
+    # volume — an operator judging a rolling restart wants "how many
+    # requests moved, how many fell to replay, how much shipped" in the
+    # same report that shows the availability burn it protected
+    migrations = {}
+    for n, labels, v in samples:
+        if n == "router_migrations_total":
+            migrations[labels.get("outcome", "unknown")] = (
+                migrations.get(labels.get("outcome", "unknown"), 0) + v
+            )
+    if migrations:
+        mig = {"outcomes": {k: migrations[k]
+                            for k in sorted(migrations)}}
+        for key, name in (
+            ("pages_shipped", "serving_migrate_pages_shipped_total"),
+            ("pages_deduped", "serving_migrate_pages_deduped_total"),
+            ("bytes", "serving_migrate_bytes_total"),
+            ("journal_bytes", "router_replay_journal_bytes"),
+        ):
+            val = _counter_value(samples, name)
+            if val:
+                mig[key] = val
+        drain_count = _counter_value(samples,
+                                     "router_drain_seconds_count")
+        drain_sum = _counter_value(samples, "router_drain_seconds_sum")
+        if drain_count:
+            mig["drains"] = drain_count
+            mig["drain_seconds_mean"] = round(
+                drain_sum / drain_count, 3
+            )
+        out["migration"] = mig
     return out
 
 
