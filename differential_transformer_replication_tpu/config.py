@@ -628,6 +628,13 @@ class RouterConfig:
     # harmed either way. 0 disables migration: drain degrades to the
     # replay/plain-retry rungs only.
     migrate_budget_s: float = 10.0
+    # A migrated continuation can be migrated AGAIN while the router is
+    # following it (one-at-a-time rolling restarts drain the destination
+    # next); /migrate/await then answers another forwarding pointer.
+    # The router follows the chain up to this many hops before falling
+    # back to the replay rung — a bound, not a retry count, so a
+    # pathological ping-pong can never loop forever.
+    migrate_max_hops: int = 4
     # Per-request cap on journaled emitted tokens (ReplayJournal). A
     # runaway generation stops growing its entry; replay then degrades
     # gracefully to a longer — still bit-exact — re-decode of the tail.
@@ -689,6 +696,11 @@ class RouterConfig:
             raise ValueError(
                 f"affinity_max_sessions must be >= 1, got "
                 f"{self.affinity_max_sessions}"
+            )
+        if self.migrate_max_hops < 1:
+            raise ValueError(
+                f"migrate_max_hops must be >= 1, got "
+                f"{self.migrate_max_hops}"
             )
         for name in ("replay_journal_max_tokens",
                      "replay_journal_max_finished"):

@@ -1317,175 +1317,222 @@ class Router:
             )
             return status, body, headers
 
-        while True:
-            with self.tracer.span("pick", attempt=attempt,
-                                  **child_span_args(ctx)):
-                replica = self._pick_for_attempt(session_id, tried, end)
-            if replica is None:
-                if last is not None:
-                    return _done(*last)
-                # nothing eligible within the wait budget: shed typed
-                self._shed_counter.inc()
-                self.events.emit("request_shed", trace_id=ctx.trace_id)
-                return _done(503, {
-                    "error": "no replica available "
-                             "(all ejected, draining, or not ready)",
-                    "code": "no_replica",
-                }, shed_headers)
-            timeout = 600.0
-            timeout_is_deadline = False
-            if end is not None:
-                timeout = max(0.05, end - time.monotonic())
-                timeout_is_deadline = True
-            status, body, retry_after, used, hedged = self._attempt(
-                replica, payload, timeout, tried, timeout_is_deadline,
-                ctx=ctx,
-            )
-            attempt += 1
-            if status == 200 and body.get("code") == "migrated":
-                # the source drained and live-migrated this request
-                # mid-decode: follow the continuation to the
-                # destination and collect the COMPLETE reply there
-                dest = str(body.get("dest") or "").rstrip("/")
-                mid = str(body.get("migrate_id") or "")
-                if session_id is not None and dest:
-                    # affinity must follow the moved state immediately
-                    self.repin(session_id, dest)
-                await_t = 600.0
+        try:
+            while True:
+                with self.tracer.span("pick", attempt=attempt,
+                                      **child_span_args(ctx)):
+                    replica = self._pick_for_attempt(session_id, tried, end)
+                if replica is None:
+                    if last is not None:
+                        return _done(*last)
+                    # nothing eligible within the wait budget: shed typed
+                    self._shed_counter.inc()
+                    self.events.emit("request_shed", trace_id=ctx.trace_id)
+                    return _done(503, {
+                        "error": "no replica available "
+                                 "(all ejected, draining, or not ready)",
+                        "code": "no_replica",
+                    }, shed_headers)
+                timeout = 600.0
+                timeout_is_deadline = False
                 if end is not None:
-                    await_t = max(0.05, end - time.monotonic())
-                astatus, abody = self._await_migrated(
-                    dest, mid, await_t, ctx=ctx
+                    timeout = max(0.05, end - time.monotonic())
+                    timeout_is_deadline = True
+                status, body, retry_after, used, hedged = self._attempt(
+                    replica, payload, timeout, tried, timeout_is_deadline,
+                    ctx=ctx,
                 )
-                if astatus == 200:
-                    self._migration_counter.inc(outcome="migrated")
-                    if replay_prefix:
-                        abody["tokens"] = (
-                            replay_prefix + list(abody.get("tokens") or [])
+                attempt += 1
+                if status == 200 and body.get("code") == "migrated":
+                    # the source drained and live-migrated this request
+                    # mid-decode: follow the continuation to the
+                    # destination and collect the COMPLETE reply there.
+                    # The destination can itself drain while decoding the
+                    # imported continuation (one-at-a-time rolling restarts
+                    # with migrate-out pre-drain do this naturally), in
+                    # which case /migrate/await answers ANOTHER forwarding
+                    # pointer — follow the chain hop-bounded; ONLY a 200
+                    # without code=="migrated" is a real reply, anything
+                    # else drops to the replay rung below.
+                    dest = str(body.get("dest") or "").rstrip("/")
+                    mid = str(body.get("migrate_id") or "")
+                    no_pointer = {
+                        "error": "migrated reply carried no destination",
+                        "code": "migrate_bad_pointer",
+                    }
+                    astatus, abody = -1, dict(no_pointer)
+                    hops = 0
+                    while dest and mid:
+                        hops += 1
+                        if hops > self.cfg.migrate_max_hops:
+                            astatus, abody = -1, {
+                                "error": "migration chain exceeded "
+                                         f"{self.cfg.migrate_max_hops} hops",
+                                "code": "migrate_hop_limit",
+                            }
+                            break
+                        if session_id is not None:
+                            # affinity must follow the moved state
+                            # immediately — every hop, not just the first
+                            self.repin(session_id, dest)
+                        await_t = 600.0
+                        if end is not None:
+                            await_t = max(0.05, end - time.monotonic())
+                        astatus, abody = self._await_migrated(
+                            dest, mid, await_t, ctx=ctx
                         )
-                        abody["prompt_ids"] = orig_prompt
-                    drep = next(
-                        (r for r in self.replicas if r.url == dest), None
-                    )
-                    abody["replica"] = (
-                        drep.name if drep is not None else dest
-                    )
-                    abody["attempts"] = attempt
-                    abody["hedged"] = hedged
-                    abody["migrated"] = True
-                    return _done(200, abody, {})
-                # destination lost the continuation (crash between
-                # import and finish): typed, counted, and dropped into
-                # the normal retriable ladder — the replay rung below
-                # reconstructs from the journal
-                self._migration_counter.inc(outcome="migrate_failed")
-                status, body, retry_after = 503, {
-                    "error": f"migrated continuation lost at {dest}: "
-                             + str(abody.get("error")
-                                   or abody.get("code") or astatus),
-                    "code": "migrate_await_failed",
-                }, None
-            elif status == 200:
-                if replay_prefix:
-                    # this attempt decoded only the tail; splice the
-                    # journaled prefix back and restore the original
-                    # prompt so the client sees one seamless reply
-                    body["tokens"] = (
-                        replay_prefix + list(body.get("tokens") or [])
-                    )
-                    body["prompt_ids"] = orig_prompt
-                    body["replayed"] = True
-                    self._migration_counter.inc(outcome="replayed")
-                body["replica"] = used.name
-                body["attempts"] = attempt
-                body["hedged"] = hedged
-                return _done(200, body, {})
-            retriable = status == -1 or (
-                status == 503
-                and body.get("code") not in NON_RETRIABLE_503_CODES
-            )
-            if not retriable:
-                # non-recoverable (504 deadline, timeout,
-                # engine_failed, 4xx/5xx): pass through, attributed
-                body.setdefault("replica", used.name)
-                return _done(status, body, {})
-            tried.append(replica.url)
-            if used is not replica and used.url not in tried:
-                tried.append(used.url)  # a failed hedge also counts
-            if orig_prompt is not None:
-                toks = self.journal.tokens(jid)
-                if toks:
-                    # resume-by-replay: the dead attempt already
-                    # emitted these tokens; resubmit prompt+prefix as
-                    # a prefill with key_offset carrying the key-chain
-                    # position, so the peer's continuation is
-                    # bit-identical — no page transfer, no lost work
-                    replay_prefix = replay_prefix + toks
-                    remaining_max = max(0, remaining_max - len(toks))
-                    reason = self._replay_finish_reason(
-                        replay_prefix, payload, remaining_max
-                    )
-                    if reason is not None:
-                        # the source died AFTER finishing the
-                        # generation but before replying: the journal
-                        # holds the complete answer — synthesize it
+                        if not (astatus == 200
+                                and abody.get("code") == "migrated"):
+                            break
+                        self.events.emit(
+                            "migrate_chained", trace_id=ctx.trace_id,
+                            hop=hops, source=dest,
+                            dest=str(abody.get("dest") or ""),
+                        )
+                        dest = str(abody.get("dest") or "").rstrip("/")
+                        mid = str(abody.get("migrate_id") or "")
+                        astatus, abody = -1, dict(no_pointer)
+                    if astatus == 200:
+                        self._migration_counter.inc(outcome="migrated")
+                        if replay_prefix:
+                            abody["tokens"] = (
+                                replay_prefix + list(abody.get("tokens") or [])
+                            )
+                            abody["prompt_ids"] = orig_prompt
+                        drep = next(
+                            (r for r in self.replicas if r.url == dest), None
+                        )
+                        abody["replica"] = (
+                            drep.name if drep is not None else dest
+                        )
+                        abody["attempts"] = attempt
+                        abody["hedged"] = hedged
+                        abody["migrated"] = True
+                        return _done(200, abody, {})
+                    # destination lost the continuation (crash between
+                    # import and finish): typed, counted, and dropped into
+                    # the normal retriable ladder — the replay rung below
+                    # reconstructs from the journal
+                    self._migration_counter.inc(outcome="migrate_failed")
+                    status, body, retry_after = 503, {
+                        "error": f"migrated continuation lost at {dest}: "
+                                 + str(abody.get("error")
+                                       or abody.get("code") or astatus),
+                        "code": "migrate_await_failed",
+                    }, None
+                elif status == 200:
+                    if replay_prefix:
+                        # this attempt decoded only the tail; splice the
+                        # journaled prefix back and restore the original
+                        # prompt so the client sees one seamless reply
+                        body["tokens"] = (
+                            replay_prefix + list(body.get("tokens") or [])
+                        )
+                        body["prompt_ids"] = orig_prompt
+                        body["replayed"] = True
                         self._migration_counter.inc(outcome="replayed")
-                        return _done(200, {
-                            "request_id": -1,
-                            "prompt_ids": orig_prompt,
-                            "tokens": replay_prefix,
-                            "finish_reason": reason,
-                            "ttft_ms": 0.0,
-                            "replayed": True,
-                            "attempts": attempt,
-                            "hedged": hedged,
-                        }, {})
-                    cur_prompt = list(cur_prompt) + toks
-                    self.journal.finish(jid)
-                    jid = uuid.uuid4().hex
-                    self.journal.begin(jid)
-                    payload = dict(payload)
-                    payload["prompt_ids"] = cur_prompt
-                    payload["key_offset"] = len(replay_prefix)
-                    payload["max_new_tokens"] = max(1, remaining_max)
-                    payload["journal_id"] = jid
-                    self.events.emit(
-                        "request_replayed", trace_id=ctx.trace_id,
-                        journaled=len(toks),
-                        total_prefix=len(replay_prefix),
-                    )
-            capped_ra = None
-            if retry_after is not None:
-                capped_ra = min(retry_after, self.cfg.retry_after_cap_s)
-            headers = {
-                "Retry-After": _fmt_secs(
-                    capped_ra if capped_ra is not None
-                    else self._shed_retry_after(priority)
+                    body["replica"] = used.name
+                    body["attempts"] = attempt
+                    body["hedged"] = hedged
+                    return _done(200, body, {})
+                retriable = status == -1 or (
+                    status == 503
+                    and body.get("code") not in NON_RETRIABLE_503_CODES
                 )
-            }
-            last = (503 if status == -1 else status, body, headers)
-            if attempt >= self.cfg.max_attempts:
-                return _done(*last)
-            delay = backoff_delay(
-                attempt - 1, base=self.cfg.retry_base_s,
-                cap=self.cfg.retry_cap_s, retry_after=capped_ra,
-                rng=self._rng,
+                if not retriable:
+                    # non-recoverable (504 deadline, timeout,
+                    # engine_failed, 4xx/5xx): pass through, attributed
+                    body.setdefault("replica", used.name)
+                    return _done(status, body, {})
+                tried.append(replica.url)
+                if used is not replica and used.url not in tried:
+                    tried.append(used.url)  # a failed hedge also counts
+                if orig_prompt is not None:
+                    toks = self.journal.tokens(jid)
+                    if toks:
+                        # resume-by-replay: the dead attempt already
+                        # emitted these tokens; resubmit prompt+prefix as
+                        # a prefill with key_offset carrying the key-chain
+                        # position, so the peer's continuation is
+                        # bit-identical — no page transfer, no lost work
+                        replay_prefix = replay_prefix + toks
+                        remaining_max = max(0, remaining_max - len(toks))
+                        reason = self._replay_finish_reason(
+                            replay_prefix, payload, remaining_max
+                        )
+                        if reason is not None:
+                            # the source died AFTER finishing the
+                            # generation but before replying: the journal
+                            # holds the complete answer — synthesize it
+                            self._migration_counter.inc(outcome="replayed")
+                            return _done(200, {
+                                "request_id": -1,
+                                "prompt_ids": orig_prompt,
+                                "tokens": replay_prefix,
+                                "finish_reason": reason,
+                                "ttft_ms": 0.0,
+                                "replayed": True,
+                                "attempts": attempt,
+                                "hedged": hedged,
+                            }, {})
+                        cur_prompt = list(cur_prompt) + toks
+                        self.journal.finish(jid)
+                        jid = uuid.uuid4().hex
+                        self.journal.begin(jid)
+                        payload = dict(payload)
+                        payload["prompt_ids"] = cur_prompt
+                        payload["key_offset"] = len(replay_prefix)
+                        payload["max_new_tokens"] = max(1, remaining_max)
+                        payload["journal_id"] = jid
+                        self.events.emit(
+                            "request_replayed", trace_id=ctx.trace_id,
+                            journaled=len(toks),
+                            total_prefix=len(replay_prefix),
+                        )
+                capped_ra = None
+                if retry_after is not None:
+                    capped_ra = min(retry_after, self.cfg.retry_after_cap_s)
+                headers = {
+                    "Retry-After": _fmt_secs(
+                        capped_ra if capped_ra is not None
+                        else self._shed_retry_after(priority)
+                    )
+                }
+                last = (503 if status == -1 else status, body, headers)
+                if attempt >= self.cfg.max_attempts:
+                    return _done(*last)
+                delay = backoff_delay(
+                    attempt - 1, base=self.cfg.retry_base_s,
+                    cap=self.cfg.retry_cap_s, retry_after=capped_ra,
+                    rng=self._rng,
+                )
+                if end is not None and time.monotonic() + delay >= end:
+                    # deadline would expire mid-backoff: surface the last
+                    # typed failure instead of manufacturing a 504
+                    return _done(*last)
+                self._retry_counter.inc()
+                self.tracer.instant(
+                    "retry", attempt=attempt, failed=used.name,
+                    code=str(body.get("code", status)), **instant_args(ctx),
+                )
+                self.events.emit(
+                    "request_retried", trace_id=ctx.trace_id,
+                    attempt=attempt, failed=used.name,
+                    code=body.get("code"),
+                )
+                self._sleep(delay)
+        finally:
+            # EVERY exit path retires the live journal entry —
+            # including an unexpected exception that bypasses
+            # _done (do_POST's catch-all 500 path). finish() is
+            # idempotent, so _done's accounting stays the happy
+            # path and this is a no-op there; without it a
+            # crashed attempt leaks its entry into _live forever
+            # (ReplayJournal only evicts finished entries).
+            self.journal.finish(jid)
+            self._journal_bytes_gauge.set(
+                self.journal.stats()["bytes"]
             )
-            if end is not None and time.monotonic() + delay >= end:
-                # deadline would expire mid-backoff: surface the last
-                # typed failure instead of manufacturing a 504
-                return _done(*last)
-            self._retry_counter.inc()
-            self.tracer.instant(
-                "retry", attempt=attempt, failed=used.name,
-                code=str(body.get("code", status)), **instant_args(ctx),
-            )
-            self.events.emit(
-                "request_retried", trace_id=ctx.trace_id,
-                attempt=attempt, failed=used.name,
-                code=body.get("code"),
-            )
-            self._sleep(delay)
 
     # -- fleet observability -------------------------------------------
 

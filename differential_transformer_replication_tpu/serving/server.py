@@ -37,9 +37,12 @@ dedup), exports the slot's checksummed wire image, lands it with
 ``POST dest /migrate/import``, releases the slot, and answers the
 original blocked ``/generate`` with ``200 {"code": "migrated"}`` so the
 router re-issues ``POST dest /migrate/await {migrate_id}`` and returns
-the COMPLETE token list from the peer. Probe, export, transfer and
-release run as ONE command on the engine thread between steps, so no
-decode iteration can interleave with a half-exported slot.
+the COMPLETE token list from the peer. Only the device touches (export
+snapshot, release) run as engine-thread commands between steps; the
+network legs (probe, transfer) stay on the HTTP handler thread, so a
+slow destination never stalls co-resident decodes — the slot keeps
+decoding between snapshot and release, and the destination regenerates
+any post-snapshot tokens bit-exactly (the key chain is pure in ``t``).
 """
 
 from __future__ import annotations
@@ -360,51 +363,85 @@ class EngineRunner:
         under the transfer budget, then release the local slot and
         settle its waiter with :class:`MigratedError` — the blocked
         /generate handler answers 200 ``{"code": "migrated"}`` and the
-        router awaits the peer. The whole sequence runs as ONE engine-
-        thread command, so no decode step interleaves between export
-        and release (the exported image is exact, and the source can
-        never decode past it). Raises :class:`MigrateExportError`
-        (typed ``code``) when any rung fails — the caller's fallback is
-        replay."""
-        budget = max(0.1, float(budget_s))
+        router awaits the peer.
 
-        def thunk():
-            deadline = time.monotonic() + budget
-            pending = self._waiters.get(request_id)
-            if pending is None:
-                # finished (or never admitted here): its /generate
-                # already answered with the real result — nothing to move
-                return {"outcome": "finished"}
+        Only the device touches (export snapshot, release) run as
+        engine-thread commands; the NETWORK legs (probe, transfer) run
+        on the calling HTTP-handler thread. A slow or unreachable
+        destination therefore costs the migrating request its budget —
+        never the co-resident in-flight decodes, which keep stepping
+        throughout. The slot also keeps decoding between snapshot and
+        release; any tokens it emits past the snapshot are regenerated
+        bit-exactly at the destination (the fold_in key chain is a pure
+        function of ``t``), so a stale image is never a wrong image.
+        Raises :class:`MigrateExportError` (typed ``code``) when any
+        rung fails — the caller's fallback is replay."""
+        budget = max(0.1, float(budget_s))
+        deadline = time.monotonic() + budget
+
+        def read_prompt():
+            if self._waiters.get(request_id) is None:
+                return None
             slot = self.engine._slot_for(request_id)
-            cached = 0
-            if slot is not None:
-                try:
-                    status, body, _ = http_post_json_with_retries(
-                        dest_url + "/migrate/probe",
-                        {"prompt_ids": [int(t) for t in slot.prompt]},
-                        timeout=min(5.0, budget), max_retries=0,
-                        deadline_s=max(0.1, deadline - time.monotonic()),
-                    )
-                    if status == 200:
-                        cached = int(body.get("cached_pages", 0) or 0)
-                except Exception:
-                    cached = 0  # probe is best-effort: dedup off
-            blob = self.engine.export_slot_state(
+            return (
+                [int(t) for t in slot.prompt]
+                if slot is not None else []
+            )
+
+        prompt = self.run_on_engine(read_prompt)
+        if prompt is None:
+            # finished (or never admitted here): its /generate already
+            # answered with the real result — nothing to move
+            return {"outcome": "finished"}
+
+        cached = 0
+        if prompt:
+            try:
+                status, body, _ = http_post_json_with_retries(
+                    dest_url + "/migrate/probe",
+                    {"prompt_ids": prompt},
+                    timeout=min(5.0, budget), max_retries=0,
+                    deadline_s=max(0.1, deadline - time.monotonic()),
+                )
+                if status == 200:
+                    cached = int(body.get("cached_pages", 0) or 0)
+            except Exception:
+                cached = 0  # probe is best-effort: dedup off
+
+        def export():
+            if self._waiters.get(request_id) is None:
+                return None
+            return self.engine.export_slot_state(
                 request_id, dedup_pages=cached
             )
-            status, body, _ = http_post_json_with_retries(
-                dest_url + "/migrate/import",
-                {"state": to_wire(blob), "migrate_id": migrate_id},
-                timeout=budget, max_retries=2,
-                deadline_s=max(0.1, deadline - time.monotonic()),
+
+        blob = self.run_on_engine(export)
+        if blob is None:
+            return {"outcome": "finished"}
+
+        status, body, _ = http_post_json_with_retries(
+            dest_url + "/migrate/import",
+            {"state": to_wire(blob), "migrate_id": migrate_id},
+            timeout=max(0.1, deadline - time.monotonic()),
+            max_retries=2,
+            deadline_s=max(0.1, deadline - time.monotonic()),
+        )
+        if status != 200:
+            code = body.get("code") if isinstance(body, dict) else None
+            _inc_stat(self.engine.stats, "migrate_failed")
+            raise MigrateExportError(
+                f"destination import failed (status {status}, "
+                f"code {code})", code="migrate_transfer",
             )
-            if status != 200:
-                code = body.get("code") if isinstance(body, dict) else None
-                _inc_stat(self.engine.stats, "migrate_failed")
-                raise MigrateExportError(
-                    f"destination import failed (status {status}, "
-                    f"code {code})", code="migrate_transfer",
-                )
+
+        def release():
+            pending = self._waiters.get(request_id)
+            if pending is None or pending.settled:
+                # finished locally during the transfer: the real result
+                # already answered the client; the imported copy decodes
+                # the same tokens at dest and idles in its bounded
+                # _migrated LRU until evicted
+                return {"outcome": "finished"}
             self.engine.release_migrated(request_id)
             self._waiters.pop(request_id, None)
             self._settle(
@@ -418,7 +455,7 @@ class EngineRunner:
                 "migrate_id": migrate_id,
             }
 
-        return self.run_on_engine(thunk, timeout=budget + 30.0)
+        return self.run_on_engine(release)
 
     def import_state(self, blob: bytes, migrate_id: str,
                      timeout: float = 30.0) -> int:

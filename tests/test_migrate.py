@@ -696,6 +696,109 @@ class TestMigrateFaultDrills:
         assert time.perf_counter() - t0 < 0.1
 
 
+class TestMigrateOutOffEngineThread:
+    """Regression: probe/transfer used to run INSIDE one engine-thread
+    command, so a slow or unreachable destination froze every
+    co-resident decode for up to the transfer budget. Only the device
+    touches (snapshot, release) may run on the engine thread — the
+    network legs stay on the caller's."""
+
+    class _Eng:
+        def __init__(self):
+            self.serving = ServingConfig(num_slots=4)
+            self.stats = {"rejected": 0}
+            self.steps = 0
+            self.finish = threading.Event()
+            self._q = []
+            self._rid = 0
+
+        def queue_len(self):
+            return 0
+
+        def has_work(self):
+            return bool(self._q)
+
+        def submit(self, prompt, params=None, **kw):
+            rid = self._rid
+            self._rid += 1
+            self._q.append(rid)
+            return rid
+
+        def cancel(self, rid):
+            return False
+
+        def step(self):
+            if self.finish.is_set():
+                self._q.clear()
+            self.steps += 1
+            time.sleep(0.002)
+            return []
+
+        def _slot_for(self, rid):
+            class _S:
+                prompt = [1, 2, 3]
+            return _S()
+
+        def export_slot_state(self, rid, dedup_pages=0):
+            return b"wire-image"
+
+        def release_migrated(self, rid):
+            self._q = [r for r in self._q if r != rid]
+            return True
+
+    def test_transfer_off_engine_thread_decodes_continue(
+        self, monkeypatch
+    ):
+        import differential_transformer_replication_tpu.serving.server \
+            as server_mod
+
+        eng = self._Eng()
+        runner = server_mod.EngineRunner(eng)
+        calls = []
+
+        def fake_post(url, payload, **kw):
+            calls.append(url.rsplit("/", 1)[-1])
+            assert threading.current_thread() is not runner._thread, \
+                "network leg ran on the engine thread"
+            if url.endswith("/migrate/probe"):
+                return 200, {"cached_pages": 0}, None
+            # the transfer stalls until the engine has stepped three
+            # MORE times — were the transfer still an engine command,
+            # no step could run and this would time out
+            start = eng.steps
+            deadline = time.time() + 5.0
+            while eng.steps < start + 3:
+                assert time.time() < deadline, \
+                    "engine thread stalled during the transfer"
+                time.sleep(0.002)
+            return 200, {"request_id": 0,
+                         "migrate_id": payload["migrate_id"]}, None
+
+        monkeypatch.setattr(
+            server_mod, "http_post_json_with_retries", fake_post
+        )
+        try:
+            moving = runner.submit([1, 2, 3], max_new_tokens=8)
+            resident = runner.submit([4, 5], max_new_tokens=8)
+            deadline = time.time() + 5.0
+            while moving.rid is None or resident.rid is None:
+                assert time.time() < deadline
+                time.sleep(0.002)
+            res = runner.migrate_out(
+                moving.rid, "http://dest", "mig1", budget_s=5.0
+            )
+            assert res["outcome"] == "migrated"
+            assert calls == ["probe", "import"]
+            assert moving.done.wait(1.0)
+            assert isinstance(moving.error, server_mod.MigratedError)
+            assert moving.error.dest == "http://dest"
+            # the co-resident request was never settled or disturbed
+            assert not resident.settled
+        finally:
+            eng.finish.set()
+            runner.close(timeout=10)
+
+
 # ---------------------------------------------------------------------
 # router fallback ladder over canned HTTP replicas (no jax, no engine)
 # ---------------------------------------------------------------------
@@ -843,6 +946,30 @@ class TestRouterReplayRung:
             s1.shutdown()
             s2.shutdown()
 
+    def test_unexpected_exception_retires_journal_entry(self):
+        """An exception that escapes the attempt loop (surfacing as
+        do_POST's catch-all 500) bypasses _done — the try/finally must
+        still retire the live journal entry, or every such failure
+        leaks bytes into _live forever (only finished entries evict)."""
+        router = Router(["http://127.0.0.1:1"], _rcfg(),
+                        rng=random.Random(0))
+        _mark_up(*router.replicas)
+
+        def boom(*a, **kw):
+            raise RuntimeError("attempt blew up")
+
+        router._attempt = boom
+        try:
+            with pytest.raises(RuntimeError, match="blew up"):
+                router.handle_generate({
+                    "prompt_ids": [1, 2], "max_new_tokens": 2,
+                })
+            stats = router.journal.stats()
+            assert stats["entries"] == 0
+            assert stats["bytes"] == 0
+        finally:
+            router.close()
+
     def test_finish_reason_inference(self):
         f = Router._replay_finish_reason
         assert f([1, 2], {}, 0) == "length"
@@ -902,6 +1029,108 @@ class TestRouterMigrateRung:
             # affinity followed the moved state immediately
             with router._aff_lock:
                 assert router._affinity["s1"] is b_rep
+        finally:
+            router.close()
+            sa.shutdown()
+            sb.shutdown()
+
+    def test_chained_migration_followed_across_hops(self):
+        """The destination itself drains while decoding the imported
+        continuation (one-at-a-time rolling restarts do this
+        naturally): /migrate/await answers ANOTHER forwarding pointer.
+        The router must follow the chain to the final replica — never
+        hand the pointer body to the client as a "successful"
+        generation with no tokens."""
+        box = {"awaits": []}
+
+        def on_a(path, payload):
+            assert path == "/generate"
+            return 200, {"code": "migrated", "dest": box["ub"],
+                         "migrate_id": "m1"}
+
+        def on_b(path, payload):
+            assert path == "/migrate/await"
+            box["awaits"].append(("b", payload["migrate_id"]))
+            return 200, {"code": "migrated", "dest": box["uc"],
+                         "migrate_id": "m2"}
+
+        def on_c(path, payload):
+            assert path == "/migrate/await"
+            box["awaits"].append(("c", payload["migrate_id"]))
+            return 200, {"request_id": 7, "prompt_ids": [1, 2, 3],
+                         "tokens": [4, 5], "finish_reason": "length",
+                         "ttft_ms": 2.0}
+
+        sa, ua = _spawn(_json_handler(on_a))
+        sb, ub = _spawn(_json_handler(on_b))
+        sc, uc = _spawn(_json_handler(on_c))
+        box["ub"], box["uc"] = ub, uc
+        router = Router([ua, ub, uc], _rcfg(), rng=random.Random(0))
+        _mark_up(*router.replicas)
+        try:
+            assert router.repin("s1", ua) is True
+            status, body, _ = router.handle_generate({
+                "prompt_ids": [1, 2, 3], "max_new_tokens": 5,
+                "session_id": "s1",
+            })
+            assert status == 200
+            assert body["migrated"] is True
+            assert body["tokens"] == [4, 5]
+            assert box["awaits"] == [("b", "m1"), ("c", "m2")]
+            c_rep = next(r for r in router.replicas if r.url == uc)
+            assert body["replica"] == c_rep.name
+            # affinity followed the moved state through EVERY hop
+            with router._aff_lock:
+                assert router._affinity["s1"] is c_rep
+            assert router._migration_counter.labels(
+                outcome="migrated"
+            ).value == 1
+        finally:
+            router.close()
+            for s in (sa, sb, sc):
+                s.shutdown()
+
+    def test_migration_hop_limit_falls_back_to_replay(self):
+        """A pathological forwarding chain (the destination keeps
+        answering another pointer) is bounded by migrate_max_hops;
+        past the bound the router drops to the replay rung instead of
+        looping forever — the client still gets real tokens."""
+        box = {"awaits": 0, "b_gen": None}
+        router_box = {}
+
+        def on_a(path, payload):
+            router_box["r"].journal.update(payload["journal_id"], [5])
+            return 200, {"code": "migrated", "dest": box["ub"],
+                         "migrate_id": "m1"}
+
+        def on_b(path, payload):
+            if path == "/migrate/await":
+                box["awaits"] += 1
+                return 200, {"code": "migrated", "dest": box["ub"],
+                             "migrate_id": f"m{box['awaits'] + 1}"}
+            box["b_gen"] = payload
+            return 200, {"request_id": 9, "tokens": [6],
+                         "finish_reason": "length", "ttft_ms": 1.0}
+
+        router, (sa, ua), (sb, ub) = self._pair(
+            on_a, on_b, max_attempts=4, migrate_max_hops=2
+        )
+        box["ub"] = ub
+        router_box["r"] = router
+        try:
+            assert router.repin("s1", ua) is True
+            status, body, _ = router.handle_generate({
+                "prompt_ids": [1, 2, 3], "max_new_tokens": 2,
+                "session_id": "s1",
+            })
+            assert status == 200
+            assert body["tokens"] == [5, 6]
+            assert body["replayed"] is True
+            assert box["awaits"] == 2  # the hop bound held
+            assert box["b_gen"]["key_offset"] == 1
+            labels = router._migration_counter.labels
+            assert labels(outcome="migrate_failed").value == 1
+            assert labels(outcome="replayed").value == 1
         finally:
             router.close()
             sa.shutdown()
